@@ -1,0 +1,30 @@
+"""shallowspeed_tpu — a TPU-native distributed-training framework.
+
+A brand-new JAX/XLA re-design of the capabilities of siboehm/ShallowSpeed
+(reference mounted at /root/reference): deep-MLP SGD training on MNIST under
+sequential, data-parallel (DP), pipeline-parallel (PP, naive / GPipe /
+PipeDream-Flush schedules) and composed DP x PP layouts.
+
+Architecture (TPU-first, not a port):
+
+- ``ops``        pure jax.numpy forward + hand-written backward kernels
+                 (the reference keeps these in NumPy: functional.py).
+- ``model``      stage partitioning + explicit forward/backward over a params
+                 pytree with residuals threaded explicitly (the reference uses
+                 stateful Module._cache dicts: layers.py).
+- ``schedules``  pipeline schedules as pure instruction-stream generators
+                 (same load-bearing abstraction as reference pipe.py:141-299).
+- ``parallel``   the TPU execution layer: a schedule -> clock-tick *lowering*
+                 (MPMD instruction streams compiled to a static SPMD tick
+                 program) and a shard_map executor over a 2-D (dp, pp)
+                 jax.sharding.Mesh where jax.lax.ppermute replaces MPI
+                 Send/Recv and jax.lax.psum replaces Iallreduce.
+- ``data``       the MNIST-784 parquet/npy data layer with strided DP sharding
+                 and microbatch slicing (reference dataset.py semantics).
+- ``optimizer``  SGD over pytrees, applied on-device inside the jitted step.
+"""
+
+from shallowspeed_tpu import data, model, ops, optimizer, schedules, utils
+from shallowspeed_tpu.model import ModelSpec, StageSpec, init_model, partition_sizes
+
+__version__ = "0.1.0"

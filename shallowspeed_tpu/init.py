@@ -1,0 +1,28 @@
+"""Deterministic, layout-independent parameter initialization.
+
+The reference guarantees that model initialization is identical no matter how
+the model is partitioned across DP replicas / PP stages, by seeding a fresh
+MT19937 stream per Linear layer from its (in, out) dims
+(/root/reference/shallowspeed/layers.py:103-113). We reproduce that scheme
+bit-for-bit on host NumPy, then device_put — it is what makes "TPU run reaches
+the NumPy reference's loss" a checkable statement, and what makes the
+layout-independent model hash (utils.py) meaningful.
+"""
+
+import numpy as np
+
+
+def linear_init(in_dim: int, out_dim: int):
+    """Weights N(0,1)/sqrt(in) fp32 with per-layer seed in + 1337*out; zero bias.
+
+    Matches reference layers.py:106-113 exactly (same bit-stream, same dtype
+    ops: normal -> astype(float32) -> divide by float64 sqrt).
+    """
+    rs = np.random.RandomState(
+        np.random.MT19937(np.random.SeedSequence(in_dim + out_dim * 1337))
+    )
+    w = rs.normal(0.0, 1.0, size=(out_dim, in_dim)).astype(np.float32) / np.sqrt(
+        in_dim
+    )
+    b = np.zeros((1, out_dim), dtype=np.float32)
+    return np.asarray(w, dtype=np.float32), b

@@ -1,0 +1,217 @@
+"""Model layer: stage partitioning + explicit forward/backward over pytrees.
+
+Capability parity with the reference's Module/Sequential/MLP stack
+(/root/reference/shallowspeed/layers.py), re-designed functionally for JAX:
+
+- parameters are a pytree ``[{"W": (out,in), "b": (1,out)}, ...]`` per stage —
+  no Parameter objects, no mutable .grad fields;
+- the per-microbatch activation caches (reference ``Module._cache`` keyed by
+  mubatch_id, layers.py:70,86,117) become *residuals returned by the forward
+  pass* and threaded explicitly into the backward pass — idiomatic JAX, and
+  what lets the whole step jit/scan cleanly;
+- gradient accumulation (reference ``param.grad +=``, layers.py:135-136) is a
+  pytree add performed by the caller (a lax.scan carry), not hidden state.
+
+Stage partitioning semantics match reference layers.py:236-270 ("MLP"):
+``len(sizes) % n_stages == 0``; stage i owns the sizes slice
+``[i*ss : i*ss+ss+1]`` (overlapping boundary entry) giving ``len(local)-1``
+Linear layers; every Linear has a fused ReLU except the last Linear of the
+last stage; the last stage appends the softmax + MSE loss head. Stages are
+deliberately UNEQUAL (e.g. 2/2/2/1 Linears at PP=4) — the SPMD executor
+handles that via zero-padded stacked params (see parallel/executor.py).
+
+Faithful reference quirk: when the last stage owns ZERO Linears (e.g. 8
+sizes at PP=8), the no-relu-on-final-Linear rule never fires — the global
+final Linear (owned by the second-to-last stage) keeps its ReLU, so that
+layout is architecturally DIFFERENT from the sequential model. This matches
+the reference exactly (layers.py:253-257); layout/sequential equivalence
+holds whenever the last stage has at least one Linear.
+"""
+
+import dataclasses
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from shallowspeed_tpu import ops
+from shallowspeed_tpu.init import linear_init
+
+
+@dataclasses.dataclass(frozen=True)
+class StageSpec:
+    """Static description of one pipeline stage (trace-time constant)."""
+
+    local_sizes: tuple  # activation dims owned by this stage, len = n_linears+1
+    relu_flags: tuple  # per-Linear fused-ReLU flag
+    has_head: bool  # softmax + MSE head lives on the last stage
+    global_batch_size: int
+
+    @property
+    def n_linears(self):
+        return len(self.local_sizes) - 1
+
+    @property
+    def in_dim(self):
+        return self.local_sizes[0]
+
+    @property
+    def out_dim(self):
+        # softmax & loss head do not change the output dim (layers.py:268-270)
+        return self.local_sizes[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """Static description of the whole (possibly pipelined) model."""
+
+    sizes: tuple
+    n_stages: int
+    global_batch_size: int
+    stages: tuple  # tuple[StageSpec]
+
+    @property
+    def in_dim(self):
+        return self.sizes[0]
+
+    @property
+    def out_dim(self):
+        return self.sizes[-1]
+
+
+def partition_sizes(sizes: Sequence[int], n_stages: int):
+    """Slice the global layer-size list into per-stage local size lists.
+
+    Same arithmetic as reference layers.py:242-250, including the overlapping
+    boundary entry and the possibility of a 0-Linear trailing stage.
+    """
+    sizes = tuple(int(s) for s in sizes)
+    if len(sizes) % n_stages != 0:
+        raise ValueError(
+            f"len(sizes)={len(sizes)} must be divisible by n_stages={n_stages}"
+        )
+    stage_size = len(sizes) // n_stages
+    return [
+        sizes[i * stage_size : min(len(sizes), i * stage_size + stage_size + 1)]
+        for i in range(n_stages)
+    ]
+
+
+def make_model_spec(sizes, n_stages, global_batch_size) -> ModelSpec:
+    locals_ = partition_sizes(sizes, n_stages)
+    stages = []
+    for i, loc in enumerate(locals_):
+        is_last = i == n_stages - 1
+        n_lin = len(loc) - 1
+        relu_flags = tuple(
+            not (is_last and l == n_lin - 1) for l in range(n_lin)
+        )  # last Linear of last stage has no activation (layers.py:253-257)
+        stages.append(
+            StageSpec(
+                local_sizes=tuple(loc),
+                relu_flags=relu_flags,
+                has_head=is_last,
+                global_batch_size=global_batch_size,
+            )
+        )
+    return ModelSpec(
+        sizes=tuple(int(s) for s in sizes),
+        n_stages=n_stages,
+        global_batch_size=global_batch_size,
+        stages=tuple(stages),
+    )
+
+
+def init_stage_params(spec: StageSpec):
+    """Host-side deterministic init for one stage; list of {"W","b"} numpy."""
+    return [
+        dict(zip(("W", "b"), linear_init(spec.local_sizes[l], spec.local_sizes[l + 1])))
+        for l in range(spec.n_linears)
+    ]
+
+
+def init_model(spec: ModelSpec):
+    """Per-stage parameter pytrees (host numpy; caller device_puts/shards)."""
+    return [init_stage_params(s) for s in spec.stages]
+
+
+# ---------------------------------------------------------------------------
+# Forward / backward. Pure functions; residuals are explicit.
+#
+# Residuals structure per stage (static given the spec):
+#   (layer_caches, z)
+#     layer_caches: tuple per Linear of (x_in, relu_bitmask)  — bitmask is a
+#                   zero-size placeholder for no-relu layers
+#     z:            head-input logits if has_head else zero-size placeholder
+# ---------------------------------------------------------------------------
+
+
+def _placeholder(dtype=jnp.float32):
+    return jnp.zeros((0,), dtype)
+
+
+def stage_forward(params, spec: StageSpec, x, precision=ops.DEFAULT_PRECISION):
+    """Run one stage's Linears (+head); return (out, residuals).
+
+    In training the caller keeps residuals; for inference discard them (XLA
+    dead-code-eliminates the cache outputs under jit).
+
+    Mirrors reference Sequential.forward + Linear.forward + head modules
+    (layers.py:115-122,152-155,176-180) with caches made explicit.
+    """
+    caches = []
+    for l in range(spec.n_linears):
+        y = ops.linear(x, params[l]["W"], params[l]["b"], precision=precision)
+        if spec.relu_flags[l]:
+            caches.append((x, y > 0))
+            x = ops.relu(y)
+        else:
+            caches.append((x, _placeholder(jnp.bool_)))
+            x = y
+    if spec.has_head:
+        z = x
+        out = ops.softmax(z)
+        return out, (tuple(caches), z)
+    return x, (tuple(caches), _placeholder())
+
+
+def stage_backward(params, spec: StageSpec, residuals, dout, precision=ops.DEFAULT_PRECISION):
+    """Backward through one stage; returns (dx, grads) with grads ≅ params.
+
+    Contract matches the reference Worker: for the head stage ``dout`` is the
+    TARGET microbatch (the reference loads targets into the output buffer and
+    MSELoss.backward consumes them, pipe.py:361-365 + layers.py:157-163);
+    for other stages it is the gradient w.r.t. this stage's output.
+    """
+    caches, z = residuals
+    if spec.has_head:
+        g = ops.softmax_mse_head_grad(z, dout, spec.global_batch_size)
+    else:
+        g = dout
+    grads = [None] * spec.n_linears
+    for l in reversed(range(spec.n_linears)):
+        x_in, bitmask = caches[l]
+        if spec.relu_flags[l]:
+            g = ops.relu_grad(g, bitmask)
+        g, dw, db = ops.linear_grad(g, x_in, params[l]["W"], precision=precision)
+        grads[l] = {"W": dw, "b": jnp.reshape(db, (1, -1))}
+    return g, grads
+
+
+def model_forward(params_list, spec: ModelSpec, x, precision=ops.DEFAULT_PRECISION):
+    """Chain all stages (the sequential / single-process path)."""
+    residuals = []
+    for params, sspec in zip(params_list, spec.stages):
+        x, res = stage_forward(params, sspec, x, precision=precision)
+        residuals.append(res)
+    return x, residuals
+
+
+def model_backward(params_list, spec: ModelSpec, residuals, target, precision=ops.DEFAULT_PRECISION):
+    """Chain all stages backward; ``target`` feeds the head stage."""
+    g = target
+    grads_list = [None] * spec.n_stages
+    for i in reversed(range(spec.n_stages)):
+        g, grads_list[i] = stage_backward(
+            params_list[i], spec.stages[i], residuals[i], g, precision=precision
+        )
+    return g, grads_list

@@ -1,0 +1,125 @@
+"""Stateless op kernels: forward and hand-derived backward, in jax.numpy.
+
+Capability parity with the reference's NumPy kernels
+(/root/reference/shallowspeed/functional.py:4-44): relu, linear, softmax and
+MSE-after-softmax loss, each with an explicit hand-written VJP. The backward
+functions are part of the framework surface (we do NOT rely on jax.grad in the
+training path; jax.grad serves as a test oracle instead — strictly stronger
+than the reference's finite-difference tests).
+
+TPU notes:
+- everything is fp32; matmuls default to ``precision=HIGHEST`` so the loss
+  trajectory is comparable float-for-float with a NumPy oracle. Callers that
+  want raw MXU throughput can pass ``precision='default'`` to use bf16-input
+  passes on the systolic array.
+- ops are shape-polymorphic and padding-safe: zero-padded rows/columns stay
+  exactly zero through linear/relu, and the softmax head takes an explicit
+  validity mask so padded logits contribute nothing. This is what lets the
+  SPMD pipeline executor run unequal stages as fixed-shape stacked params.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Matmul precision used across the framework. HIGHEST = fp32 accumulate with
+# full-precision inputs (required for NumPy-trajectory parity tests); callers
+# may override per-call.
+DEFAULT_PRECISION = lax.Precision.HIGHEST
+
+# Large-negative used to mask invalid logits. Not -inf: exp(-inf - -inf) would
+# produce NaN when a fully-masked row meets the global max subtraction.
+_NEG_MASK = -1e30
+
+
+def relu(x):
+    """max(x, 0). Reference: functional.py:4-5."""
+    return jnp.maximum(x, 0.0)
+
+
+def relu_grad(g, bitmask):
+    """VJP of relu given the cached activation bitmask (out > 0).
+
+    Reference: functional.py:8-10 (bitmask of the *input*; identical since the
+    reference computes the mask on the relu input and we compute it on the
+    pre-activation — same tensor).
+    """
+    return g * bitmask
+
+
+def linear(x, w, b, precision=DEFAULT_PRECISION):
+    """y = x @ w.T + b with w: (out, in), b: (1, out) or (out,).
+
+    Reference: functional.py:13-17.
+    """
+    return jnp.matmul(x, w.T, precision=precision) + jnp.reshape(b, (1, -1))
+
+
+def linear_grad(g, x, w, precision=DEFAULT_PRECISION):
+    """VJP of linear: returns (dx, dw, db) = (g @ w, g.T @ x, sum_rows(g)).
+
+    Reference: functional.py:20-21.
+    """
+    dx = jnp.matmul(g, w, precision=precision)
+    dw = jnp.matmul(g.T, x, precision=precision)
+    db = g.sum(axis=0)
+    return dx, dw, db
+
+
+def softmax(z, valid_mask=None):
+    """Row softmax with the reference's exact quirks (functional.py:24-27):
+
+    - the max subtracted for stability is the *global* max over the whole
+      array (not per-row),
+    - the denominator gets ``+ 1e-7``.
+
+    ``valid_mask`` (broadcastable to z, True = real logit) supports the padded
+    SPMD layout: masked positions get probability exactly 0 and do not affect
+    the max or the row sums.
+    """
+    if valid_mask is not None:
+        z = jnp.where(valid_mask, z, _NEG_MASK)
+    z_exp = jnp.exp(z - jnp.max(z))
+    return z_exp / (z_exp.sum(axis=1, keepdims=True) + 1e-7)
+
+
+def softmax_grad(g, z, valid_mask=None):
+    """VJP of softmax, recomputing the forward from the cached *input* z.
+
+    Recomputation instead of stashing the output is deliberate: on TPU the
+    extra exp/sum fuses into the backward and saves HBM traffic — and it is
+    also exactly what the reference does (functional.py:30-35).
+    """
+    out = softmax(z, valid_mask)
+    gz = out * g
+    return gz - out * gz.sum(axis=-1, keepdims=True)
+
+
+def mse_loss(p, t, batch_size):
+    """sum((t - p)^2) / batch_size. Reference: functional.py:38-40.
+
+    ``batch_size`` is the GLOBAL batch size: this single scaling is what makes
+    microbatch gradient accumulation + DP SUM-reduction reproduce the serial
+    full-batch gradient with no averaging anywhere (reference layers.py:160).
+    """
+    return ((t - p) ** 2).sum() / batch_size
+
+
+def mse_loss_grad(p, t, batch_size):
+    """dL/dp = -2 (t - p) / batch_size. Reference: functional.py:43-44."""
+    return -2.0 * (t - p) / batch_size
+
+
+@partial(jax.jit, static_argnames=("batch_size",))
+def softmax_mse_head_grad(z, t, batch_size, valid_mask=None):
+    """Fused loss-head backward: d(MSE(softmax(z), t))/dz.
+
+    The reference implements this as two chained Module backwards
+    (MSELoss layers.py:157-163 then Softmax layers.py:89-93); fused here so
+    XLA emits a single elementwise pipeline over the logits.
+    """
+    p = softmax(z, valid_mask)
+    g = mse_loss_grad(p, t, batch_size)
+    return softmax_grad(g, z, valid_mask)

@@ -1,0 +1,27 @@
+"""Optimizers over parameter pytrees, applied on-device inside the jitted step.
+
+Capability parity: the reference ships plain stateless SGD
+(/root/reference/shallowspeed/optimizer.py:4-13, ``param.data -= lr * grad``).
+Here the update is a pytree map that XLA fuses into the training step — no
+host round-trip per parameter.
+"""
+
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class SGD:
+    """Stateless SGD. ``apply`` returns new params; grads are SUMS over the
+    global batch (the loss is pre-scaled by the global batch size), so no
+    averaging happens here — same ledger as the reference."""
+
+    lr: float
+
+    def init(self, params):
+        return ()  # no optimizer state
+
+    def apply(self, params, grads, state=()):
+        new = jax.tree.map(lambda p, g: p - self.lr * g, params, grads)
+        return new, state

@@ -1,0 +1,108 @@
+"""Sequential (single-device) training path: jitted step with microbatch scan.
+
+Reference equivalent: running train.py with DP=1, PP=1, where the Worker
+interprets [ZeroGrad, {Load, Forward, Load, Backward} x M, OptimizerStep] per
+batch (/root/reference/shallowspeed/pipe.py:184-222 with one stage). Here the
+whole batch — M microbatch forward+backward passes with gradient accumulation,
+plus the SGD update — is ONE jitted XLA computation: the microbatch loop is a
+``lax.scan`` whose carry is the gradient pytree, and ``train_epoch`` scans that
+step over every batch of the epoch so an epoch is a single device program with
+no host round-trips.
+
+Gradient-correctness ledger (identical to the reference, SURVEY §3.3): the
+loss gradient is scaled once by the GLOBAL batch size; each Linear backward
+sums over its microbatch rows; the scan sums over microbatches; (under DP the
+executor psums over replicas). Three sums, no averaging — bitwise the same
+ledger as sequential full-batch training.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from shallowspeed_tpu import ops
+from shallowspeed_tpu.model import ModelSpec, model_backward, model_forward
+
+
+def _make_batch_step(spec: ModelSpec, opt, precision):
+    """The shared per-batch body: microbatch-scan gradient accumulation +
+    optimizer apply. Used by both the per-batch step and the epoch scan."""
+
+    def batch_step(params, opt_state, xb, yb):
+        def accumulate(acc, mxy):
+            x, y = mxy
+            _, res = model_forward(params, spec, x, precision=precision)
+            _, grads = model_backward(params, spec, res, y, precision=precision)
+            return jax.tree.map(jnp.add, acc, grads), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        grads, _ = lax.scan(accumulate, zeros, (xb, yb))
+        return opt.apply(params, grads, opt_state)
+
+    return batch_step
+
+
+def make_train_step(spec: ModelSpec, opt, precision=ops.DEFAULT_PRECISION):
+    """Returns jitted ``step(params, opt_state, xb, yb) -> (params, opt_state)``.
+
+    ``xb``: (M, mubatch, in_dim); ``yb``: (M, mubatch, out_dim) one-hot.
+    """
+    batch_step = _make_batch_step(spec, opt, precision)
+    return jax.jit(batch_step, donate_argnums=(0, 1))
+
+
+def make_train_epoch(spec: ModelSpec, opt, precision=ops.DEFAULT_PRECISION):
+    """Whole-epoch scan: ``epoch(params, opt_state, X, Y)`` with
+    X: (num_batches, M, mubatch, in_dim). One XLA program per epoch."""
+    batch_step = _make_batch_step(spec, opt, precision)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def epoch(params, opt_state, X, Y):
+        def body(carry, xy):
+            new = batch_step(*carry, *xy)
+            return new, None
+
+        (params, opt_state), _ = lax.scan(body, (params, opt_state), (X, Y))
+        return params, opt_state
+
+    return epoch
+
+
+def make_predict(spec: ModelSpec, precision=ops.DEFAULT_PRECISION):
+    """Jitted inference: softmax predictions for a (batch, in_dim) array."""
+
+    @jax.jit
+    def predict(params, x):
+        out, _ = model_forward(params, spec, x, precision=precision)
+        return out
+
+    return predict
+
+
+def make_loss_fn(spec: ModelSpec, precision=ops.DEFAULT_PRECISION):
+    """Monitoring-only loss (the reference never computes the training loss,
+    layers.py:150-155; we expose it as an opt-in observability feature)."""
+
+    @jax.jit
+    def loss_fn(params, x, y):
+        out, _ = model_forward(params, spec, x, precision=precision)
+        return ops.mse_loss(out, y, spec.global_batch_size)
+
+    return loss_fn
+
+
+def accuracy(predict, params, X, Y, batch_size=1024):
+    """Host-side argmax accuracy over a full split (reference train.py:21-47).
+
+    Evaluates every sample: the ragged tail chunk runs at its natural size
+    (it only triggers one extra XLA specialization).
+    """
+    correct = total = 0
+    for i in range(0, len(X), batch_size):
+        xb, yb = X[i : i + batch_size], Y[i : i + batch_size]
+        preds = predict(params, xb)
+        correct += int((jnp.argmax(preds, axis=1) == jnp.argmax(yb, axis=1)).sum())
+        total += len(xb)
+    return correct / max(total, 1)

@@ -1,0 +1,26 @@
+"""Test config: force an 8-device virtual CPU platform BEFORE jax imports.
+
+This is how the reference's biggest testing gap (no distributed tests at all,
+SURVEY §4) gets closed without a TPU pod: every DP/PP layout runs SPMD on
+8 emulated host devices, so mesh/collective code paths are exercised for real.
+"""
+
+import os
+
+# Keep the TPU tunnel plugin (axon) completely out of CPU test runs: its
+# sitecustomize registration (gated on PALLAS_AXON_POOL_IPS) would dial the
+# single-client TPU tunnel at backend init and serialize/hang parallel CPU
+# processes.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (after env setup, before any test imports)
+
+# If the plugin registered at interpreter startup it may have forced
+# jax_platforms='axon,cpu'; pin it back so backends() never dials the tunnel.
+jax.config.update("jax_platforms", "cpu")

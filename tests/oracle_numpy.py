@@ -1,0 +1,63 @@
+"""A tiny, freshly-written NumPy training oracle for parity tests.
+
+Implements the same math contract as the framework (MLP with fused
+linear+relu layers, softmax + MSE head with global-batch loss scaling,
+microbatch gradient accumulation, SGD) in plain NumPy, so the JAX path can be
+checked against an independent CPU implementation float-for-float (within
+reassociation tolerance). This plays the role the reference's NumPy engine
+plays for its own equivalence story — written from the math, not copied.
+"""
+
+import numpy as np
+
+from shallowspeed_tpu.init import linear_init
+
+
+def init_params(sizes):
+    return [linear_init(sizes[i], sizes[i + 1]) for i in range(len(sizes) - 1)]
+
+
+def forward(params, x):
+    """Returns (softmax_probs, caches). Last linear has no relu."""
+    caches = []
+    n = len(params)
+    for i, (w, b) in enumerate(params):
+        z = x @ w.T + b
+        if i < n - 1:
+            caches.append((x, z > 0))
+            x = np.maximum(z, 0.0)
+        else:
+            caches.append((x, None))
+            x = z
+    z_exp = np.exp(x - np.max(x))
+    probs = z_exp / (z_exp.sum(axis=1, keepdims=True) + 1e-7)
+    return probs, (caches, x)
+
+
+def backward(params, caches_z, probs, target, global_batch):
+    caches, z = caches_z
+    g = -2.0 * (target - probs) / global_batch  # d mse / d probs
+    gz = probs * g  # softmax VJP (recompute style)
+    g = gz - probs * gz.sum(axis=1, keepdims=True)
+    grads = [None] * len(params)
+    for i in reversed(range(len(params))):
+        x_in, mask = caches[i]
+        if mask is not None:
+            g = g * mask
+        w, _ = params[i]
+        grads[i] = (g.T @ x_in, g.sum(axis=0, keepdims=True))
+        g = g @ w
+    return grads
+
+
+def train_step(params, xb, yb, lr, global_batch):
+    """One batch: accumulate grads over microbatches (leading axis), SGD."""
+    acc = [(np.zeros_like(w), np.zeros_like(b)) for w, b in params]
+    for x, y in zip(xb, yb):
+        probs, caches_z = forward(params, x)
+        grads = backward(params, caches_z, probs, y, global_batch)
+        acc = [(aw + gw, ab + gb) for (aw, ab), (gw, gb) in zip(acc, grads)]
+    return [
+        ((w - lr * gw).astype(np.float32), (b - lr * gb).astype(np.float32))
+        for (w, b), (gw, gb) in zip(params, acc)
+    ]

@@ -1,0 +1,70 @@
+"""Data-layer tests: DP shard arithmetic, microbatch slicing, epoch arrays.
+
+Unlike the reference's tests/test_dataset.py (which requires a downloaded
+MNIST), these generate a tiny deterministic dataset in tmp_path.
+"""
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu.data import Dataset
+
+N, DIM, CLASSES = 1000, 12, 10
+GBS = 64
+
+
+@pytest.fixture()
+def data_dir(tmp_path):
+    rng = np.random.RandomState(0)
+    for suffix, n in (("train", N), ("val", 200)):
+        x = rng.randn(n, DIM).astype(np.float32)
+        y = np.eye(CLASSES, dtype=np.float32)[rng.randint(0, CLASSES, n)]
+        np.save(tmp_path / f"x_{suffix}.npy", x)
+        np.save(tmp_path / f"y_{suffix}.npy", y)
+    return tmp_path
+
+
+def test_drop_last_and_strided_shard(data_dir):
+    ds = Dataset(data_dir, GBS, mubatch_size=16)
+    ds.load(DP_rank=1, DP_size=2)
+    full = (N // GBS) * GBS  # 960
+    assert len(ds) == full // 2
+    raw = np.load(data_dir / "x_train.npy")
+    np.testing.assert_array_equal(ds.input_X, raw[1:full:2])
+
+
+def test_shards_partition_the_data(data_dir):
+    shards = []
+    for r in range(4):
+        ds = Dataset(data_dir, GBS, mubatch_size=4)
+        ds.load(r, 4)
+        shards.append(ds.input_X)
+    raw = np.load(data_dir / "x_train.npy")[: (N // GBS) * GBS]
+    recon = np.empty_like(raw)
+    for r in range(4):
+        recon[r::4] = shards[r]
+    np.testing.assert_array_equal(recon, raw)
+
+
+def test_mubatch_slicing_matches_epoch_arrays(data_dir):
+    ds = Dataset(data_dir, GBS, mubatch_size=16)
+    ds.load(0, 1)
+    X, Y = ds.epoch_arrays()
+    assert X.shape == (N // GBS, 4, 16, DIM)
+    for b in (0, 3):
+        for m in range(4):
+            np.testing.assert_array_equal(X[b, m], ds.load_micro_batch_input(b, m))
+            np.testing.assert_array_equal(Y[b, m], ds.load_micro_batch_target(b, m))
+
+
+def test_divisibility_errors(data_dir):
+    with pytest.raises(ValueError):
+        Dataset(data_dir, GBS, mubatch_size=16).load(0, 3)  # 64 % 3 != 0
+    with pytest.raises(ValueError):
+        Dataset(data_dir, GBS, mubatch_size=7).load(0, 1)  # 7 ∤ 64
+
+
+def test_validation_split(data_dir):
+    ds = Dataset(data_dir, GBS, mubatch_size=GBS, validation=True)
+    ds.load(0, 1)
+    assert len(ds) == (200 // GBS) * GBS

@@ -1,0 +1,133 @@
+"""Model-layer tests: partitioning, layout-independent init, VJP correctness.
+
+Mirrors the reference's tests/test_layers.py (param counts, stage
+partitioning, end-to-end fwd+bwd) with jax.grad as the gradient oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shallowspeed_tpu import model as M
+from shallowspeed_tpu import ops
+
+SIZES = (784, 128, 127, 126, 125, 124, 123, 10)  # flagship model (train.py:98)
+
+
+def device(params_list):
+    return jax.tree.map(jnp.asarray, params_list)
+
+
+class TestPartitioning:
+    def test_stage_slices_overlap_boundary(self):
+        # same semantics the reference asserts in tests/test_layers.py:52-70
+        parts = M.partition_sizes(list(range(9)), 3)
+        assert parts == [(0, 1, 2, 3), (3, 4, 5, 6), (6, 7, 8)]
+
+    def test_uneven_flagship_stages(self):
+        spec = M.make_model_spec(SIZES, 4, 128)
+        n_lin = [s.n_linears for s in spec.stages]
+        assert n_lin == [2, 2, 2, 1]  # stages are deliberately unequal
+        assert spec.stages[-1].has_head
+        # last Linear of last stage has no fused relu; all others do
+        assert spec.stages[-1].relu_flags == (False,)
+        assert all(all(s.relu_flags) for s in spec.stages[:-1])
+
+    def test_zero_linear_trailing_stage(self):
+        spec = M.make_model_spec(SIZES, 8, 128)
+        assert spec.stages[-1].n_linears == 0
+        assert spec.stages[-1].has_head
+
+    def test_in_out_dims(self):
+        spec = M.make_model_spec(SIZES, 4, 128)
+        assert [s.in_dim for s in spec.stages] == [784, 127, 125, 123]
+        assert [s.out_dim for s in spec.stages] == [127, 125, 123, 10]
+
+
+class TestInit:
+    def test_layout_independent(self):
+        """Partitioning must not change the initial weights (layers.py:103-106)."""
+        seq = M.init_model(M.make_model_spec(SIZES, 1, 128))
+        pp4 = M.init_model(M.make_model_spec(SIZES, 4, 128))
+        flat_seq = [l for s in seq for l in s]
+        flat_pp4 = [l for s in pp4 for l in s]
+        assert len(flat_seq) == len(flat_pp4) == 7
+        for a, b in zip(flat_seq, flat_pp4):
+            np.testing.assert_array_equal(a["W"], b["W"])
+            np.testing.assert_array_equal(a["b"], b["b"])
+
+    def test_deterministic(self):
+        a = M.init_model(M.make_model_spec(SIZES, 2, 128))
+        b = M.init_model(M.make_model_spec(SIZES, 2, 128))
+        for sa, sb in zip(a, b):
+            for la, lb in zip(sa, sb):
+                np.testing.assert_array_equal(la["W"], lb["W"])
+
+    def test_scale(self):
+        spec = M.make_model_spec((784, 128), 1, 128)
+        w = M.init_model(spec)[0][0]["W"]
+        assert w.shape == (128, 784)
+        assert abs(float(np.std(w)) - 1 / np.sqrt(784)) < 0.005
+
+
+class TestForwardBackward:
+    def test_forward_is_softmax_distribution(self):
+        spec = M.make_model_spec((20, 16, 10), 1, 32)
+        params = device(M.init_model(spec))
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 20), jnp.float32)
+        out, _ = M.model_forward(params, spec, x)
+        np.testing.assert_allclose(np.asarray(out).sum(1), 1.0, atol=1e-4)
+
+    def test_backward_matches_jax_grad(self):
+        spec = M.make_model_spec((12, 16, 14, 10), 1, 32)
+        params = device(M.init_model(spec))
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(8, 12), jnp.float32)
+        t = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)])
+
+        def loss(params):
+            out, _ = M.model_forward(params, spec, x)
+            return ops.mse_loss(out, t, 32)
+
+        want = jax.grad(loss)(params)
+        _, res = M.model_forward(params, spec, x)
+        _, got = M.model_backward(params, spec, res, t)
+        jax.tree.map(
+            lambda g, w: np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-6),
+            got,
+            want,
+        )
+
+    def test_staged_equals_sequential(self):
+        """Chaining PP=4 stages == one-stage full model, float-for-float."""
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(8, 784), jnp.float32)
+        t = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)])
+        outs, grads = [], []
+        for n_stages in (1, 4):
+            spec = M.make_model_spec(SIZES, n_stages, 128)
+            params = device(M.init_model(spec))
+            out, res = M.model_forward(params, spec, x)
+            _, g = M.model_backward(params, spec, res, t)
+            outs.append(np.asarray(out))
+            grads.append([l for s in g for l in s])
+        np.testing.assert_array_equal(outs[0], outs[1])
+        for a, b in zip(*grads):
+            np.testing.assert_array_equal(a["W"], b["W"])
+            np.testing.assert_array_equal(a["b"], b["b"])
+
+    def test_backward_input_grad_matches_jax(self):
+        spec = M.make_model_spec((12, 10), 1, 16)
+        params = device(M.init_model(spec))
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(4, 12), jnp.float32)
+        t = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, 4)])
+
+        def loss(x):
+            out, _ = M.model_forward(params, spec, x)
+            return ops.mse_loss(out, t, 16)
+
+        want = jax.grad(loss)(x)
+        _, res = M.model_forward(params, spec, x)
+        dx, _ = M.model_backward(params, spec, res, t)
+        np.testing.assert_allclose(dx, want, rtol=1e-4, atol=1e-6)

@@ -1,0 +1,157 @@
+"""Schedule-stream tests: structural properties + happens-before predicates.
+
+Covers what the reference's tests/test_schedules.py covers, plus the
+happens-before checks its header TODO wished for (reference
+tests/test_schedules.py:4-10) — e.g. GPipe: last forward strictly before
+first backward; PipeDream-Flush: at most min(M, depth-stage) forwards in
+flight.
+"""
+
+import pytest
+
+from shallowspeed_tpu import schedules as S
+
+
+def flat(sched):
+    return S.flat_commands(sched)
+
+
+def types(cmds):
+    return [type(c) for c in cmds]
+
+
+ALL_TRAIN = [S.NaiveParallelSchedule, S.GPipeSchedule, S.PipeDreamFlushSchedule]
+
+
+@pytest.mark.parametrize("cls", ALL_TRAIN)
+@pytest.mark.parametrize("stages,stage", [(1, 0), (4, 0), (4, 2), (4, 3)])
+def test_batch_bracketing(cls, stages, stage):
+    cmds = flat(cls(num_micro_batches=4, num_stages=stages, stage_id=stage))
+    assert isinstance(cmds[0], S.ZeroGrad)
+    assert isinstance(cmds[-1], S.OptimizerStep)
+    assert sum(isinstance(c, S.ZeroGrad) for c in cmds) == 1
+    assert sum(isinstance(c, S.OptimizerStep) for c in cmds) == 1
+
+
+@pytest.mark.parametrize("cls", ALL_TRAIN)
+@pytest.mark.parametrize("stages,stage", [(1, 0), (4, 0), (4, 1), (4, 3)])
+def test_every_mubatch_forward_and_backward_once(cls, stages, stage):
+    M = 4
+    cmds = flat(cls(num_micro_batches=M, num_stages=stages, stage_id=stage))
+    fwd = [c.mubatch_id for c in cmds if isinstance(c, S.Forward)]
+    bwd = [
+        c.mubatch_id
+        for c in cmds
+        if isinstance(c, (S.BackwardGradAcc, S.BackwardGradAllReduce))
+    ]
+    assert sorted(fwd) == list(range(M))
+    assert sorted(bwd) == list(range(M))
+
+
+@pytest.mark.parametrize("cls", ALL_TRAIN)
+def test_allreduce_exactly_once_and_on_final_backward(cls):
+    """BackwardGradAllReduce marks the LAST executed backward of the batch —
+    that is where the DP psum is anchored (reference pipe.py:108-122)."""
+    cmds = flat(cls(num_micro_batches=4, num_stages=4, stage_id=1))
+    ar = [i for i, c in enumerate(cmds) if isinstance(c, S.BackwardGradAllReduce)]
+    bwd = [
+        i
+        for i, c in enumerate(cmds)
+        if isinstance(c, (S.BackwardGradAcc, S.BackwardGradAllReduce))
+    ]
+    assert len(ar) == 1
+    assert ar[0] == bwd[-1]
+
+
+@pytest.mark.parametrize("cls", ALL_TRAIN)
+@pytest.mark.parametrize("stage", [0, 1, 3])
+def test_io_roles_by_stage(cls, stage):
+    cmds = flat(cls(num_micro_batches=4, num_stages=4, stage_id=stage))
+    has = lambda t: any(isinstance(c, t) for c in cmds)
+    assert has(S.LoadMuBatchInput) == (stage == 0)
+    assert has(S.RecvActivations) == (stage != 0)
+    assert has(S.LoadMuBatchTarget) == (stage == 3)
+    assert has(S.RecvOutputGrad) == (stage != 3)
+    assert has(S.SendActivations) == (stage != 3)
+    assert has(S.SendInputGrad) == (stage != 0)
+
+
+def _pos(cmds, pred):
+    return [i for i, c in enumerate(cmds) if pred(c)]
+
+
+def test_gpipe_happens_before_all_fwd_before_any_bwd():
+    for stage in range(4):
+        cmds = flat(S.GPipeSchedule(num_micro_batches=4, num_stages=4, stage_id=stage))
+        last_fwd = max(_pos(cmds, lambda c: isinstance(c, S.Forward)))
+        first_bwd = min(
+            _pos(cmds, lambda c: isinstance(c, (S.BackwardGradAcc, S.BackwardGradAllReduce)))
+        )
+        assert last_fwd < first_bwd
+
+
+def test_gpipe_backward_order_reversed():
+    cmds = flat(S.GPipeSchedule(num_micro_batches=4, num_stages=2, stage_id=1))
+    bwd = [
+        c.mubatch_id
+        for c in cmds
+        if isinstance(c, (S.BackwardGradAcc, S.BackwardGradAllReduce))
+    ]
+    assert bwd == [3, 2, 1, 0]
+
+
+def test_naive_one_mubatch_fully_before_next():
+    cmds = flat(S.NaiveParallelSchedule(num_micro_batches=3, num_stages=2, stage_id=0))
+    events = [
+        (c.mubatch_id, isinstance(c, S.Forward))
+        for c in cmds
+        if isinstance(c, (S.Forward, S.BackwardGradAcc, S.BackwardGradAllReduce))
+    ]
+    assert events == [(0, True), (0, False), (1, True), (1, False), (2, True), (2, False)]
+
+
+class TestPipeDreamFlush:
+    def test_backward_order_is_fifo(self):
+        cmds = flat(S.PipeDreamFlushSchedule(num_micro_batches=4, num_stages=4, stage_id=1))
+        bwd = [
+            c.mubatch_id
+            for c in cmds
+            if isinstance(c, (S.BackwardGradAcc, S.BackwardGradAllReduce))
+        ]
+        assert bwd == [0, 1, 2, 3]
+
+    @pytest.mark.parametrize("stage", range(4))
+    def test_activation_memory_bound(self, stage):
+        """In-flight forwards (fwd done, bwd not yet) never exceed
+        min(M, depth - stage) — the 1F1B memory property."""
+        M, depth = 8, 4
+        cmds = flat(
+            S.PipeDreamFlushSchedule(num_micro_batches=M, num_stages=depth, stage_id=stage)
+        )
+        in_flight = peak = 0
+        for c in cmds:
+            if isinstance(c, S.Forward):
+                in_flight += 1
+            elif isinstance(c, (S.BackwardGradAcc, S.BackwardGradAllReduce)):
+                in_flight -= 1
+            peak = max(peak, in_flight)
+        assert peak <= min(M, depth - stage)
+
+    def test_last_stage_strictly_alternates(self):
+        cmds = flat(S.PipeDreamFlushSchedule(num_micro_batches=4, num_stages=4, stage_id=3))
+        compute = [
+            isinstance(c, S.Forward)
+            for c in cmds
+            if isinstance(c, (S.Forward, S.BackwardGradAcc, S.BackwardGradAllReduce))
+        ]
+        assert compute == [True, False] * 4
+
+
+def test_inference_forward_only():
+    for stage in range(3):
+        cmds = flat(S.InferenceSchedule(num_micro_batches=2, num_stages=3, stage_id=stage))
+        assert not any(
+            isinstance(c, (S.BackwardGradAcc, S.BackwardGradAllReduce, S.ZeroGrad, S.OptimizerStep))
+            for c in cmds
+        )
+        assert sum(isinstance(c, S.Forward) for c in cmds) == 2

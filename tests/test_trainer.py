@@ -1,0 +1,123 @@
+"""Sequential-trainer tests: NumPy-oracle parity + microbatch-count invariance.
+
+The reference's whole correctness story is "distributed == sequential ==
+full-batch" (SURVEY §3.3). Here: the jitted JAX step must match an independent
+NumPy implementation step-for-step, and the result must be invariant to how
+the batch is sliced into microbatches.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shallowspeed_tpu import model as M
+from shallowspeed_tpu.optimizer import SGD
+from shallowspeed_tpu import trainer
+
+import oracle_numpy
+
+SIZES = (20, 16, 12, 10)
+B = 32
+LR = 0.006
+
+
+def _data(num_batches, mubatches, rng):
+    mb = B // mubatches
+    X = rng.randn(num_batches, mubatches, mb, SIZES[0]).astype(np.float32)
+    Y = np.eye(SIZES[-1], dtype=np.float32)[
+        rng.randint(0, SIZES[-1], (num_batches, mubatches, mb))
+    ]
+    return X, Y
+
+
+def _flat(params_list):
+    return [(np.asarray(l["W"]), np.asarray(l["b"])) for s in params_list for l in s]
+
+
+def test_matches_numpy_oracle_over_steps():
+    spec = M.make_model_spec(SIZES, 1, B)
+    params = jax.tree.map(jnp.asarray, M.init_model(spec))
+    step = trainer.make_train_step(spec, SGD(LR))
+    opt_state = ()
+
+    oracle = oracle_numpy.init_params(SIZES)
+    rng = np.random.RandomState(0)
+    X, Y = _data(5, 4, rng)
+    for b in range(5):
+        params, opt_state = step(params, opt_state, jnp.asarray(X[b]), jnp.asarray(Y[b]))
+        oracle = oracle_numpy.train_step(oracle, X[b], Y[b], LR, B)
+    for (jw, jb), (ow, ob) in zip(_flat(params), oracle):
+        np.testing.assert_allclose(jw, ow, rtol=2e-4, atol=2e-6)
+        np.testing.assert_allclose(jb, ob, rtol=2e-4, atol=2e-6)
+
+
+def test_mubatch_count_invariance():
+    """Training with M=1, 2, 4 microbatches must give (nearly) identical
+    weights — the global-batch loss scaling + sum accumulation ledger."""
+    rng = np.random.RandomState(1)
+    Xflat, _ = _data(3, 1, rng)
+    Yflat = np.eye(SIZES[-1], dtype=np.float32)[
+        rng.randint(0, SIZES[-1], (3, 1, B))
+    ]
+    results = []
+    for m in (1, 2, 4):
+        spec = M.make_model_spec(SIZES, 1, B)
+        params = jax.tree.map(jnp.asarray, M.init_model(spec))
+        step = trainer.make_train_step(spec, SGD(LR))
+        opt_state = ()
+        X = Xflat.reshape(3, m, B // m, SIZES[0])
+        Y = Yflat.reshape(3, m, B // m, SIZES[-1])
+        for b in range(3):
+            params, opt_state = step(
+                params, opt_state, jnp.asarray(X[b]), jnp.asarray(Y[b])
+            )
+        results.append(_flat(params))
+    for other in results[1:]:
+        for (w0, b0), (w1, b1) in zip(results[0], other):
+            np.testing.assert_allclose(w0, w1, rtol=1e-5, atol=1e-7)
+
+
+def test_epoch_scan_matches_per_batch_steps():
+    spec = M.make_model_spec(SIZES, 1, B)
+    rng = np.random.RandomState(2)
+    X, Y = _data(4, 4, rng)
+
+    params = jax.tree.map(jnp.asarray, M.init_model(spec))
+    step = trainer.make_train_step(spec, SGD(LR))
+    st = ()
+    for b in range(4):
+        params, st = step(params, st, jnp.asarray(X[b]), jnp.asarray(Y[b]))
+
+    params2 = jax.tree.map(jnp.asarray, M.init_model(spec))
+    epoch = trainer.make_train_epoch(spec, SGD(LR))
+    params2, _ = epoch(params2, (), jnp.asarray(X), jnp.asarray(Y))
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+        params,
+        params2,
+    )
+
+
+def test_training_learns_separable_data():
+    spec = M.make_model_spec((8, 16, 10), 1, B)
+    params = jax.tree.map(jnp.asarray, M.init_model(spec))
+    rng = np.random.RandomState(3)
+    labels = rng.randint(0, 10, 512)
+    centers = rng.randn(10, 8).astype(np.float32) * 2
+    Xall = (centers[labels] + rng.randn(512, 8).astype(np.float32) * 0.1)
+    Yall = np.eye(10, dtype=np.float32)[labels]
+    loss_fn = trainer.make_loss_fn(spec)
+    step = trainer.make_train_step(spec, SGD(0.05))
+    before = float(loss_fn(params, jnp.asarray(Xall[:B]), jnp.asarray(Yall[:B])))
+    st = ()
+    for epoch in range(30):
+        for i in range(0, 512, B):
+            xb = Xall[i : i + B].reshape(4, B // 4, 8)
+            yb = Yall[i : i + B].reshape(4, B // 4, 10)
+            params, st = step(params, st, jnp.asarray(xb), jnp.asarray(yb))
+    after = float(loss_fn(params, jnp.asarray(Xall[:B]), jnp.asarray(Yall[:B])))
+    assert after < before * 0.5
+    predict = trainer.make_predict(spec)
+    acc = trainer.accuracy(predict, params, jnp.asarray(Xall), jnp.asarray(Yall), 256)
+    assert acc > 0.9
