@@ -1,0 +1,121 @@
+"""Benchmark: MNIST-MLP training samples/sec/chip vs the NumPy reference.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "samples/s", "vs_baseline": N}
+
+Protocol (BASELINE.md: the reference publishes no numbers, so the baseline is
+measured here): train the flagship 7-layer MLP (sizes [784,128,...,10],
+GLOBAL_BATCH=128, 4 microbatches, SGD lr=0.006) on MNIST-sized data and
+report end-to-end training throughput.
+
+- baseline: an independent NumPy implementation of the identical training
+  step (microbatch grad accumulation, global-batch loss scaling) timed on
+  this host's CPU — the reference's compute engine (NumPy+BLAS) doing the
+  reference's exact work.
+- value: this framework's jitted whole-epoch lax.scan on the default JAX
+  device (the TPU chip when run by the driver).
+- vs_baseline: value / baseline  (>1 = faster than the NumPy reference).
+"""
+
+import json
+import time
+
+import numpy as np
+
+SIZES = (784, 128, 127, 126, 125, 124, 123, 10)
+B, M, LR = 128, 4, 0.006
+N_SAMPLES = 59392  # MNIST train size after drop-last to 128-multiples
+
+
+def numpy_baseline_sps(n_batches=40):
+    """Fresh NumPy training step (reference-equivalent math), timed."""
+    from shallowspeed_tpu.init import linear_init
+
+    params = [linear_init(SIZES[i], SIZES[i + 1]) for i in range(len(SIZES) - 1)]
+    rng = np.random.RandomState(0)
+    xb = rng.randn(M, B // M, SIZES[0]).astype(np.float32)
+    yb = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (M, B // M))]
+
+    def train_batch(params):
+        acc = [(np.zeros_like(w), np.zeros_like(b)) for w, b in params]
+        n = len(params)
+        for x, t in zip(xb, yb):
+            caches = []
+            for i, (w, b) in enumerate(params):
+                z = x @ w.T + b
+                if i < n - 1:
+                    caches.append((x, z > 0))
+                    x = np.maximum(z, 0.0)
+                else:
+                    caches.append((x, None))
+                    x = z
+            ze = np.exp(x - np.max(x))
+            p = ze / (ze.sum(axis=1, keepdims=True) + 1e-7)
+            g = -2.0 * (t - p) / B
+            gz = p * g
+            g = gz - p * gz.sum(axis=1, keepdims=True)
+            for i in reversed(range(n)):
+                xi, mask = caches[i]
+                if mask is not None:
+                    g = g * mask
+                acc[i] = (acc[i][0] + g.T @ xi, acc[i][1] + g.sum(0, keepdims=True))
+                g = g @ params[i][0]
+        return [
+            (w - LR * gw, b - LR * gb) for (w, b), (gw, gb) in zip(params, acc)
+        ]
+
+    params = train_batch(params)  # warm BLAS
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        params = train_batch(params)
+    dt = time.perf_counter() - t0
+    return n_batches * B / dt
+
+
+def jax_sps(n_epochs=5):
+    import jax
+    import jax.numpy as jnp
+
+    from shallowspeed_tpu import model as Mo
+    from shallowspeed_tpu import trainer
+    from shallowspeed_tpu.optimizer import SGD
+
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+    epoch = trainer.make_train_epoch(spec, SGD(LR))
+
+    nb = N_SAMPLES // B
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.rand(nb, M, B // M, SIZES[0]).astype(np.float32))
+    Y = jnp.asarray(
+        np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, M, B // M))]
+    )
+
+    state = ()
+    params, state = epoch(params, state, X, Y)  # compile + warmup
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(n_epochs):
+        params, state = epoch(params, state, X, Y)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    return n_epochs * nb * B / dt
+
+
+def main():
+    baseline = numpy_baseline_sps()
+    value = jax_sps()
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_mlp_train_samples_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "samples/s",
+                "vs_baseline": round(value / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""TPU execution layer: mesh construction, schedule lowering, SPMD executor.
+
+The reference drives its pipeline MPMD-style — each MPI rank interprets a
+different instruction stream against blocking Send/Recv
+(/root/reference/shallowspeed/pipe.py:330-466). XLA/jit is SPMD: one traced
+program for all devices. The bridge is this package:
+
+- ``lowering``  compiles the per-stage instruction streams of any Schedule
+                into a static *clock-tick program* (numpy tables) where every
+                tick every stage runs the same jitted tick function and
+                payloads move between neighbor stages via jax.lax.ppermute;
+- ``mesh``      builds the 2-D (dp, pp) jax.sharding.Mesh that replaces the
+                reference's two MPI communicators (train.py:87-94);
+- ``executor``  the shard_map + lax.scan runtime executing tick programs over
+                padded stacked stage parameters, with jax.lax.psum as the DP
+                gradient all-reduce.
+"""
+
+from shallowspeed_tpu.parallel.lowering import TickProgram, lower_schedule
+from shallowspeed_tpu.parallel.mesh import make_mesh

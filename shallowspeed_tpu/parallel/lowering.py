@@ -1,0 +1,322 @@
+"""Schedule -> clock-tick lowering: the MPMD-to-SPMD compiler.
+
+The reference executes pipeline schedules MPMD: each rank interprets ITS
+instruction stream, synchronizing implicitly through blocking MPI Send/Recv
+(pipe.py:330-466). Under jit/shard_map every device must run the SAME traced
+program, so this module compiles the per-stage instruction streams into a
+static *clock-tick program*: numpy tables, indexed [tick, stage], saying what
+each stage computes, which mailbox slot it reads, whether it emits a payload,
+and where arriving payloads are stored. The executor then runs one jitted
+tick function under ``lax.scan``; ``jax.lax.ppermute`` moves payloads between
+neighbor stages each tick (pipeline bubbles become masked no-op ticks —
+exactly the blank cells of the reference's pebble graph, README.md:41).
+
+The lowering is schedule-agnostic: any Schedule whose streams obey the
+contract (one compute per step-group, sends attached to the producing
+compute, recvs attached to the consuming compute) lowers automatically —
+naive, GPipe, PipeDream-Flush and Inference all go through this one path.
+
+Timing model (matches the executor's tick loop):
+- a payload sent at tick t is delivered into the receiver's mailbox at the
+  end of tick t and is consumable from tick t+1;
+- each stage executes at most ONE compute item (forward or backward of one
+  microbatch) per tick;
+- a send always occurs in the same tick as the compute that produced it.
+
+The simulator is also a verifier: it detects deadlocks, unmatched
+sends/recvs, mailbox overflows and missing/duplicate microbatch work, so a
+buggy schedule fails at lowering time with a readable error instead of
+hanging a TPU collective.
+"""
+
+import dataclasses
+
+import numpy as np
+
+from shallowspeed_tpu import schedules as S
+
+# op codes in the tick tables
+OP_NOOP, OP_FWD, OP_BWD = 0, 1, 2
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkItem:
+    """One compute event parsed from a stage's instruction stream."""
+
+    kind: int  # OP_FWD | OP_BWD
+    mubatch_id: int
+    needs_fwd_msg: bool = False  # consumes activations from stage-1
+    needs_bwd_msg: bool = False  # consumes output-grad from stage+1
+    sends_fwd: bool = False  # emits activations to stage+1
+    sends_bwd: bool = False  # emits input-grad to stage-1
+    allreduce: bool = False  # this backward anchors the DP all-reduce
+
+
+@dataclasses.dataclass(frozen=True)
+class TickProgram:
+    """Static SPMD program: everything the executor's scan body indexes."""
+
+    num_ticks: int
+    num_stages: int
+    num_micro_batches: int
+    n_fwd_slots: int  # mailbox depths (trash slot = index n_slots)
+    n_bwd_slots: int
+    is_training: bool
+    op: np.ndarray  # (T, S) int32: OP_NOOP/FWD/BWD
+    mb: np.ndarray  # (T, S) int32: microbatch id, trash = M
+    read_fwd_slot: np.ndarray  # (T, S) int32: fwd-mail slot consumed, trash = K_f
+    read_bwd_slot: np.ndarray  # (T, S) int32: bwd-mail slot consumed, trash = K_b
+    in_fwd_slot: np.ndarray  # (T, S) int32: slot storing payload arriving from s-1
+    in_bwd_slot: np.ndarray  # (T, S) int32: slot storing payload arriving from s+1
+    send_fwd: np.ndarray  # (T, S) int32 0/1: emit fwd payload this tick
+    send_bwd: np.ndarray  # (T, S) int32 0/1: emit bwd payload this tick
+
+
+class ScheduleLoweringError(ValueError):
+    pass
+
+
+def parse_stage_stream(commands, stage_id, num_stages, training=True):
+    """Flatten one stage's instruction stream into WorkItems + validate.
+
+    Recv/Load instructions bind to the NEXT compute; Send instructions bind
+    to the PREVIOUS compute — the same dataflow the reference Worker's buffer
+    semantics imply (pipe.py:355-406: recv fills the buffer the next
+    forward/backward reads; send ships the buffer the last compute wrote).
+    """
+    items = []
+    pend_fwd_msg = pend_bwd_msg = False
+    seen_zero = seen_opt = False
+    for cmd in commands:
+        if isinstance(cmd, S.ZeroGrad):
+            if items or seen_zero:
+                raise ScheduleLoweringError("ZeroGrad must be the first instruction")
+            seen_zero = True
+        elif isinstance(cmd, S.OptimizerStep):
+            if seen_opt:
+                raise ScheduleLoweringError("duplicate OptimizerStep")
+            seen_opt = True
+        elif isinstance(cmd, S.RecvActivations):
+            if stage_id == 0:
+                raise ScheduleLoweringError("stage 0 cannot RecvActivations")
+            if pend_fwd_msg:
+                raise ScheduleLoweringError("two RecvActivations before a Forward")
+            pend_fwd_msg = True
+        elif isinstance(cmd, S.RecvOutputGrad):
+            if stage_id == num_stages - 1:
+                raise ScheduleLoweringError("last stage cannot RecvOutputGrad")
+            if pend_bwd_msg:
+                raise ScheduleLoweringError("two RecvOutputGrads before a Backward")
+            pend_bwd_msg = True
+        elif isinstance(cmd, S.LoadMuBatchInput):
+            if stage_id != 0:
+                raise ScheduleLoweringError("only stage 0 loads inputs")
+        elif isinstance(cmd, S.LoadMuBatchTarget):
+            if stage_id != num_stages - 1:
+                raise ScheduleLoweringError("only the last stage loads targets")
+        elif isinstance(cmd, S.Forward):
+            if seen_opt:
+                raise ScheduleLoweringError("compute after OptimizerStep")
+            if pend_bwd_msg:
+                raise ScheduleLoweringError("RecvOutputGrad not consumed by a Backward")
+            items.append(
+                WorkItem(OP_FWD, cmd.mubatch_id, needs_fwd_msg=pend_fwd_msg)
+            )
+            pend_fwd_msg = False
+        elif isinstance(cmd, (S.BackwardGradAcc, S.BackwardGradAllReduce)):
+            if seen_opt:
+                raise ScheduleLoweringError("compute after OptimizerStep")
+            if pend_fwd_msg:
+                raise ScheduleLoweringError("RecvActivations not consumed by a Forward")
+            items.append(
+                WorkItem(
+                    OP_BWD,
+                    cmd.mubatch_id,
+                    needs_bwd_msg=pend_bwd_msg,
+                    allreduce=isinstance(cmd, S.BackwardGradAllReduce),
+                )
+            )
+            pend_bwd_msg = False
+        elif isinstance(cmd, S.SendActivations):
+            if stage_id == num_stages - 1:
+                raise ScheduleLoweringError("last stage cannot SendActivations")
+            if not items or items[-1].kind != OP_FWD or items[-1].sends_fwd:
+                raise ScheduleLoweringError(
+                    "SendActivations must directly follow its Forward"
+                )
+            items[-1] = dataclasses.replace(items[-1], sends_fwd=True)
+        elif isinstance(cmd, S.SendInputGrad):
+            if stage_id == 0:
+                raise ScheduleLoweringError("stage 0 cannot SendInputGrad")
+            if not items or items[-1].kind != OP_BWD or items[-1].sends_bwd:
+                raise ScheduleLoweringError(
+                    "SendInputGrad must directly follow its Backward"
+                )
+            items[-1] = dataclasses.replace(items[-1], sends_bwd=True)
+        else:
+            raise ScheduleLoweringError(f"unknown instruction {cmd!r}")
+    if pend_fwd_msg or pend_bwd_msg:
+        raise ScheduleLoweringError("dangling Recv with no consuming compute")
+    if training and not (seen_zero and seen_opt):
+        raise ScheduleLoweringError("training stream must bracket with ZeroGrad/OptimizerStep")
+    return items
+
+
+class _Mailbox:
+    """Receiver-side slot allocator for one direction at one stage."""
+
+    def __init__(self):
+        self.free_from = []  # per slot: earliest tick this slot may take an arrival
+        self.msgs = []  # FIFO of (sent_tick, slot, mubatch_id)
+
+    def deliver(self, tick, mubatch_id):
+        for i, f in enumerate(self.free_from):
+            if f <= tick:
+                self.free_from[i] = np.inf  # occupied
+                self.msgs.append((tick, i, mubatch_id))
+                return i
+        self.free_from.append(np.inf)
+        self.msgs.append((tick, len(self.free_from) - 1, mubatch_id))
+        return len(self.free_from) - 1
+
+    def _find(self, tick, mubatch_id):
+        for i, (sent, _, mb) in enumerate(self.msgs):
+            if sent < tick and mb == mubatch_id:
+                return i
+        return None
+
+    def consumable(self, tick, mubatch_id):
+        """A delivered message for exactly this microbatch is available.
+        Binding consumption by mubatch_id (not FIFO position) both supports
+        out-of-order consumers and turns sender/receiver order mismatches
+        into visible deadlocks instead of silently mispairing activations."""
+        return self._find(tick, mubatch_id) is not None
+
+    def consume(self, tick, mubatch_id):
+        i = self._find(tick, mubatch_id)
+        assert i is not None
+        _, slot, _ = self.msgs.pop(i)
+        self.free_from[slot] = tick  # reusable for arrivals this very tick
+        return slot
+
+    @property
+    def depth(self):
+        return len(self.free_from)
+
+
+def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
+    """Compile a Schedule class into a TickProgram for (M, S)."""
+    streams = [
+        S.flat_commands(
+            schedule_cls(
+                num_micro_batches=num_micro_batches,
+                num_stages=num_stages,
+                stage_id=s,
+            )
+        )
+        for s in range(num_stages)
+    ]
+    if training is None:
+        training = any(isinstance(c, S.OptimizerStep) for c in streams[0])
+    stage_items = [
+        parse_stage_stream(streams[s], s, num_stages, training)
+        for s in range(num_stages)
+    ]
+
+    # validate per-stage microbatch coverage
+    for s, items in enumerate(stage_items):
+        fwd = sorted(i.mubatch_id for i in items if i.kind == OP_FWD)
+        if fwd != list(range(num_micro_batches)):
+            raise ScheduleLoweringError(f"stage {s}: forwards {fwd} != 0..M-1")
+        if training:
+            bwd = sorted(i.mubatch_id for i in items if i.kind == OP_BWD)
+            if bwd != list(range(num_micro_batches)):
+                raise ScheduleLoweringError(f"stage {s}: backwards {bwd} != 0..M-1")
+            ars = [i for i in items if i.allreduce]
+            bwds = [i for i in items if i.kind == OP_BWD]
+            if len(ars) != 1 or bwds[-1] is not ars[0]:
+                raise ScheduleLoweringError(
+                    f"stage {s}: BackwardGradAllReduce must be exactly the final backward"
+                )
+
+    # --- greedy tick simulation -------------------------------------------
+    ptr = [0] * num_stages
+    fwd_mail = [_Mailbox() for _ in range(num_stages)]  # from s-1
+    bwd_mail = [_Mailbox() for _ in range(num_stages)]  # from s+1
+    rows = []  # per tick: list of per-stage dicts
+    t = 0
+    limit = 4 * num_micro_batches * num_stages + 8 * num_stages + 16
+    while any(ptr[s] < len(stage_items[s]) for s in range(num_stages)):
+        if t > limit:
+            raise ScheduleLoweringError("schedule failed to converge (livelock?)")
+        row = [
+            dict(op=OP_NOOP, mb=num_micro_batches, rf=-1, rb=-1, sf=0, sb=0, inf=-1, inb=-1)
+            for _ in range(num_stages)
+        ]
+        arrivals = []  # (direction, to_stage)
+        progressed = False
+        for s in range(num_stages):
+            if ptr[s] >= len(stage_items[s]):
+                continue
+            item = stage_items[s][ptr[s]]
+            if item.needs_fwd_msg and not fwd_mail[s].consumable(t, item.mubatch_id):
+                continue
+            if item.needs_bwd_msg and not bwd_mail[s].consumable(t, item.mubatch_id):
+                continue
+            # execute item at tick t
+            r = row[s]
+            r["op"], r["mb"] = item.kind, item.mubatch_id
+            if item.needs_fwd_msg:
+                r["rf"] = fwd_mail[s].consume(t, item.mubatch_id)
+            if item.needs_bwd_msg:
+                r["rb"] = bwd_mail[s].consume(t, item.mubatch_id)
+            if item.sends_fwd:
+                r["sf"] = 1
+                arrivals.append(("fwd", s + 1, item.mubatch_id))
+            if item.sends_bwd:
+                r["sb"] = 1
+                arrivals.append(("bwd", s - 1, item.mubatch_id))
+            ptr[s] += 1
+            progressed = True
+        if not progressed:
+            state = [(s, ptr[s], len(stage_items[s])) for s in range(num_stages)]
+            raise ScheduleLoweringError(f"deadlock at tick {t}: {state}")
+        for direction, dst, mb_id in arrivals:
+            mail = fwd_mail[dst] if direction == "fwd" else bwd_mail[dst]
+            slot = mail.deliver(t, mb_id)
+            row[dst]["inf" if direction == "fwd" else "inb"] = slot
+        rows.append(row)
+        t += 1
+
+    for s in range(num_stages):
+        if fwd_mail[s].msgs or bwd_mail[s].msgs:
+            raise ScheduleLoweringError(f"stage {s}: unconsumed messages at end")
+
+    K_f = max((m.depth for m in fwd_mail), default=0) or 1
+    K_b = max((m.depth for m in bwd_mail), default=0) or 1
+    T = len(rows)
+
+    def table(key, trash):
+        out = np.full((T, num_stages), 0, dtype=np.int32)
+        for ti, row in enumerate(rows):
+            for s in range(num_stages):
+                v = row[s][key]
+                out[ti, s] = trash if v == -1 else v
+        return out
+
+    return TickProgram(
+        num_ticks=T,
+        num_stages=num_stages,
+        num_micro_batches=num_micro_batches,
+        n_fwd_slots=K_f,
+        n_bwd_slots=K_b,
+        is_training=training,
+        op=np.array([[r[s]["op"] for s in range(num_stages)] for r in rows], np.int32),
+        mb=np.array([[r[s]["mb"] for s in range(num_stages)] for r in rows], np.int32),
+        read_fwd_slot=table("rf", K_f),
+        read_bwd_slot=table("rb", K_b),
+        in_fwd_slot=table("inf", K_f),
+        in_bwd_slot=table("inb", K_b),
+        send_fwd=np.array([[r[s]["sf"] for s in range(num_stages)] for r in rows], np.int32),
+        send_bwd=np.array([[r[s]["sb"] for s in range(num_stages)] for r in rows], np.int32),
+    )

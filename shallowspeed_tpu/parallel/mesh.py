@@ -1,0 +1,26 @@
+"""Device-mesh construction: the TPU replacement for the reference's two MPI
+communicators (train.py:87-94 — dp_comm = Split(rank % PP), pp_comm =
+Split(rank // PP)).
+
+A 2-D ``jax.sharding.Mesh`` with axes ``('dp', 'pp')`` expresses the same
+grid: rows are model replicas (the pp_comm groups), columns are same-stage
+ranks across replicas (the dp_comm groups). Collectives over axis 'dp' =
+Iallreduce over dp_comm; ppermute over axis 'pp' = the stage-relay Send/Recv
+pairs. On a real slice the mesh rides ICI; on CPU tests it rides the
+host-emulated devices from --xla_force_host_platform_device_count.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(dp: int, pp: int, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if dp * pp > len(devices):
+        raise ValueError(
+            f"need {dp * pp} devices for DP={dp} x PP={pp}, have {len(devices)}"
+        )
+    grid = np.asarray(devices[: dp * pp]).reshape(dp, pp)
+    return Mesh(grid, ("dp", "pp"))

@@ -255,10 +255,7 @@ class InferenceSchedule(Schedule):
 
     def steps(self):
         for mb in range(self.num_micro_batches):
-            cmds = self._fwd_step(mb)
-            if not self.is_last_stage:
-                cmds.append(SendActivations())
-            yield cmds
+            yield self._fwd_step_send(mb)
 
 
 SCHEDULES = {

@@ -1,0 +1,201 @@
+"""SPMD executor tests on the 8-virtual-device CPU mesh.
+
+The correctness bar is the reference's own: every distributed layout must
+reproduce SEQUENTIAL training (SURVEY §3.3 — the three-sums gradient ledger),
+and DP replicas must end bit-identical. These run the real shard_map +
+ppermute + psum code paths, which the reference never covered with tests at
+all (its multi-process checks were runtime asserts only).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shallowspeed_tpu import model as Mo
+from shallowspeed_tpu import schedules as S
+from shallowspeed_tpu import trainer, utils
+from shallowspeed_tpu.optimizer import SGD
+from shallowspeed_tpu.parallel import executor as E
+from shallowspeed_tpu.parallel import lower_schedule, make_mesh
+
+SIZES = (784, 128, 127, 126, 125, 124, 123, 10)  # flagship, uneven stages
+SMALL = (24, 20, 18, 16, 14, 12, 11, 10)  # same shape class, faster
+B, M, LR = 64, 4, 0.01
+NB = 3  # batches
+
+
+def _data(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(NB, B, sizes[0]).astype(np.float32)
+    Y = np.eye(sizes[-1], dtype=np.float32)[rng.randint(0, sizes[-1], (NB, B))]
+    return X, Y
+
+
+def _sequential_params(sizes, X, Y):
+    spec = Mo.make_model_spec(sizes, 1, B)
+    params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+    step = trainer.make_train_step(spec, SGD(LR))
+    st = ()
+    for i in range(NB):
+        params, st = step(
+            params,
+            st,
+            jnp.asarray(X[i].reshape(M, B // M, sizes[0])),
+            jnp.asarray(Y[i].reshape(M, B // M, sizes[-1])),
+        )
+    return [l for stage in params for l in stage]
+
+
+def _pipeline_params(sizes, X, Y, dp, pp, sched_cls, use_epoch=False):
+    mesh = make_mesh(dp, pp)
+    spec = Mo.make_model_spec(sizes, pp, B)
+    prog = lower_schedule(sched_cls, M, pp)
+    stacked, flags = E.init_stacked(spec, mesh)
+    mb_sz = B // dp // M
+    if use_epoch:
+        epoch = E.make_pipeline_epoch(mesh, spec, prog, mb_sz, SGD(LR))
+        stacked, _ = epoch(stacked, flags, jnp.asarray(X), jnp.asarray(Y))
+    else:
+        step = E.make_pipeline_step(mesh, spec, prog, mb_sz, SGD(LR))
+        for i in range(NB):
+            stacked, _ = step(stacked, flags, jnp.asarray(X[i]), jnp.asarray(Y[i]))
+    return stacked, spec, flags, mesh
+
+
+def _assert_matches_sequential(sizes, stacked, spec, rtol=3e-4, atol=3e-6):
+    X, Y = _data(sizes)
+    want = _sequential_params(sizes, X, Y)
+    got = [l for stage in E.unstack_params(stacked, spec) for l in stage]
+    assert len(want) == len(got)
+    for a, b in zip(want, got):
+        np.testing.assert_allclose(np.asarray(a["W"]), b["W"], rtol=rtol, atol=atol)
+        np.testing.assert_allclose(
+            np.asarray(a["b"]).reshape(-1), b["b"].reshape(-1), rtol=rtol, atol=atol
+        )
+
+
+LAYOUTS = [
+    (1, 1, S.GPipeSchedule),
+    (4, 1, S.NaiveParallelSchedule),
+    (8, 1, S.GPipeSchedule),
+    (1, 4, S.NaiveParallelSchedule),
+    (1, 4, S.GPipeSchedule),
+    (1, 4, S.PipeDreamFlushSchedule),
+    (2, 4, S.GPipeSchedule),
+    (2, 4, S.PipeDreamFlushSchedule),
+    (2, 2, S.NaiveParallelSchedule),
+]
+
+
+@pytest.mark.parametrize("dp,pp,sched", LAYOUTS)
+def test_layout_equals_sequential(dp, pp, sched):
+    """The headline invariant: any DP x PP x schedule == sequential."""
+    X, Y = _data(SMALL)
+    stacked, spec, _, _ = _pipeline_params(SMALL, X, Y, dp, pp, sched)
+    _assert_matches_sequential(SMALL, stacked, spec)
+
+
+def test_pp8_with_linear_on_last_stage_equals_sequential():
+    """PP=8 parity needs a size list whose last stage owns a Linear: with
+    exactly 8 sizes the reference's partitioning gives the last stage zero
+    Linears, so its 'no relu on the final Linear' rule never fires and the
+    PP=8 model architecturally differs from sequential (reference
+    layers.py:253-257 — a faithful quirk, covered in test_model). 16 sizes
+    give stage 7 a real Linear and exact parity."""
+    sizes16 = (24, 22, 21, 20, 19, 18, 17, 16, 16, 15, 14, 13, 13, 12, 11, 10)
+    X, Y = _data(sizes16)
+    stacked, spec, _, _ = _pipeline_params(sizes16, X, Y, 1, 8, S.GPipeSchedule)
+    _assert_matches_sequential(sizes16, stacked, spec)
+
+
+def test_flagship_dp2_pp4_gpipe_equals_sequential():
+    """Full-size model (784-wide, uneven 2/2/2/1 stages) on the full mesh."""
+    X, Y = _data(SIZES)
+    stacked, spec, _, _ = _pipeline_params(SIZES, X, Y, 2, 4, S.GPipeSchedule)
+    _assert_matches_sequential(SIZES, stacked, spec)
+
+
+def test_epoch_scan_matches_per_batch():
+    X, Y = _data(SMALL)
+    a, spec, _, _ = _pipeline_params(SMALL, X, Y, 2, 4, S.GPipeSchedule)
+    b, _, _, _ = _pipeline_params(SMALL, X, Y, 2, 4, S.GPipeSchedule, use_epoch=True)
+    ua = [l for st in E.unstack_params(a, spec) for l in st]
+    ub = [l for st in E.unstack_params(b, spec) for l in st]
+    for x, y in zip(ua, ub):
+        np.testing.assert_allclose(x["W"], y["W"], rtol=1e-6, atol=1e-7)
+
+
+def test_schedules_agree_with_each_other():
+    """naive, gpipe and pipedream must produce identical updates — they
+    reorder the same microbatch work."""
+    X, Y = _data(SMALL)
+    results = []
+    for sched in (S.NaiveParallelSchedule, S.GPipeSchedule, S.PipeDreamFlushSchedule):
+        stacked, spec, _, _ = _pipeline_params(SMALL, X, Y, 1, 4, sched)
+        results.append([l for st in E.unstack_params(stacked, spec) for l in st])
+    for other in results[1:]:
+        for a, b in zip(results[0], other):
+            np.testing.assert_allclose(a["W"], b["W"], rtol=1e-5, atol=1e-7)
+
+
+def test_dp_replicas_stay_in_sync():
+    X, Y = _data(SMALL)
+    stacked, spec, flags, mesh = _pipeline_params(SMALL, X, Y, 4, 2, S.GPipeSchedule)
+    utils.assert_dp_replicas_in_sync(stacked)
+
+
+def test_padded_regions_stay_zero():
+    """The zero-padding invariant after real training steps."""
+    X, Y = _data(SMALL)
+    stacked, spec, _, _ = _pipeline_params(SMALL, X, Y, 2, 4, S.GPipeSchedule)
+    W = np.asarray(jax.device_get(stacked["W"]))
+    b = np.asarray(jax.device_get(stacked["b"]))
+    for s, sspec in enumerate(spec.stages):
+        for l in range(W.shape[1]):
+            if l < sspec.n_linears:
+                out_d, in_d = sspec.local_sizes[l + 1], sspec.local_sizes[l]
+                block = W[s, l].copy()
+                block[:out_d, :in_d] = 0
+                assert (block == 0).all(), f"stage {s} layer {l} leaked outside block"
+                assert (b[s, l, out_d:] == 0).all()
+            else:
+                assert (W[s, l] == 0).all() and (b[s, l] == 0).all()
+
+
+def test_pipeline_inference_matches_sequential_predict():
+    X, Y = _data(SMALL)
+    mesh = make_mesh(2, 4)
+    spec = Mo.make_model_spec(SMALL, 4, B)
+    eval_prog = lower_schedule(S.InferenceSchedule, M, 4, training=False)
+    stacked, flags = E.init_stacked(spec, mesh)
+    eval_step = E.make_pipeline_step(mesh, spec, eval_prog, B // 2 // M)
+    preds = eval_step(stacked, flags, jnp.asarray(X[0]))
+
+    spec1 = Mo.make_model_spec(SMALL, 1, B)
+    params1 = jax.tree.map(jnp.asarray, Mo.init_model(spec1))
+    want = trainer.make_predict(spec1)(params1, jnp.asarray(X[0]))
+    np.testing.assert_allclose(
+        np.asarray(preds)[:, : SMALL[-1]], np.asarray(want), rtol=2e-4, atol=1e-5
+    )
+    assert (np.asarray(preds)[:, SMALL[-1] :] == 0).all()
+
+
+def test_train_loss_decreases():
+    rng = np.random.RandomState(7)
+    labels = rng.randint(0, 10, (8, B))
+    centers = rng.randn(10, SMALL[0]).astype(np.float32) * 2
+    X = np.stack([centers[lb] + 0.1 * rng.randn(B, SMALL[0]).astype(np.float32) for lb in labels])
+    Y = np.eye(10, dtype=np.float32)[labels]
+    mesh = make_mesh(2, 4)
+    spec = Mo.make_model_spec(SMALL, 4, B)
+    prog = lower_schedule(S.GPipeSchedule, M, 4)
+    stacked, flags = E.init_stacked(spec, mesh)
+    step = E.make_pipeline_step(mesh, spec, prog, B // 2 // M, SGD(0.05))
+    losses = []
+    for e in range(6):
+        for i in range(8):
+            stacked, loss = step(stacked, flags, jnp.asarray(X[i]), jnp.asarray(Y[i]))
+        losses.append(float(loss))
+    assert all(b < a for a, b in zip(losses, losses[1:])), losses
+    assert losses[-1] < losses[0] - 5e-3, losses

@@ -1,0 +1,230 @@
+"""Lowering tests: replay tick programs symbolically and verify dataflow.
+
+The tick program is the load-bearing artifact of the whole SPMD pipeline —
+these tests interpret it with symbolic payloads (no arrays, no jax) and
+assert that every stage's forward consumes exactly the right microbatch's
+activations from its predecessor, every backward consumes the right gradient
+from its successor, mailboxes never collide, and the tick counts match the
+textbook formulas for each schedule.
+"""
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu import schedules as S
+from shallowspeed_tpu.parallel.lowering import (
+    OP_BWD,
+    OP_FWD,
+    OP_NOOP,
+    ScheduleLoweringError,
+    lower_schedule,
+)
+
+TRAIN = [S.NaiveParallelSchedule, S.GPipeSchedule, S.PipeDreamFlushSchedule]
+GRID = [(4, 1), (4, 2), (4, 4), (2, 4), (8, 4), (1, 3), (4, 8)]
+
+
+def replay(p):
+    """Symbolically execute a TickProgram; returns per-stage event log.
+
+    Payloads are tuples ("act"|"grad", mubatch, from_stage). Raises on any
+    mailbox misuse; returns events[(t, s)] = (op, mb, consumed_payload).
+    """
+    Kf, Kb = p.n_fwd_slots, p.n_bwd_slots
+    fwd_mail = [[None] * Kf for _ in range(p.num_stages)]
+    bwd_mail = [[None] * Kb for _ in range(p.num_stages)]
+    events = {}
+    for t in range(p.num_ticks):
+        outgoing = []  # (dst, direction, slot, payload)
+        for s in range(p.num_stages):
+            op, mb = int(p.op[t, s]), int(p.mb[t, s])
+            consumed = None
+            rf, rb = int(p.read_fwd_slot[t, s]), int(p.read_bwd_slot[t, s])
+            if rf != Kf:
+                consumed = fwd_mail[s][rf]
+                assert consumed is not None, f"read from empty fwd slot at t={t} s={s}"
+                fwd_mail[s][rf] = None
+            if rb != Kb:
+                assert consumed is None
+                consumed = bwd_mail[s][rb]
+                assert consumed is not None, f"read from empty bwd slot at t={t} s={s}"
+                bwd_mail[s][rb] = None
+            if op != OP_NOOP:
+                events[(t, s)] = (op, mb, consumed)
+            if p.send_fwd[t, s]:
+                assert op == OP_FWD
+                outgoing.append((s + 1, "fwd", ("act", mb, s)))
+            if p.send_bwd[t, s]:
+                assert op == OP_BWD
+                outgoing.append((s - 1, "bwd", ("grad", mb, s)))
+        for dst, direction, payload in outgoing:
+            mail = fwd_mail if direction == "fwd" else bwd_mail
+            slot_tab = p.in_fwd_slot if direction == "fwd" else p.in_bwd_slot
+            slot = int(slot_tab[t, dst])
+            assert slot != (Kf if direction == "fwd" else Kb), (
+                f"payload to stage {dst} at t={t} has no assigned slot"
+            )
+            assert mail[dst][slot] is None, f"mailbox collision at t={t} dst={dst}"
+            mail[dst][slot] = payload
+    for s in range(p.num_stages):
+        assert all(x is None for x in fwd_mail[s] + bwd_mail[s]), "leftover messages"
+    return events
+
+
+@pytest.mark.parametrize("cls", TRAIN)
+@pytest.mark.parametrize("M,St", GRID)
+def test_dataflow_correctness(cls, M, St):
+    p = lower_schedule(cls, M, St)
+    events = replay(p)
+    for (t, s), (op, mb, consumed) in events.items():
+        if op == OP_FWD:
+            if s == 0:
+                assert consumed is None  # loads from the dataset
+            else:
+                assert consumed == ("act", mb, s - 1), (t, s, mb, consumed)
+        elif op == OP_BWD:
+            if s == St - 1:
+                assert consumed is None  # consumes loaded targets
+            else:
+                assert consumed == ("grad", mb, s + 1), (t, s, mb, consumed)
+    # every stage does M forwards and M backwards
+    for s in range(St):
+        ops_s = [v[0] for (t, ss), v in events.items() if ss == s]
+        assert ops_s.count(OP_FWD) == M and ops_s.count(OP_BWD) == M
+
+
+@pytest.mark.parametrize("M,St", GRID)
+def test_inference_dataflow(M, St):
+    p = lower_schedule(S.InferenceSchedule, M, St)
+    events = replay(p)
+    assert all(v[0] == OP_FWD for v in events.values())
+    assert not p.is_training
+
+
+class TestTickCounts:
+    """Lowered latency must equal the textbook schedule depth."""
+
+    @pytest.mark.parametrize("M,St", [(4, 2), (4, 4), (8, 4), (2, 4)])
+    def test_gpipe(self, M, St):
+        assert lower_schedule(S.GPipeSchedule, M, St).num_ticks == 2 * (M + St - 1)
+
+    @pytest.mark.parametrize("M,St", [(4, 2), (4, 4), (8, 4)])
+    def test_pipedream_no_slower_than_gpipe(self, M, St):
+        assert (
+            lower_schedule(S.PipeDreamFlushSchedule, M, St).num_ticks
+            <= lower_schedule(S.GPipeSchedule, M, St).num_ticks
+        )
+
+    @pytest.mark.parametrize("M,St", [(4, 2), (4, 4)])
+    def test_naive(self, M, St):
+        assert lower_schedule(S.NaiveParallelSchedule, M, St).num_ticks == 2 * M * St
+
+    @pytest.mark.parametrize("M,St", [(4, 4), (8, 2)])
+    def test_inference(self, M, St):
+        assert lower_schedule(S.InferenceSchedule, M, St).num_ticks == M + St - 1
+
+
+class TestPipelineUtilization:
+    def test_gpipe_bubble_fraction(self):
+        """Busy ticks / total = M/(M+S-1) per phase — the GPipe bubble law."""
+        M, St = 8, 4
+        p = lower_schedule(S.GPipeSchedule, M, St)
+        busy = (np.asarray(p.op) != OP_NOOP).sum()
+        assert busy == 2 * M * St  # total work
+        assert p.num_ticks == 2 * (M + St - 1)
+
+    def test_naive_only_one_stage_active(self):
+        p = lower_schedule(S.NaiveParallelSchedule, 4, 4)
+        active_per_tick = (np.asarray(p.op) != OP_NOOP).sum(axis=1)
+        assert (active_per_tick <= 1).all()
+
+
+class TestValidation:
+    def test_malformed_schedule_deadlocks(self):
+        class Broken(S.Schedule):
+            def steps(self):
+                yield [S.ZeroGrad()]
+                # stage 1 receives but stage 0 never sends -> deadlock
+                if self.stage_id == 0:
+                    yield [S.LoadMuBatchInput(mubatch_id=0), S.Forward(mubatch_id=0)]
+                    yield [
+                        S.LoadMuBatchTarget(mubatch_id=0),
+                        S.BackwardGradAllReduce(mubatch_id=0),
+                    ]
+                else:
+                    yield [S.RecvActivations(), S.Forward(mubatch_id=0)]
+                    yield [S.BackwardGradAllReduce(mubatch_id=0)]
+                yield [S.OptimizerStep()]
+
+        with pytest.raises(ScheduleLoweringError):
+            lower_schedule(Broken, 1, 2)
+
+    def test_missing_optimizer_step_rejected(self):
+        class NoOpt(S.Schedule):
+            def steps(self):
+                yield [S.ZeroGrad()]
+                yield [S.LoadMuBatchInput(mubatch_id=0), S.Forward(mubatch_id=0)]
+                yield [
+                    S.LoadMuBatchTarget(mubatch_id=0),
+                    S.BackwardGradAllReduce(mubatch_id=0),
+                ]
+
+        with pytest.raises(ScheduleLoweringError):
+            lower_schedule(NoOpt, 1, 1, training=True)
+
+    def test_out_of_order_consumer_pairs_correctly(self):
+        """A receiver that consumes microbatches in a different order than its
+        peer emits them must get the RIGHT payloads (mailbox binds messages by
+        microbatch id, not FIFO position) — never silently mispair."""
+
+        class Swapped(S.Schedule):
+            # stage 0 sends fwd mb0 then mb1; stage 1 consumes mb1 first
+            def steps(self):
+                yield [S.ZeroGrad()]
+                if self.stage_id == 0:
+                    for mb in (0, 1):
+                        yield [
+                            S.LoadMuBatchInput(mubatch_id=mb),
+                            S.Forward(mubatch_id=mb),
+                            S.SendActivations(),
+                        ]
+                    for mb in (0, 1):
+                        yield [
+                            S.RecvOutputGrad(),
+                            (S.BackwardGradAllReduce if mb == 1 else S.BackwardGradAcc)(
+                                mubatch_id=mb
+                            ),
+                        ]
+                else:
+                    for mb in (1, 0):  # swapped consumption order
+                        yield [S.RecvActivations(), S.Forward(mubatch_id=mb)]
+                    for mb in (0, 1):
+                        yield [
+                            S.LoadMuBatchTarget(mubatch_id=mb),
+                            (S.BackwardGradAllReduce if mb == 1 else S.BackwardGradAcc)(
+                                mubatch_id=mb
+                            ),
+                            S.SendInputGrad(),
+                        ]
+                yield [S.OptimizerStep()]
+
+        p = lower_schedule(Swapped, 2, 2)
+        events = replay(p)  # replay asserts every consume matches its mubatch
+        fwd_order_s1 = [
+            v[1] for (t, s), v in sorted(events.items()) if s == 1 and v[0] == OP_FWD
+        ]
+        assert fwd_order_s1 == [1, 0]
+
+    def test_incomplete_mubatch_coverage_rejected(self):
+        class Skips(S.GPipeSchedule):
+            def steps(self):
+                for step in super().steps():
+                    # drop forward of mubatch 1
+                    yield [
+                        c
+                        for c in step
+                        if not (isinstance(c, S.Forward) and c.mubatch_id == 1)
+                    ]
+
+        with pytest.raises(ScheduleLoweringError):
+            lower_schedule(Skips, 2, 1)

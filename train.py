@@ -1,0 +1,160 @@
+"""Training driver CLI — the TPU-native counterpart of the reference train.py.
+
+Same surface: ``python train.py [--dp N] [--pp M] [--schedule naive|gpipe|pipedream]``
+(reference train.py:62-74), same flagship model (sizes [784,128,127,126,125,
+124,123,10], train.py:98), same constants (EPOCHS=20, GLOBAL_BATCH_SIZE=128,
+N_MUBATCHES=4, lr=0.006), same epoch structure (per-epoch validation accuracy
+from the last stage, final replica-sync check).
+
+Differences by design:
+- no mpirun: ONE process drives the whole (dp, pp) device mesh; the two MPI
+  communicators become mesh axes (parallel/mesh.py);
+- the per-batch instruction streams are compiled once to a tick program and
+  the whole epoch runs as one jitted scan on device;
+- extra flags (epochs, batch size, lr, data dir, platform) are exposed
+  instead of module constants.
+
+Examples:
+    python train.py                      # sequential, 1 device
+    python train.py --dp 8               # 8-way data parallel
+    python train.py --pp 4 --schedule gpipe
+    python train.py --dp 2 --pp 4 --schedule pipedream
+On a single-chip host, multi-device layouts run on emulated CPU devices:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python train.py --dp 2 --pp 4 --schedule gpipe
+"""
+
+import argparse
+import time
+
+LAYER_SIZES = (784, 128, 127, 126, 125, 124, 123, 10)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dp", type=int, default=1, help="data-parallel replicas")
+    ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
+    ap.add_argument(
+        "--schedule",
+        choices=["naive", "gpipe", "pipedream"],
+        default="naive",
+        help="pipeline schedule (ignored unless --pp > 1)",
+    )
+    ap.add_argument("--epochs", type=int, default=20)
+    ap.add_argument("--global-batch-size", type=int, default=128)
+    ap.add_argument("--mubatches", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=0.006)
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--no-eval", action="store_true", help="skip per-epoch accuracy")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from shallowspeed_tpu import model as Mo
+    from shallowspeed_tpu import schedules as S
+    from shallowspeed_tpu import trainer, utils
+    from shallowspeed_tpu.data import Dataset, default_data_dir
+    from shallowspeed_tpu.optimizer import SGD
+    from shallowspeed_tpu.parallel import executor as E
+    from shallowspeed_tpu.parallel import lower_schedule, make_mesh
+
+    B, M = args.global_batch_size, args.mubatches
+    assert B % args.dp == 0, "batch size must be divisible by DP"
+    local_batch = B // args.dp
+    assert local_batch % M == 0, "microbatches must divide the local batch"
+    data_dir = args.data_dir or default_data_dir()
+
+    ds = Dataset(data_dir, B, mubatch_size=local_batch // M)
+    ds.load(0, 1)  # one process holds the global batch; the mesh shards it
+    val = Dataset(data_dir, B, mubatch_size=B, validation=True)
+    val.load(0, 1)
+    vx, vy = jnp.asarray(val.input_X), jnp.asarray(val.target_y)
+
+    spec = Mo.make_model_spec(LAYER_SIZES, args.pp, B)
+    opt = SGD(args.lr)
+    nb = ds.get_num_batches()
+    Xb, Yb = ds.epoch_arrays()  # (nb, M, mb_local*dp, d) ordering: global batches
+    X = jnp.asarray(Xb.reshape(nb, B, Xb.shape[-1]))
+    Y = jnp.asarray(Yb.reshape(nb, B, Yb.shape[-1]))
+
+    print(
+        f"devices={jax.devices()} layout: DP={args.dp} x PP={args.pp}"
+        f" schedule={args.schedule if args.pp > 1 else 'sequential'}"
+        f" batches/epoch={nb}"
+    )
+
+    if args.dp == 1 and args.pp == 1:
+        params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+        epoch_fn = trainer.make_train_epoch(spec, opt)
+        predict = trainer.make_predict(spec)
+        state = ()
+        Xe = X.reshape(nb, M, B // M, -1)
+        Ye = Y.reshape(nb, M, B // M, -1)
+        t0 = time.time()
+        for e in range(args.epochs):
+            if not args.no_eval:
+                acc = trainer.accuracy(predict, params, vx, vy)
+                print(
+                    f"Epoch: {e}, Time Spent: {time.time() - t0:.2f}s, "
+                    f"Accuracy: {acc * 100:.2f}%"
+                )
+            params, state = epoch_fn(params, state, Xe, Ye)
+        jax.block_until_ready(params)
+        acc = trainer.accuracy(predict, params, vx, vy)
+        print(
+            f"Epoch: {args.epochs}, Time Spent: {time.time() - t0:.2f}s, "
+            f"Accuracy: {acc * 100:.2f}%"
+        )
+        print("final model hash:", utils.model_hash(params))
+        return
+
+    mesh = make_mesh(args.dp, args.pp)
+    sched_cls = S.SCHEDULES[args.schedule]
+    prog = lower_schedule(sched_cls, M, args.pp)
+    eval_prog = lower_schedule(S.InferenceSchedule, 1, args.pp, training=False)
+    stacked, flags = E.init_stacked(spec, mesh)
+    mb_sz = local_batch // M
+    epoch_fn = E.make_pipeline_epoch(mesh, spec, prog, mb_sz, opt)
+    # validation runs the inference tick program with one full-batch microbatch
+    # on a pp-only slice of the mesh semantics (dp shards the val batch too)
+    eval_step = E.make_pipeline_step(mesh, spec, eval_prog, B // args.dp)
+
+    def pipeline_accuracy(stacked):
+        """Full-split accuracy; the ragged tail chunk is zero-padded up to B
+        and only its valid rows are counted (eval shapes stay static)."""
+        correct = total = 0
+        for i in range(0, len(val.input_X), B):
+            xb, yb = vx[i : i + B], vy[i : i + B]
+            n_valid = xb.shape[0]
+            if n_valid < B:
+                xb = jnp.pad(xb, ((0, B - n_valid), (0, 0)))
+            preds = eval_step(stacked, flags, xb)[:n_valid]
+            correct += int((jnp.argmax(preds[:, :10], 1) == jnp.argmax(yb, 1)).sum())
+            total += n_valid
+        return correct / max(total, 1)
+
+    t0 = time.time()
+    for e in range(args.epochs):
+        if not args.no_eval:
+            acc = pipeline_accuracy(stacked)
+            print(
+                f"Epoch: {e}, Time Spent: {time.time() - t0:.2f}s, "
+                f"Accuracy: {acc * 100:.2f}%"
+            )
+        stacked, mean_loss = epoch_fn(stacked, flags, X, Y)
+        print(f"Epoch: {e}, mean train loss: {float(mean_loss):.5f}")
+    jax.block_until_ready(stacked)
+    acc = pipeline_accuracy(stacked)
+    print(
+        f"Epoch: {args.epochs}, Time Spent: {time.time() - t0:.2f}s, "
+        f"Accuracy: {acc * 100:.2f}%"
+    )
+    utils.assert_dp_replicas_in_sync(stacked)
+    print("DP replicas in sync ✓")
+    print("final model hash:", utils.model_hash(E.unstack_params(stacked, spec)))
+
+
+if __name__ == "__main__":
+    main()
