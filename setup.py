@@ -1,0 +1,10 @@
+from setuptools import find_packages, setup
+
+setup(
+    name="shallowspeed_tpu",
+    version="0.1.0",
+    description="TPU-native distributed-training framework (DP x PP on a JAX mesh)",
+    packages=find_packages(include=["shallowspeed_tpu", "shallowspeed_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["jax", "numpy"],
+)

@@ -1,0 +1,112 @@
+"""Checkpoint / resume: layout-independent on-disk snapshots.
+
+The reference has NO checkpointing in its framework (SURVEY §5.4 — only its
+PyTorch baseline script saves weights for divergence comparison). Here it is
+a first-class subsystem, designed around the same principle as init and
+hashing: checkpoints store the *logical* per-layer (W, b) blocks in global
+layer order, so a model trained DP=2 x PP=4 can be saved and resumed
+sequentially, or vice versa — the layout is a property of the run, not of
+the checkpoint.
+
+Format: a single .npz (atomic rename on save) with arrays ``w{i}``/``b{i}``
+per global layer plus a JSON metadata blob (sizes, global batch size, epoch,
+optimizer state).
+"""
+
+import json
+import os
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+from shallowspeed_tpu.model import ModelSpec, make_model_spec
+
+FORMAT_VERSION = 1
+
+
+def _flatten_logical(params_list):
+    """Per-stage ragged params -> flat global layer list (host numpy)."""
+    import jax
+
+    out = []
+    for stage in params_list:
+        for layer in stage:
+            out.append(
+                (
+                    np.asarray(jax.device_get(layer["W"]), np.float32),
+                    np.asarray(jax.device_get(layer["b"]), np.float32).reshape(1, -1),
+                )
+            )
+    return out
+
+
+def save_checkpoint(path, params_list, spec: ModelSpec, epoch: int, extra=None):
+    """Atomically write params (+ metadata) to ``path`` (.npz)."""
+    path = Path(path)
+    flat = _flatten_logical(params_list)
+    if len(flat) != len(spec.sizes) - 1:
+        raise ValueError(
+            f"param count {len(flat)} does not match spec sizes {spec.sizes}"
+        )
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "sizes": list(spec.sizes),
+        "global_batch_size": spec.global_batch_size,
+        "epoch": int(epoch),
+        "extra": extra or {},
+    }
+    arrays = {"meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)}
+    for i, (w, b) in enumerate(flat):
+        arrays[f"w{i}"] = w
+        arrays[f"b{i}"] = b
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_checkpoint(path, n_stages: int, global_batch_size=None):
+    """Load a checkpoint and re-partition it for an ``n_stages`` layout.
+
+    ``global_batch_size``: the CURRENT run's global batch size — it feeds the
+    loss-scaling spec, so resurrecting the saved value when the run uses a
+    different batch size would silently mis-scale every gradient. Defaults to
+    the saved value for same-configuration resumes.
+
+    Returns (params_list, spec, meta): params_list is per-stage ragged host
+    numpy ready for ``jax.tree.map(jnp.asarray, ...)`` (sequential) or
+    ``executor.stack_params`` (pipeline).
+    """
+    with np.load(Path(path)) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        if meta.get("format_version") != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version: {meta}")
+        n_layers = len(meta["sizes"]) - 1
+        flat = [(z[f"w{i}"], z[f"b{i}"]) for i in range(n_layers)]
+    if global_batch_size is None:
+        global_batch_size = meta["global_batch_size"]
+    spec = make_model_spec(meta["sizes"], n_stages, global_batch_size)
+    params_list, k = [], 0
+    for sspec in spec.stages:
+        layers = []
+        for _ in range(sspec.n_linears):
+            w, b = flat[k]
+            layers.append({"W": w, "b": b})
+            k += 1
+        params_list.append(layers)
+    # shape sanity against the re-partitioned spec
+    for sspec, layers in zip(spec.stages, params_list):
+        for l, layer in enumerate(layers):
+            want = (sspec.local_sizes[l + 1], sspec.local_sizes[l])
+            if layer["W"].shape != want:
+                raise ValueError(
+                    f"checkpoint layer shape {layer['W'].shape} != spec {want}"
+                )
+    return params_list, spec, meta

@@ -160,11 +160,14 @@ def stage_forward(params, spec: StageSpec, x, precision=ops.DEFAULT_PRECISION):
     """
     caches = []
     for l in range(spec.n_linears):
-        y = ops.linear(x, params[l]["W"], params[l]["b"], precision=precision)
         if spec.relu_flags[l]:
-            caches.append((x, y > 0))
-            x = ops.relu(y)
+            y, mask = ops.linear_relu_fused(
+                x, params[l]["W"], params[l]["b"], precision=precision
+            )
+            caches.append((x, mask))
+            x = y
         else:
+            y = ops.linear(x, params[l]["W"], params[l]["b"], precision=precision)
             caches.append((x, _placeholder(jnp.bool_)))
             x = y
     if spec.has_head:
@@ -191,8 +194,11 @@ def stage_backward(params, spec: StageSpec, residuals, dout, precision=ops.DEFAU
     for l in reversed(range(spec.n_linears)):
         x_in, bitmask = caches[l]
         if spec.relu_flags[l]:
-            g = ops.relu_grad(g, bitmask)
-        g, dw, db = ops.linear_grad(g, x_in, params[l]["W"], precision=precision)
+            g, dw, db = ops.linear_relu_grad_fused(
+                g, bitmask, x_in, params[l]["W"], precision=precision
+            )
+        else:
+            g, dw, db = ops.linear_grad(g, x_in, params[l]["W"], precision=precision)
         grads[l] = {"W": dw, "b": jnp.reshape(db, (1, -1))}
     return g, grads
 

@@ -18,11 +18,25 @@ TPU notes:
   SPMD pipeline executor run unequal stages as fixed-shape stacked params.
 """
 
+import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+# Opt-in Pallas kernel path for the fused linear+relu hot op (pallas_ops.py);
+# default is plain XLA, which already fuses well for this model class.
+_PALLAS = os.environ.get("SHALLOWSPEED_PALLAS", "0") == "1"
+
+
+def set_pallas(enabled: bool) -> None:
+    global _PALLAS
+    _PALLAS = bool(enabled)
+
+
+def pallas_enabled() -> bool:
+    return _PALLAS
 
 # Matmul precision used across the framework. HIGHEST = fp32 accumulate with
 # full-precision inputs (required for NumPy-trajectory parity tests); callers
@@ -66,6 +80,33 @@ def linear_grad(g, x, w, precision=DEFAULT_PRECISION):
     dw = jnp.matmul(g.T, x, precision=precision)
     db = g.sum(axis=0)
     return dx, dw, db
+
+
+def linear_relu_fused(x, w, b, precision=DEFAULT_PRECISION):
+    """Fused y = relu(x @ w.T + b); returns (y, pre-activation bitmask).
+
+    XLA path by default; the Pallas kernel (pallas_ops.py) when enabled —
+    same contract either way, so the model layer is backend-agnostic.
+    """
+    if _PALLAS:
+        from shallowspeed_tpu import pallas_ops
+
+        y, mask = pallas_ops.linear_relu_fwd(x, w, b)
+        return y, mask > 0
+    y = linear(x, w, b, precision=precision)
+    return relu(y), y > 0
+
+
+def linear_relu_grad_fused(g, bitmask, x, w, precision=DEFAULT_PRECISION):
+    """Backward of linear_relu_fused: (dx, dw, db) in one fused unit."""
+    if _PALLAS:
+        from shallowspeed_tpu import pallas_ops
+
+        dx, dw, db = pallas_ops.linear_relu_bwd(
+            g, bitmask.astype(jnp.float32), x, w
+        )
+        return dx, dw, jnp.reshape(db, (-1,))
+    return linear_grad(relu_grad(g, bitmask), x, w, precision=precision)
 
 
 def softmax(z, valid_mask=None):

@@ -1,0 +1,93 @@
+"""Pallas TPU kernels for the hot op: fused linear + ReLU, forward & backward.
+
+The framework's compute path is XLA-compiled jax.numpy (ops.py) — for this
+model class XLA already fuses bias-add and ReLU into the matmul. These Pallas
+kernels exist for the cases XLA can't schedule as one unit and as the
+framework's custom-kernel layer (per-stage tensors here are small enough that
+a whole layer fits VMEM, so each kernel is a single block: HBM -> VMEM once,
+matmul on the MXU with fp32 accumulation, activation + bitmask on the VPU,
+one write back).
+
+- ``linear_relu_fwd(x, w, b) -> (y, mask)``: y = relu(x @ w.T + b), mask the
+  pre-activation sign bitmask the backward needs (reference semantics:
+  layers.py:68-71 caches the same bitmask).
+- ``linear_relu_bwd(g, mask, x, w) -> (dx, dw, db)``: all three gradients in
+  one kernel from one VMEM residency of g/mask/x/w.
+
+Enable with SHALLOWSPEED_PALLAS=1 (or ``ops.set_pallas(True)``); off-TPU the
+kernels run in interpreter mode, so the same tests cover CPU CI and real
+hardware. Scope note: the flag applies to the SEQUENTIAL model path
+(model.stage_forward/backward). The pipeline executor keeps the pure-XLA
+path: its layer loop selects relu/identity behavior with traced per-device
+flags, so a statically-fused relu kernel cannot be slotted in without
+specializing the program per stage.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, mask_ref):
+    z = (
+        jnp.dot(x_ref[:], w_ref[:].T, preferred_element_type=jnp.float32)
+        + b_ref[:]
+    )
+    mask_ref[:] = (z > 0.0).astype(jnp.float32)
+    y_ref[:] = jnp.maximum(z, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def linear_relu_fwd(x, w, b):
+    mb, din = x.shape
+    dout = w.shape[0]
+    y, mask = pl.pallas_call(
+        _fwd_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((mb, dout), jnp.float32),
+            jax.ShapeDtypeStruct((mb, dout), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        interpret=_interpret(),
+    )(x, w, jnp.reshape(b, (1, -1)))
+    return y, mask
+
+
+def _bwd_kernel(g_ref, mask_ref, x_ref, w_ref, dx_ref, dw_ref, db_ref):
+    ge = g_ref[:] * mask_ref[:]
+    dx_ref[:] = jnp.dot(ge, w_ref[:], preferred_element_type=jnp.float32)
+    dw_ref[:] = jnp.dot(ge.T, x_ref[:], preferred_element_type=jnp.float32)
+    db_ref[:] = jnp.sum(ge, axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def linear_relu_bwd(g, mask, x, w):
+    mb, dout = g.shape
+    din = x.shape[1]
+    dx, dw, db = pl.pallas_call(
+        _bwd_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((mb, din), jnp.float32),
+            jax.ShapeDtypeStruct((dout, din), jnp.float32),
+            jax.ShapeDtypeStruct((1, dout), jnp.float32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 4,
+        out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
+        interpret=_interpret(),
+    )(g, mask, x, w)
+    return dx, dw, db

@@ -109,13 +109,20 @@ def unstack_params(stacked, spec: ModelSpec):
     return out
 
 
+def put_stacked(stacked, flags, mesh: Mesh):
+    """device_put stacked params + flags with P('pp') sharding on the stage
+    axis — the one place the stacked-param sharding is defined."""
+    pp = NamedSharding(mesh, P("pp"))
+    return (
+        jax.tree.map(lambda x: jax.device_put(x, pp), stacked),
+        jax.tree.map(lambda x: jax.device_put(x, pp), flags),
+    )
+
+
 def init_stacked(spec: ModelSpec, mesh: Mesh):
     """Deterministic init, stacked + device_put with pp sharding."""
     stacked, flags = stack_params(init_model(spec), spec)
-    pp = NamedSharding(mesh, P("pp"))
-    stacked = jax.tree.map(lambda x: jax.device_put(x, pp), stacked)
-    flags = jax.tree.map(lambda x: jax.device_put(x, pp), flags)
-    return stacked, flags
+    return put_stacked(stacked, flags, mesh)
 
 
 # ---------------------------------------------------------------------------

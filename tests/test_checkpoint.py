@@ -1,0 +1,126 @@
+"""Checkpoint tests: round-trip fidelity + cross-layout resume.
+
+The design property under test: a checkpoint stores logical per-layer blocks
+in global layer order, so save-from-one-layout / resume-into-another is exact
+(the reference framework has no checkpointing at all, SURVEY §5.4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shallowspeed_tpu import model as Mo
+from shallowspeed_tpu import schedules as S
+from shallowspeed_tpu import trainer
+from shallowspeed_tpu.checkpoint import load_checkpoint, save_checkpoint
+from shallowspeed_tpu.optimizer import SGD
+from shallowspeed_tpu.parallel import executor as E
+from shallowspeed_tpu.parallel import lower_schedule, make_mesh
+
+SIZES = (24, 20, 18, 16, 14, 12, 11, 10)
+B, M = 32, 4
+
+
+def _train_sequential(params, spec, n=2, seed=0):
+    rng = np.random.RandomState(seed)
+    step = trainer.make_train_step(spec, SGD(0.01))
+    st = ()
+    for _ in range(n):
+        x = jnp.asarray(rng.randn(M, B // M, SIZES[0]).astype(np.float32))
+        y = jnp.asarray(
+            np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, 10, (M, B // M))]
+        )
+        params, st = step(params, st, x, y)
+    return params
+
+
+def test_round_trip_exact(tmp_path):
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    params = _train_sequential(jax.tree.map(jnp.asarray, Mo.init_model(spec)), spec)
+    p = tmp_path / "ck.npz"
+    save_checkpoint(p, params, spec, epoch=3, extra={"note": "t"})
+    loaded, spec2, meta = load_checkpoint(p, 1)
+    assert meta["epoch"] == 3 and meta["extra"]["note"] == "t"
+    assert spec2.sizes == spec.sizes
+    for a, b in zip(
+        [l for s in params for l in s], [l for s in loaded for l in s]
+    ):
+        np.testing.assert_array_equal(np.asarray(a["W"]), b["W"])
+        np.testing.assert_array_equal(np.asarray(a["b"]).reshape(1, -1), b["b"])
+
+
+def test_cross_layout_resume_sequential_to_pipeline(tmp_path):
+    """Train sequentially, save, resume DP=2 x PP=4 — trained weights must
+    land in the right stacked blocks and keep training correctly."""
+    spec1 = Mo.make_model_spec(SIZES, 1, B)
+    params = _train_sequential(jax.tree.map(jnp.asarray, Mo.init_model(spec1)), spec1)
+    p = tmp_path / "ck.npz"
+    save_checkpoint(p, params, spec1, epoch=0)
+
+    loaded, spec4, _ = load_checkpoint(p, 4)
+    mesh = make_mesh(2, 4)
+    stacked, flags = E.put_stacked(*E.stack_params(loaded, spec4), mesh)
+
+    # continue training one batch in BOTH layouts; results must agree
+    rng = np.random.RandomState(42)
+    xb = rng.randn(B, SIZES[0]).astype(np.float32)
+    yb = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, 10, B)]
+
+    step1 = trainer.make_train_step(spec1, SGD(0.01))
+    seq_params, _ = step1(
+        params,
+        (),
+        jnp.asarray(xb.reshape(M, B // M, -1)),
+        jnp.asarray(yb.reshape(M, B // M, -1)),
+    )
+
+    prog = lower_schedule(S.GPipeSchedule, M, 4)
+    step4 = E.make_pipeline_step(mesh, spec4, prog, B // 2 // M, SGD(0.01))
+    stacked, _ = step4(stacked, flags, jnp.asarray(xb), jnp.asarray(yb))
+
+    want = [l for s in seq_params for l in s]
+    got = [l for s in E.unstack_params(stacked, spec4) for l in s]
+    for a, b in zip(want, got):
+        np.testing.assert_allclose(np.asarray(a["W"]), b["W"], rtol=3e-4, atol=3e-6)
+
+
+def test_cross_layout_resume_pipeline_to_sequential(tmp_path):
+    mesh = make_mesh(2, 4)
+    spec4 = Mo.make_model_spec(SIZES, 4, B)
+    prog = lower_schedule(S.GPipeSchedule, M, 4)
+    stacked, flags = E.init_stacked(spec4, mesh)
+    rng = np.random.RandomState(1)
+    xb = rng.randn(B, SIZES[0]).astype(np.float32)
+    yb = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, 10, B)]
+    step4 = E.make_pipeline_step(mesh, spec4, prog, B // 2 // M, SGD(0.01))
+    stacked, _ = step4(stacked, flags, jnp.asarray(xb), jnp.asarray(yb))
+
+    p = tmp_path / "ck.npz"
+    save_checkpoint(p, E.unstack_params(stacked, spec4), spec4, epoch=1)
+    loaded, spec1, _ = load_checkpoint(p, 1)
+
+    got = [l for s in loaded for l in s]
+    want = [l for s in E.unstack_params(stacked, spec4) for l in s]
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a["W"], b["W"])
+
+
+def test_save_is_atomic_and_overwrites(tmp_path):
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+    p = tmp_path / "ck.npz"
+    save_checkpoint(p, params, spec, epoch=0)
+    save_checkpoint(p, params, spec, epoch=1)  # overwrite path
+    _, _, meta = load_checkpoint(p, 1)
+    assert meta["epoch"] == 1
+    assert not list(tmp_path.glob("*.tmp"))
+
+
+def test_wrong_stage_count_shape_check(tmp_path):
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+    p = tmp_path / "ck.npz"
+    save_checkpoint(p, params, spec, epoch=0)
+    with pytest.raises(ValueError):
+        load_checkpoint(p, 3)  # 8 sizes not divisible by 3 stages

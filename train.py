@@ -46,6 +46,19 @@ def main():
     ap.add_argument("--lr", type=float, default=0.006)
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--no-eval", action="store_true", help="skip per-epoch accuracy")
+    ap.add_argument(
+        "--checkpoint", default=None, help="path to save a checkpoint after each epoch"
+    )
+    ap.add_argument(
+        "--resume",
+        default=None,
+        help="checkpoint to resume from (any layout -> any layout)",
+    )
+    ap.add_argument(
+        "--profile-dir",
+        default=None,
+        help="write a jax.profiler trace of one training epoch to this directory",
+    )
     args = ap.parse_args()
 
     import jax
@@ -55,10 +68,19 @@ def main():
     from shallowspeed_tpu import model as Mo
     from shallowspeed_tpu import schedules as S
     from shallowspeed_tpu import trainer, utils
+    from shallowspeed_tpu.checkpoint import load_checkpoint, save_checkpoint
     from shallowspeed_tpu.data import Dataset, default_data_dir
     from shallowspeed_tpu.optimizer import SGD
     from shallowspeed_tpu.parallel import executor as E
     from shallowspeed_tpu.parallel import lower_schedule, make_mesh
+
+    import contextlib
+
+    def profiled(epoch_idx):
+        """Trace exactly one epoch (the second, past compile) when asked."""
+        if args.profile_dir and epoch_idx == min(1, args.epochs - 1):
+            return jax.profiler.trace(args.profile_dir)
+        return contextlib.nullcontext()
 
     B, M = args.global_batch_size, args.mubatches
     assert B % args.dp == 0, "batch size must be divisible by DP"
@@ -85,26 +107,37 @@ def main():
         f" batches/epoch={nb}"
     )
 
+    start_epoch = 0
     if args.dp == 1 and args.pp == 1:
-        params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+        if args.resume:
+            host_params, spec, meta = load_checkpoint(args.resume, 1, B)
+            start_epoch = meta["epoch"] + 1
+            print(f"resumed from {args.resume} (epoch {meta['epoch']})")
+            params = jax.tree.map(jnp.asarray, host_params)
+        else:
+            params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
         epoch_fn = trainer.make_train_epoch(spec, opt)
         predict = trainer.make_predict(spec)
         state = ()
         Xe = X.reshape(nb, M, B // M, -1)
         Ye = Y.reshape(nb, M, B // M, -1)
         t0 = time.time()
-        for e in range(args.epochs):
+        for e in range(start_epoch, start_epoch + args.epochs):
             if not args.no_eval:
                 acc = trainer.accuracy(predict, params, vx, vy)
                 print(
                     f"Epoch: {e}, Time Spent: {time.time() - t0:.2f}s, "
                     f"Accuracy: {acc * 100:.2f}%"
                 )
-            params, state = epoch_fn(params, state, Xe, Ye)
+            with profiled(e - start_epoch):
+                params, state = epoch_fn(params, state, Xe, Ye)
+                jax.block_until_ready(params)
+            if args.checkpoint:
+                save_checkpoint(args.checkpoint, params, spec, e)
         jax.block_until_ready(params)
         acc = trainer.accuracy(predict, params, vx, vy)
         print(
-            f"Epoch: {args.epochs}, Time Spent: {time.time() - t0:.2f}s, "
+            f"Epoch: {start_epoch + args.epochs}, Time Spent: {time.time() - t0:.2f}s, "
             f"Accuracy: {acc * 100:.2f}%"
         )
         print("final model hash:", utils.model_hash(params))
@@ -114,7 +147,13 @@ def main():
     sched_cls = S.SCHEDULES[args.schedule]
     prog = lower_schedule(sched_cls, M, args.pp)
     eval_prog = lower_schedule(S.InferenceSchedule, 1, args.pp, training=False)
-    stacked, flags = E.init_stacked(spec, mesh)
+    if args.resume:
+        host_params, spec, meta = load_checkpoint(args.resume, args.pp, B)
+        start_epoch = meta["epoch"] + 1
+        print(f"resumed from {args.resume} (epoch {meta['epoch']})")
+        stacked, flags = E.put_stacked(*E.stack_params(host_params, spec), mesh)
+    else:
+        stacked, flags = E.init_stacked(spec, mesh)
     mb_sz = local_batch // M
     epoch_fn = E.make_pipeline_epoch(mesh, spec, prog, mb_sz, opt)
     # validation runs the inference tick program with one full-batch microbatch
@@ -136,19 +175,23 @@ def main():
         return correct / max(total, 1)
 
     t0 = time.time()
-    for e in range(args.epochs):
+    for e in range(start_epoch, start_epoch + args.epochs):
         if not args.no_eval:
             acc = pipeline_accuracy(stacked)
             print(
                 f"Epoch: {e}, Time Spent: {time.time() - t0:.2f}s, "
                 f"Accuracy: {acc * 100:.2f}%"
             )
-        stacked, mean_loss = epoch_fn(stacked, flags, X, Y)
+        with profiled(e - start_epoch):
+            stacked, mean_loss = epoch_fn(stacked, flags, X, Y)
+            jax.block_until_ready(stacked)
         print(f"Epoch: {e}, mean train loss: {float(mean_loss):.5f}")
+        if args.checkpoint:
+            save_checkpoint(args.checkpoint, E.unstack_params(stacked, spec), spec, e)
     jax.block_until_ready(stacked)
     acc = pipeline_accuracy(stacked)
     print(
-        f"Epoch: {args.epochs}, Time Spent: {time.time() - t0:.2f}s, "
+        f"Epoch: {start_epoch + args.epochs}, Time Spent: {time.time() - t0:.2f}s, "
         f"Accuracy: {acc * 100:.2f}%"
     )
     utils.assert_dp_replicas_in_sync(stacked)
