@@ -1,0 +1,142 @@
+"""Scaling benchmark: the five BASELINE.md configs, samples/sec + efficiency.
+
+Measures MNIST-MLP training throughput for:
+    seq          sequential (1 device)
+    dp4          DP=4
+    pp4-naive    PP=4, naive schedule
+    pp4-gpipe    PP=4, GPipe
+    dp2pp4-gpipe DP=2 x PP=4 (8 devices)
+
+and reports samples/sec plus scaling efficiency vs the sequential run
+(efficiency = throughput / (n_devices * seq_throughput)). Emits one JSON line
+per config. Configs needing more devices than available are skipped with a
+note (a single-chip host runs only `seq`; use the 8-virtual-device CPU mesh
+to exercise the rest:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 ...).
+
+NOTE on interpretation: pipeline parallelism on this tiny MLP exists to
+demonstrate/validate the machinery (the reference is an educational
+framework); per-device efficiency is expected to be <1 because the model is
+far too small to fill a pipeline — the numbers quantify schedule overhead
+(naive vs GPipe vs 1F1B bubbles), which is exactly what the reference's
+pebble diagrams illustrate.
+"""
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+SIZES = (784, 128, 127, 126, 125, 124, 123, 10)
+B, M, LR = 128, 4, 0.006
+
+
+def _data(nb, rng):
+    X = rng.rand(nb, B, SIZES[0]).astype(np.float32)
+    Y = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, B))]
+    return X, Y
+
+
+def bench_sequential(nb, reps):
+    import jax
+    import jax.numpy as jnp
+
+    from shallowspeed_tpu import model as Mo
+    from shallowspeed_tpu import trainer
+    from shallowspeed_tpu.optimizer import SGD
+
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+    epoch = trainer.make_train_epoch(spec, SGD(LR))
+    X, Y = _data(nb, np.random.RandomState(0))
+    Xe = jnp.asarray(X.reshape(nb, M, B // M, -1))
+    Ye = jnp.asarray(Y.reshape(nb, M, B // M, -1))
+    st = ()
+    params, st = epoch(params, st, Xe, Ye)
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        params, st = epoch(params, st, Xe, Ye)
+    jax.block_until_ready(params)
+    return reps * nb * B / (time.perf_counter() - t0)
+
+
+def bench_pipeline(dp, pp, sched_name, nb, reps):
+    import jax
+    import jax.numpy as jnp
+
+    from shallowspeed_tpu import model as Mo
+    from shallowspeed_tpu import schedules as S
+    from shallowspeed_tpu.optimizer import SGD
+    from shallowspeed_tpu.parallel import executor as E
+    from shallowspeed_tpu.parallel import lower_schedule, make_mesh
+
+    mesh = make_mesh(dp, pp)
+    spec = Mo.make_model_spec(SIZES, pp, B)
+    prog = lower_schedule(S.SCHEDULES[sched_name], M, pp)
+    stacked, flags = E.init_stacked(spec, mesh)
+    epoch = E.make_pipeline_epoch(mesh, spec, prog, B // dp // M, SGD(LR))
+    X, Y = _data(nb, np.random.RandomState(0))
+    Xj, Yj = jnp.asarray(X), jnp.asarray(Y)
+    stacked, _ = epoch(stacked, flags, Xj, Yj)
+    jax.block_until_ready(stacked["W"])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        stacked, _ = epoch(stacked, flags, Xj, Yj)
+    jax.block_until_ready(stacked["W"])
+    return reps * nb * B / (time.perf_counter() - t0)
+
+
+CONFIGS = [
+    ("seq", 1, 1, None),
+    ("dp4", 4, 1, "gpipe"),
+    ("pp4-naive", 1, 4, "naive"),
+    ("pp4-gpipe", 1, 4, "gpipe"),
+    ("dp2pp4-gpipe", 2, 4, "gpipe"),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, default=64, help="batches per rep")
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    n_dev = len(jax.devices())
+    results = {}
+    for name, dp, pp, sched in CONFIGS:
+        need = dp * pp
+        if need > n_dev:
+            print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
+            continue
+        if name == "seq":
+            sps = bench_sequential(args.batches, args.reps)
+        else:
+            sps = bench_pipeline(dp, pp, sched, args.batches, args.reps)
+        results[name] = sps
+        eff = (
+            sps / (need * results["seq"])
+            if "seq" in results and name != "seq"
+            else 1.0
+        )
+        print(
+            json.dumps(
+                {
+                    "config": name,
+                    "devices": need,
+                    "samples_per_sec": round(sps, 1),
+                    "efficiency_vs_seq": round(eff, 4),
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

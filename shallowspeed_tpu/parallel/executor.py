@@ -7,9 +7,11 @@ batch — every pipeline tick of every stage, the DP gradient reduction and the
 optimizer step — is ONE jitted XLA computation:
 
 - stages live on the ``pp`` mesh axis; each device holds its stage's
-  parameters as one row of zero-padded, stacked arrays (W: (S, L, D, D)), so
-  the deliberately-unequal stages (2/2/2/1 Linears at PP=4, SURVEY §7.3)
-  run under a single SPMD program;
+  parameters as one row of zero-padded stacked arrays, so the deliberately-
+  unequal stages (2/2/2/1 Linears at PP=4, SURVEY §7.3) run under a single
+  SPMD program. Padding is PER LAYER SLOT, not global: slot l is stacked to
+  ``(S, max_out_l, max_in_l)`` — for the flagship model that is (S,128,784)
+  and (S,127,128) instead of (S,2,784,784), an ~10x cut in padded FLOPs;
 - the per-batch instruction streams are pre-compiled by ``lowering`` into a
   static tick table; the executor ``lax.scan``s one tick function whose body
   ``lax.switch``es between {noop, forward, backward} — pipeline bubbles are
@@ -31,9 +33,11 @@ Zero-padding invariant: weights are zero outside each layer's logical
 width, the softmax head masks invalid columns to probability zero, and
 targets are zero-padded — so every gradient is exactly zero outside its
 logical block and padded compute is numerically inert, not approximately so.
+Width changes between slots use ``_fit`` (slice-or-pad), which is exact
+because stacked-slot widths always cover the true content (validated at
+stack time).
 """
 
-import dataclasses
 from functools import partial
 
 import jax
@@ -49,61 +53,79 @@ from shallowspeed_tpu.parallel.lowering import OP_BWD, OP_FWD, TickProgram
 
 
 # ---------------------------------------------------------------------------
-# Padded stacked parameters
+# Per-slot stacked parameters
 # ---------------------------------------------------------------------------
 
 
-@dataclasses.dataclass(frozen=True)
-class StackedDims:
-    S: int  # stages
-    L: int  # max linears per stage
-    D: int  # max activation width
+def slot_shapes(spec: ModelSpec):
+    """Static per-slot stacked dims: [(out_l, in_l)] with maxima over stages.
 
-    @staticmethod
-    def of(spec: ModelSpec):
-        return StackedDims(
-            S=spec.n_stages,
-            L=max((s.n_linears for s in spec.stages), default=0) or 1,
-            D=max(spec.sizes),
-        )
+    Also validates the passthrough-width invariant: any stage that is shorter
+    than the deepest stage must have an out_dim that fits through every
+    later slot's widths (true for the reference's monotone size lists).
+    """
+    L = max((s.n_linears for s in spec.stages), default=0) or 1
+    dims = []
+    for l in range(L):
+        outs = [s.local_sizes[l + 1] for s in spec.stages if s.n_linears > l]
+        ins = [s.local_sizes[l] for s in spec.stages if s.n_linears > l]
+        dims.append((max(outs), max(ins)))
+    for s in spec.stages:
+        for l in range(s.n_linears, L):
+            o, i = dims[l]
+            if s.out_dim > min(o, i):
+                raise ValueError(
+                    f"stage with out_dim={s.out_dim} cannot pass through slot {l} "
+                    f"of width {min(o, i)}; use equal-depth stages for this size list"
+                )
+    return dims
 
 
 def stack_params(params_list, spec: ModelSpec):
-    """Per-stage ragged params -> zero-padded stacked arrays + static flags.
+    """Per-stage ragged params -> per-slot zero-padded stacks + flags.
 
-    Returns (stacked, flags): stacked = {"W": (S,L,D,D), "b": (S,L,D)} and
-    flags = {"active": (S,L), "relu": (S,L), "head_mask": (S,D)} — all numpy,
-    caller device_puts with P('pp') sharding on the leading stage axis.
+    Returns (stacked, flags):
+      stacked = {"W": tuple_l of (S, out_l, in_l), "b": tuple_l of (S, out_l)}
+      flags   = {"active": (S,L), "relu": (S,L), "head_mask": (S, out_last)}
+    All numpy; device-put with ``put_stacked`` (P('pp') on the stage axis).
     """
-    d = StackedDims.of(spec)
-    W = np.zeros((d.S, d.L, d.D, d.D), np.float32)
-    b = np.zeros((d.S, d.L, d.D), np.float32)
-    active = np.zeros((d.S, d.L), np.bool_)
-    relu = np.zeros((d.S, d.L), np.bool_)
-    head_mask = np.zeros((d.S, d.D), np.bool_)
+    dims = slot_shapes(spec)
+    S = spec.n_stages
+    L = len(dims)
+    Ws = [np.zeros((S, o, i), np.float32) for o, i in dims]
+    bs = [np.zeros((S, o), np.float32) for o, _ in dims]
+    active = np.zeros((S, L), np.bool_)
+    relu = np.zeros((S, L), np.bool_)
+    head_mask = np.zeros((S, dims[-1][0]), np.bool_)
     for s, (sspec, sparams) in enumerate(zip(spec.stages, params_list)):
         for l, layer in enumerate(sparams):
             out_d, in_d = layer["W"].shape
-            W[s, l, :out_d, :in_d] = np.asarray(layer["W"])
-            b[s, l, :out_d] = np.asarray(layer["b"]).reshape(-1)
+            Ws[l][s, :out_d, :in_d] = np.asarray(layer["W"])
+            bs[l][s, :out_d] = np.asarray(layer["b"]).reshape(-1)
             active[s, l] = True
             relu[s, l] = sspec.relu_flags[l]
         if sspec.has_head:
             head_mask[s, : sspec.out_dim] = True
-    return {"W": W, "b": b}, {"active": active, "relu": relu, "head_mask": head_mask}
+    return (
+        {"W": tuple(Ws), "b": tuple(bs)},
+        {"active": active, "relu": relu, "head_mask": head_mask},
+    )
 
 
 def unstack_params(stacked, spec: ModelSpec):
     """Extract the logical ragged per-stage params back out (host numpy)."""
-    W = np.asarray(jax.device_get(stacked["W"]))
-    b = np.asarray(jax.device_get(stacked["b"]))
+    Ws = [np.asarray(jax.device_get(w)) for w in stacked["W"]]
+    bs = [np.asarray(jax.device_get(b)) for b in stacked["b"]]
     out = []
     for s, sspec in enumerate(spec.stages):
         layers = []
         for l in range(sspec.n_linears):
             in_d, out_d = sspec.local_sizes[l], sspec.local_sizes[l + 1]
             layers.append(
-                {"W": W[s, l, :out_d, :in_d].copy(), "b": b[s, l, :out_d].reshape(1, -1).copy()}
+                {
+                    "W": Ws[l][s, :out_d, :in_d].copy(),
+                    "b": bs[l][s, :out_d].reshape(1, -1).copy(),
+                }
             )
         out.append(layers)
     return out
@@ -130,29 +152,44 @@ def init_stacked(spec: ModelSpec, mesh: Mesh):
 # ---------------------------------------------------------------------------
 
 
-def _stage_fwd(W, b, active, relu, L, x, precision):
-    """Forward through the L padded layer slots; returns out + per-slot caches."""
+def _fit(a, width):
+    """Slice or zero-pad the last dim to ``width`` (exact under the padding
+    invariant: dropped columns are always zero)."""
+    cur = a.shape[-1]
+    if cur == width:
+        return a
+    if cur > width:
+        return a[..., :width]
+    return jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, width - cur)])
+
+
+def _stage_fwd(Ws, bs, active, relu, dims, x, precision):
+    """Forward through the per-slot stacks; returns (out, xs, masks) where
+    xs[l]: (mb, in_l) and masks[l]: (mb, out_l)."""
     xs, masks = [], []
-    for l in range(L):
-        y = ops.linear(x, W[l], b[l], precision=precision)
-        xs.append(x)
+    for l, (o, i) in enumerate(dims):
+        x_l = _fit(x, i)
+        y = ops.linear(x_l, Ws[l], bs[l], precision=precision)
+        xs.append(x_l)
         masks.append(y > 0)
         y_act = jnp.where(relu[l], ops.relu(y), y)
-        x = jnp.where(active[l], y_act, x)
-    return x, jnp.stack(xs), jnp.stack(masks)
+        x = jnp.where(active[l], y_act, _fit(x_l, o))
+    return x, tuple(xs), tuple(masks)
 
 
-def _stage_bwd(W, active, relu, L, xs, masks, g, precision):
-    """Backward through the L padded slots; returns dx + per-slot grads."""
-    gWs = [None] * L
-    gbs = [None] * L
+def _stage_bwd(Ws, active, relu, dims, xs, masks, g, precision):
+    """Backward through the per-slot stacks; returns (dx, gWs, gbs)."""
+    L = len(dims)
+    gWs, gbs = [None] * L, [None] * L
     for l in reversed(range(L)):
-        g_eff = jnp.where(relu[l], g * masks[l], g)
-        dx, dw, db = ops.linear_grad(g_eff, xs[l], W[l], precision=precision)
+        o, i = dims[l]
+        g_l = _fit(g, o)
+        g_eff = jnp.where(relu[l], g_l * masks[l], g_l)
+        dx, dw, db = ops.linear_grad(g_eff, xs[l], Ws[l], precision=precision)
         gWs[l] = jnp.where(active[l], dw, 0.0)
         gbs[l] = jnp.where(active[l], db, 0.0)
-        g = jnp.where(active[l], dx, g)
-    return g, jnp.stack(gWs), jnp.stack(gbs)
+        g = jnp.where(active[l], dx, _fit(g_l, i))
+    return g, tuple(gWs), tuple(gbs)
 
 
 def make_pipeline_step(
@@ -174,10 +211,11 @@ def make_pipeline_step(
       computes the training loss).
 
     Inference:
-        step(stacked, flags, x) -> preds (global_eval_batch, D) P('dp')
+        step(stacked, flags, x) -> preds (global_eval_batch, out_width) P('dp')
     """
-    dims = StackedDims.of(spec)
-    S_, L, D = dims.S, dims.L, dims.D
+    dims = slot_shapes(spec)
+    S_, L = spec.n_stages, len(dims)
+    D_in, D_out = dims[0][1], dims[-1][0]
     M = prog.num_micro_batches
     Kf, Kb = prog.n_fwd_slots, prog.n_bwd_slots
     mb_sz = mubatch_size
@@ -206,30 +244,31 @@ def make_pipeline_step(
 
     def per_device(stacked, flags, x, y):
         # local views: stage axis is sharded to size 1 on pp
-        W = stacked["W"][0]  # (L, D, D)
-        b = stacked["b"][0]  # (L, D)
+        Ws = [w[0] for w in stacked["W"]]  # per slot (out_l, in_l)
+        bs = [b[0] for b in stacked["b"]]
         active = flags["active"][0]  # (L,)
         relu = flags["relu"][0]
-        head_mask = flags["head_mask"][0]  # (D,)
+        head_mask = flags["head_mask"][0]  # (D_out,)
         stage = lax.axis_index("pp")
         is_first = stage == 0
         is_last = stage == S_ - 1
 
-        x = x.reshape(M, mb_sz, D)  # local dp shard, padded to D
-        y = y.reshape(M, mb_sz, D) if y is not None else None
+        x = x.reshape(M, mb_sz, D_in)  # local dp shard, padded to D_in
+        y = y.reshape(M, mb_sz, D_out) if y is not None else None
 
         carry = dict(
-            xs=jnp.zeros((M + 1, L, mb_sz, D), jnp.float32),
-            masks=jnp.zeros((M + 1, L, mb_sz, D), jnp.bool_),
-            z=jnp.zeros((M + 1, mb_sz, D), jnp.float32),
-            preds=jnp.zeros((M + 1, mb_sz, D), jnp.float32),
-            fwd_mail=jnp.zeros((Kf + 1, mb_sz, D), jnp.float32),
-            bwd_mail=jnp.zeros((Kb + 1, mb_sz, D), jnp.float32),
-            gW=jnp.zeros((L, D, D), jnp.float32),
-            gb=jnp.zeros((L, D), jnp.float32),
+            xs=tuple(jnp.zeros((M + 1, mb_sz, i), jnp.float32) for _, i in dims),
+            masks=tuple(jnp.zeros((M + 1, mb_sz, o), jnp.bool_) for o, _ in dims),
+            z=jnp.zeros((M + 1, mb_sz, D_out), jnp.float32),
+            preds=jnp.zeros((M + 1, mb_sz, D_out), jnp.float32),
+            fwd_mail=jnp.zeros((Kf + 1, mb_sz, D_in), jnp.float32),
+            bwd_mail=jnp.zeros((Kb + 1, mb_sz, D_out), jnp.float32),
+            gW=tuple(jnp.zeros((o, i), jnp.float32) for o, i in dims),
+            gb=tuple(jnp.zeros((o,), jnp.float32) for o, _ in dims),
             loss=jnp.zeros((), jnp.float32),
         )
-        zero_payload = jnp.zeros((mb_sz, D), jnp.float32)
+        zero_fwd = jnp.zeros((mb_sz, D_in), jnp.float32)
+        zero_bwd = jnp.zeros((mb_sz, D_out), jnp.float32)
 
         def tick(carry, row):
             opv = row["op"][stage]
@@ -237,16 +276,20 @@ def make_pipeline_step(
             mb_r = jnp.minimum(mb_i, M - 1)  # clamped read index
 
             def noop(c):
-                return c, zero_payload, zero_payload
+                return c, zero_fwd, zero_bwd
 
             def forward(c):
-                x_in = jnp.where(
-                    is_first, x[mb_r], c["fwd_mail"][row["rf"][stage]]
+                x_in = jnp.where(is_first, x[mb_r], c["fwd_mail"][row["rf"][stage]])
+                out, xs_l, masks_l = _stage_fwd(
+                    Ws, bs, active, relu, dims, x_in, precision
                 )
-                out, xs_l, masks_l = _stage_fwd(W, b, active, relu, L, x_in, precision)
                 c = dict(c)
-                c["xs"] = c["xs"].at[mb_i].set(xs_l)
-                c["masks"] = c["masks"].at[mb_i].set(masks_l)
+                c["xs"] = tuple(
+                    buf.at[mb_i].set(v) for buf, v in zip(c["xs"], xs_l)
+                )
+                c["masks"] = tuple(
+                    buf.at[mb_i].set(v) for buf, v in zip(c["masks"], masks_l)
+                )
                 p = ops.softmax(out, valid_mask=head_mask[None, :])
                 if training:
                     c["z"] = c["z"].at[mb_i].set(out)
@@ -254,22 +297,24 @@ def make_pipeline_step(
                     c["loss"] = c["loss"] + jnp.where(is_last, mb_loss, 0.0)
                 else:
                     c["preds"] = c["preds"].at[mb_i].set(jnp.where(is_last, p, 0.0))
-                payload = jnp.where(row["sf"][stage] == 1, out, 0.0)
-                return c, payload, zero_payload
+                payload = jnp.where(row["sf"][stage] == 1, _fit(out, D_in), 0.0)
+                return c, payload, zero_bwd
 
             def backward(c):
                 g0 = ops.softmax_mse_head_grad(
                     c["z"][mb_r], y[mb_r], B_global, valid_mask=head_mask[None, :]
                 )
                 g_in = jnp.where(is_last, g0, c["bwd_mail"][row["rb"][stage]])
+                xs_r = tuple(buf[mb_r] for buf in c["xs"])
+                masks_r = tuple(buf[mb_r] for buf in c["masks"])
                 dx, gW_d, gb_d = _stage_bwd(
-                    W, active, relu, L, c["xs"][mb_r], c["masks"][mb_r], g_in, precision
+                    Ws, active, relu, dims, xs_r, masks_r, g_in, precision
                 )
                 c = dict(c)
-                c["gW"] = c["gW"] + gW_d
-                c["gb"] = c["gb"] + gb_d
-                payload = jnp.where(row["sb"][stage] == 1, dx, 0.0)
-                return c, zero_payload, payload
+                c["gW"] = tuple(a + d for a, d in zip(c["gW"], gW_d))
+                c["gb"] = tuple(a + d for a, d in zip(c["gb"], gb_d))
+                payload = jnp.where(row["sb"][stage] == 1, _fit(dx, D_out), 0.0)
+                return c, zero_fwd, payload
 
             branches = [noop, forward] + ([backward] if training else [noop])
             carry, fwd_out, bwd_out = lax.switch(opv, branches, carry)
@@ -284,7 +329,7 @@ def make_pipeline_step(
         carry, _ = lax.scan(tick, carry, tabs)
 
         if not training:
-            preds = carry["preds"][:M].reshape(M * mb_sz, D)
+            preds = carry["preds"][:M].reshape(M * mb_sz, D_out)
             # only the last stage holds predictions; broadcast them over pp
             return lax.psum(jnp.where(is_last, preds, 0.0), "pp")
 
@@ -296,14 +341,17 @@ def make_pipeline_step(
         loss = lax.pmax(loss, "pp")  # replicate scalar across stages
 
         local = {"W": stacked["W"], "b": stacked["b"]}
-        grads = {"W": gW[None], "b": gb[None]}
+        grads = {
+            "W": tuple(g[None] for g in gW),
+            "b": tuple(g[None] for g in gb),
+        }
         new_local, _ = opt.apply(local, grads, ())
         return new_local, loss
 
-    pp_spec = P("pp")
+    pp = P("pp")
     dp_spec = P("dp")
-    flags_specs = {"active": pp_spec, "relu": pp_spec, "head_mask": pp_spec}
-    stacked_specs = {"W": pp_spec, "b": pp_spec}
+    flags_specs = {"active": pp, "relu": pp, "head_mask": pp}
+    stacked_specs = {"W": (pp,) * L, "b": (pp,) * L}
 
     if training:
         smapped = shard_map(
@@ -315,7 +363,7 @@ def make_pipeline_step(
         )
 
         def step_impl(stacked, flags, x, y):
-            return smapped(stacked, flags, _pad_last(x, D), _pad_last(y, D))
+            return smapped(stacked, flags, _fit(x, D_in), _fit(y, D_out))
 
         if jit:
             return jax.jit(step_impl, donate_argnums=(0,))
@@ -330,16 +378,9 @@ def make_pipeline_step(
     )
 
     def eval_impl(stacked, flags, x):
-        return smapped(stacked, flags, _pad_last(x, D))
+        return smapped(stacked, flags, _fit(x, D_in))
 
     return jax.jit(eval_impl) if jit else eval_impl
-
-
-def _pad_last(a, D):
-    pad = D - a.shape[-1]
-    if pad == 0:
-        return a
-    return jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)])
 
 
 def make_pipeline_epoch(mesh, spec, prog, mubatch_size, opt, precision=ops.DEFAULT_PRECISION):

@@ -83,6 +83,9 @@ def test_cross_layout_resume_sequential_to_pipeline(tmp_path):
     got = [l for s in E.unstack_params(stacked, spec4) for l in s]
     for a, b in zip(want, got):
         np.testing.assert_allclose(np.asarray(a["W"]), b["W"], rtol=3e-4, atol=3e-6)
+        np.testing.assert_allclose(
+            np.asarray(a["b"]).reshape(-1), b["b"].reshape(-1), rtol=3e-4, atol=3e-6
+        )
 
 
 def test_cross_layout_resume_pipeline_to_sequential(tmp_path):

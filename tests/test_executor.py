@@ -146,21 +146,21 @@ def test_dp_replicas_stay_in_sync():
 
 
 def test_padded_regions_stay_zero():
-    """The zero-padding invariant after real training steps."""
+    """The zero-padding invariant after real training steps (per-slot stacks)."""
     X, Y = _data(SMALL)
     stacked, spec, _, _ = _pipeline_params(SMALL, X, Y, 2, 4, S.GPipeSchedule)
-    W = np.asarray(jax.device_get(stacked["W"]))
-    b = np.asarray(jax.device_get(stacked["b"]))
+    Ws = [np.asarray(jax.device_get(w)) for w in stacked["W"]]
+    bs = [np.asarray(jax.device_get(b)) for b in stacked["b"]]
     for s, sspec in enumerate(spec.stages):
-        for l in range(W.shape[1]):
+        for l in range(len(Ws)):
             if l < sspec.n_linears:
                 out_d, in_d = sspec.local_sizes[l + 1], sspec.local_sizes[l]
-                block = W[s, l].copy()
+                block = Ws[l][s].copy()
                 block[:out_d, :in_d] = 0
                 assert (block == 0).all(), f"stage {s} layer {l} leaked outside block"
-                assert (b[s, l, out_d:] == 0).all()
+                assert (bs[l][s, out_d:] == 0).all()
             else:
-                assert (W[s, l] == 0).all() and (b[s, l] == 0).all()
+                assert (Ws[l][s] == 0).all() and (bs[l][s] == 0).all()
 
 
 def test_pipeline_inference_matches_sequential_predict():
