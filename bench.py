@@ -82,7 +82,9 @@ def jax_sps(n_epochs=5):
 
     spec = Mo.make_model_spec(SIZES, 1, B)
     params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
-    epoch = trainer.make_train_epoch(spec, SGD(LR))
+    # fuse_mubatches: identical training (sum-gradient ledger), one full-batch
+    # forward/backward per step — the TPU-shaped way to run the sequential path
+    epoch = trainer.make_train_epoch(spec, SGD(LR), fuse_mubatches=True)
 
     nb = N_SAMPLES // B
     rng = np.random.RandomState(0)

@@ -1,0 +1,75 @@
+"""Render a pipeline schedule's clock-tick program as an ASCII pebble diagram.
+
+The reference's README illustrates its schedules with a pebble-graph GIF
+(README.md:41) that is a static asset; here the diagram is generated from
+the ACTUAL lowered tick program, so what you see is exactly what the SPMD
+executor will run — forward cells, backward cells, and the bubbles.
+
+    python scripts/show_schedule.py gpipe --mubatches 4 --stages 4
+    python scripts/show_schedule.py --all
+
+Legend: F<m> forward of microbatch m · B<m> backward · '.' bubble (noop tick).
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from shallowspeed_tpu import schedules as S  # noqa: E402
+from shallowspeed_tpu.parallel.lowering import (  # noqa: E402
+    OP_BWD,
+    OP_FWD,
+    lower_schedule,
+)
+
+ALL = {**S.SCHEDULES, "inference": S.InferenceSchedule}
+
+
+def render(name, M, stages):
+    prog = lower_schedule(ALL[name], M, stages)
+    width = max(2, len(str(M - 1)) + 1)
+    busy = 0
+    lines = []
+    for s in range(stages):
+        cells = []
+        for t in range(prog.num_ticks):
+            op, mb = int(prog.op[t, s]), int(prog.mb[t, s])
+            if op == OP_FWD:
+                cells.append(f"F{mb}".ljust(width))
+                busy += 1
+            elif op == OP_BWD:
+                cells.append(f"B{mb}".ljust(width))
+                busy += 1
+            else:
+                cells.append(".".ljust(width))
+        lines.append(f"stage {s} │ " + " ".join(cells))
+    util = busy / (prog.num_ticks * stages)
+    header = (
+        f"{name}  M={M} S={stages}: {prog.num_ticks} ticks, "
+        f"utilization {util * 100:.0f}% (bubbles {100 - util * 100:.0f}%)"
+    )
+    print(header)
+    print("─" * len(header))
+    tick_hdr = "        │ " + " ".join(str(t).ljust(width) for t in range(prog.num_ticks))
+    print(tick_hdr)
+    for line in lines:
+        print(line)
+    print()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("schedule", nargs="?", choices=sorted(ALL), default=None)
+    ap.add_argument("--mubatches", "-m", type=int, default=4)
+    ap.add_argument("--stages", "-s", type=int, default=4)
+    ap.add_argument("--all", action="store_true", help="render every schedule")
+    args = ap.parse_args()
+    names = sorted(S.SCHEDULES) if args.all or not args.schedule else [args.schedule]
+    for name in names:
+        render(name, args.mubatches, args.stages)
+
+
+if __name__ == "__main__":
+    main()

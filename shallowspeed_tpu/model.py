@@ -149,11 +149,17 @@ def _placeholder(dtype=jnp.float32):
     return jnp.zeros((0,), dtype)
 
 
-def stage_forward(params, spec: StageSpec, x, precision=ops.DEFAULT_PRECISION):
+def stage_forward(
+    params, spec: StageSpec, x, precision=ops.DEFAULT_PRECISION, head_group_rows=None
+):
     """Run one stage's Linears (+head); return (out, residuals).
 
     In training the caller keeps residuals; for inference discard them (XLA
     dead-code-eliminates the cache outputs under jit).
+
+    ``head_group_rows``: when several microbatches are fused into one call,
+    the softmax head's stability max is taken per group of this many rows so
+    the result is float-identical to a per-microbatch loop.
 
     Mirrors reference Sequential.forward + Linear.forward + head modules
     (layers.py:115-122,152-155,176-180) with caches made explicit.
@@ -172,12 +178,19 @@ def stage_forward(params, spec: StageSpec, x, precision=ops.DEFAULT_PRECISION):
             x = y
     if spec.has_head:
         z = x
-        out = ops.softmax(z)
+        out = ops.softmax(z, group_rows=head_group_rows)
         return out, (tuple(caches), z)
     return x, (tuple(caches), _placeholder())
 
 
-def stage_backward(params, spec: StageSpec, residuals, dout, precision=ops.DEFAULT_PRECISION):
+def stage_backward(
+    params,
+    spec: StageSpec,
+    residuals,
+    dout,
+    precision=ops.DEFAULT_PRECISION,
+    head_group_rows=None,
+):
     """Backward through one stage; returns (dx, grads) with grads ≅ params.
 
     Contract matches the reference Worker: for the head stage ``dout`` is the
@@ -187,7 +200,9 @@ def stage_backward(params, spec: StageSpec, residuals, dout, precision=ops.DEFAU
     """
     caches, z = residuals
     if spec.has_head:
-        g = ops.softmax_mse_head_grad(z, dout, spec.global_batch_size)
+        g = ops.softmax_mse_head_grad(
+            z, dout, spec.global_batch_size, group_rows=head_group_rows
+        )
     else:
         g = dout
     grads = [None] * spec.n_linears
@@ -203,21 +218,37 @@ def stage_backward(params, spec: StageSpec, residuals, dout, precision=ops.DEFAU
     return g, grads
 
 
-def model_forward(params_list, spec: ModelSpec, x, precision=ops.DEFAULT_PRECISION):
+def model_forward(
+    params_list, spec: ModelSpec, x, precision=ops.DEFAULT_PRECISION, head_group_rows=None
+):
     """Chain all stages (the sequential / single-process path)."""
     residuals = []
     for params, sspec in zip(params_list, spec.stages):
-        x, res = stage_forward(params, sspec, x, precision=precision)
+        x, res = stage_forward(
+            params, sspec, x, precision=precision, head_group_rows=head_group_rows
+        )
         residuals.append(res)
     return x, residuals
 
 
-def model_backward(params_list, spec: ModelSpec, residuals, target, precision=ops.DEFAULT_PRECISION):
+def model_backward(
+    params_list,
+    spec: ModelSpec,
+    residuals,
+    target,
+    precision=ops.DEFAULT_PRECISION,
+    head_group_rows=None,
+):
     """Chain all stages backward; ``target`` feeds the head stage."""
     g = target
     grads_list = [None] * spec.n_stages
     for i in reversed(range(spec.n_stages)):
         g, grads_list[i] = stage_backward(
-            params_list[i], spec.stages[i], residuals[i], g, precision=precision
+            params_list[i],
+            spec.stages[i],
+            residuals[i],
+            g,
+            precision=precision,
+            head_group_rows=head_group_rows,
         )
     return g, grads_list

@@ -109,11 +109,26 @@ def linear_relu_grad_fused(g, bitmask, x, w, precision=DEFAULT_PRECISION):
     return linear_grad(relu_grad(g, bitmask), x, w, precision=precision)
 
 
-def softmax(z, valid_mask=None):
+def _stability_max(z, group_rows):
+    """The max subtracted for stability: over the WHOLE array (the
+    reference's quirk), or — with ``group_rows`` — over each consecutive
+    group of that many rows, reproducing exactly what a per-microbatch loop
+    would have computed. Grouping matters because the ``+1e-7`` denominator
+    breaks exact shift-invariance."""
+    if group_rows is None:
+        return jnp.max(z)
+    g = z.reshape(-1, group_rows, z.shape[-1])
+    m = jnp.max(g, axis=(1, 2), keepdims=True)
+    return jnp.broadcast_to(m, g.shape).reshape(z.shape)
+
+
+def softmax(z, valid_mask=None, group_rows=None):
     """Row softmax with the reference's exact quirks (functional.py:24-27):
 
     - the max subtracted for stability is the *global* max over the whole
-      array (not per-row),
+      array (not per-row) — or per consecutive ``group_rows``-row group, for
+      callers that fuse several microbatches into one call and need the
+      per-microbatch semantics float-for-float,
     - the denominator gets ``+ 1e-7``.
 
     ``valid_mask`` (broadcastable to z, True = real logit) supports the padded
@@ -122,18 +137,18 @@ def softmax(z, valid_mask=None):
     """
     if valid_mask is not None:
         z = jnp.where(valid_mask, z, _NEG_MASK)
-    z_exp = jnp.exp(z - jnp.max(z))
+    z_exp = jnp.exp(z - _stability_max(z, group_rows))
     return z_exp / (z_exp.sum(axis=1, keepdims=True) + 1e-7)
 
 
-def softmax_grad(g, z, valid_mask=None):
+def softmax_grad(g, z, valid_mask=None, group_rows=None):
     """VJP of softmax, recomputing the forward from the cached *input* z.
 
     Recomputation instead of stashing the output is deliberate: on TPU the
     extra exp/sum fuses into the backward and saves HBM traffic — and it is
     also exactly what the reference does (functional.py:30-35).
     """
-    out = softmax(z, valid_mask)
+    out = softmax(z, valid_mask, group_rows)
     gz = out * g
     return gz - out * gz.sum(axis=-1, keepdims=True)
 
@@ -153,14 +168,14 @@ def mse_loss_grad(p, t, batch_size):
     return -2.0 * (t - p) / batch_size
 
 
-@partial(jax.jit, static_argnames=("batch_size",))
-def softmax_mse_head_grad(z, t, batch_size, valid_mask=None):
+@partial(jax.jit, static_argnames=("batch_size", "group_rows"))
+def softmax_mse_head_grad(z, t, batch_size, valid_mask=None, group_rows=None):
     """Fused loss-head backward: d(MSE(softmax(z), t))/dz.
 
     The reference implements this as two chained Module backwards
     (MSELoss layers.py:157-163 then Softmax layers.py:89-93); fused here so
     XLA emits a single elementwise pipeline over the logits.
     """
-    p = softmax(z, valid_mask)
+    p = softmax(z, valid_mask, group_rows)
     g = mse_loss_grad(p, t, batch_size)
-    return softmax_grad(g, z, valid_mask)
+    return softmax_grad(g, z, valid_mask, group_rows)

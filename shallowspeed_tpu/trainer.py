@@ -26,11 +26,36 @@ from shallowspeed_tpu import ops
 from shallowspeed_tpu.model import ModelSpec, model_backward, model_forward
 
 
-def _make_batch_step(spec: ModelSpec, opt, precision):
-    """The shared per-batch body: microbatch-scan gradient accumulation +
-    optimizer apply. Used by both the per-batch step and the epoch scan."""
+def _make_batch_step(spec: ModelSpec, opt, precision, fuse_mubatches=False):
+    """The shared per-batch body: microbatch gradient accumulation + optimizer
+    apply. Used by both the per-batch step and the epoch scan.
+
+    ``fuse_mubatches=True`` computes the whole batch in ONE forward/backward
+    instead of scanning microbatches. This is the same training computation:
+    the loss is a sum scaled by the global batch size, so the full-batch
+    gradient IS the sum of microbatch gradients (the ledger the reference
+    builds its equivalence on, SURVEY §3.3), and the softmax head's
+    stability-max quirk is evaluated per microbatch-row-group
+    (``head_group_rows``) so even that grouping-sensitive detail matches the
+    scanned path float-for-float. The fused path feeds the MXU
+    microbatch-count-times larger matmuls; the microbatch path exists for
+    mechanism parity with the reference and for the pipeline executor, where
+    microbatches are semantic.
+    """
 
     def batch_step(params, opt_state, xb, yb):
+        if fuse_mubatches:
+            rows = xb.shape[1]
+            x = xb.reshape(-1, xb.shape[-1])
+            y = yb.reshape(-1, yb.shape[-1])
+            _, res = model_forward(
+                params, spec, x, precision=precision, head_group_rows=rows
+            )
+            _, grads = model_backward(
+                params, spec, res, y, precision=precision, head_group_rows=rows
+            )
+            return opt.apply(params, grads, opt_state)
+
         def accumulate(acc, mxy):
             x, y = mxy
             _, res = model_forward(params, spec, x, precision=precision)
@@ -44,19 +69,23 @@ def _make_batch_step(spec: ModelSpec, opt, precision):
     return batch_step
 
 
-def make_train_step(spec: ModelSpec, opt, precision=ops.DEFAULT_PRECISION):
+def make_train_step(
+    spec: ModelSpec, opt, precision=ops.DEFAULT_PRECISION, fuse_mubatches=False
+):
     """Returns jitted ``step(params, opt_state, xb, yb) -> (params, opt_state)``.
 
     ``xb``: (M, mubatch, in_dim); ``yb``: (M, mubatch, out_dim) one-hot.
     """
-    batch_step = _make_batch_step(spec, opt, precision)
+    batch_step = _make_batch_step(spec, opt, precision, fuse_mubatches)
     return jax.jit(batch_step, donate_argnums=(0, 1))
 
 
-def make_train_epoch(spec: ModelSpec, opt, precision=ops.DEFAULT_PRECISION):
+def make_train_epoch(
+    spec: ModelSpec, opt, precision=ops.DEFAULT_PRECISION, fuse_mubatches=False
+):
     """Whole-epoch scan: ``epoch(params, opt_state, X, Y)`` with
     X: (num_batches, M, mubatch, in_dim). One XLA program per epoch."""
-    batch_step = _make_batch_step(spec, opt, precision)
+    batch_step = _make_batch_step(spec, opt, precision, fuse_mubatches)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def epoch(params, opt_state, X, Y):

@@ -40,6 +40,19 @@ class TestShapes:
             ops.softmax(z), ops.softmax(z + 3.0), rtol=1e-4, atol=1e-6
         )
 
+    def test_softmax_group_rows_matches_per_group_loop(self):
+        """group_rows=k must reproduce exactly what softmax-per-k-row-chunk
+        computes — including under adversarial magnitude spread where the
+        +1e-7 denominator makes the grouping observable."""
+        z = np.array(r(12, 10))  # writable host copy
+        z[4:8] += 40.0  # one group's logits dwarf the others
+        z = jnp.asarray(z)
+        got = ops.softmax(z, group_rows=4)
+        want = jnp.concatenate([ops.softmax(z[i : i + 4]) for i in range(0, 12, 4)])
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # and ungrouped genuinely differs here (the quirk is observable)
+        assert not np.allclose(np.asarray(ops.softmax(z)), np.asarray(want))
+
 
 class TestGradOracle:
     """Each hand-written backward must equal jax.grad of its forward."""

@@ -1,0 +1,34 @@
+"""Smoke tests for the developer tools (pebble renderer, scaling bench CLI)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_show_schedule_renders_all(capsys):
+    sys.path.insert(0, str(ROOT / "scripts"))
+    try:
+        import show_schedule
+    finally:
+        sys.path.pop(0)
+    for name in ("gpipe", "naive", "pipedream", "inference"):
+        show_schedule.render(name, 4, 4)
+    out = capsys.readouterr().out
+    assert "utilization" in out
+    assert "F0" in out and "B0" in out
+    # GPipe's lowered latency shows up in the header
+    assert "gpipe  M=4 S=4: 14 ticks" in out
+
+
+def test_train_cli_help():
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "train.py"), "--help"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0
+    for flag in ("--dp", "--pp", "--schedule", "--checkpoint", "--resume", "--precision"):
+        assert flag in r.stdout

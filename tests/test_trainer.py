@@ -77,6 +77,30 @@ def test_mubatch_count_invariance():
             np.testing.assert_allclose(w0, w1, rtol=1e-5, atol=1e-7)
 
 
+def test_fused_mubatches_matches_scanned():
+    """fuse_mubatches=True must train to the same weights as the microbatch
+    scan — the sum-gradient ledger makes them the same computation, and the
+    softmax head's stability max is grouped per microbatch. The data is made
+    adversarial: one microbatch's inputs are scaled 50x so its logits dwarf
+    the others' — exactly the case where an ungrouped global max would make
+    the fused path diverge through the +1e-7 softmax denominator."""
+    spec = M.make_model_spec(SIZES, 1, B)
+    rng = np.random.RandomState(7)
+    X, Y = _data(4, 4, rng)
+    X[:, 2] *= 50.0  # adversarial magnitude spread across microbatches
+    results = []
+    for fuse in (False, True):
+        params = jax.tree.map(jnp.asarray, M.init_model(spec))
+        step = trainer.make_train_step(spec, SGD(LR), fuse_mubatches=fuse)
+        st = ()
+        for b in range(4):
+            params, st = step(params, st, jnp.asarray(X[b]), jnp.asarray(Y[b]))
+        results.append(_flat(params))
+    for (w0, b0), (w1, b1) in zip(*results):
+        np.testing.assert_allclose(w0, w1, rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(b0, b1, rtol=1e-5, atol=1e-7)
+
+
 def test_epoch_scan_matches_per_batch_steps():
     spec = M.make_model_spec(SIZES, 1, B)
     rng = np.random.RandomState(2)

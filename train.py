@@ -59,6 +59,13 @@ def main():
         default=None,
         help="write a jax.profiler trace of one training epoch to this directory",
     )
+    ap.add_argument(
+        "--precision",
+        choices=["highest", "default"],
+        default="highest",
+        help="matmul precision: 'highest' = fp32 parity with the NumPy "
+        "reference; 'default' = let the MXU use fast (bf16-input) passes",
+    )
     args = ap.parse_args()
 
     import jax
@@ -81,6 +88,12 @@ def main():
         if args.profile_dir and epoch_idx == min(1, args.epochs - 1):
             return jax.profiler.trace(args.profile_dir)
         return contextlib.nullcontext()
+
+    from jax import lax as _lax
+
+    precision = (
+        _lax.Precision.HIGHEST if args.precision == "highest" else _lax.Precision.DEFAULT
+    )
 
     B, M = args.global_batch_size, args.mubatches
     assert B % args.dp == 0, "batch size must be divisible by DP"
@@ -116,8 +129,8 @@ def main():
             params = jax.tree.map(jnp.asarray, host_params)
         else:
             params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
-        epoch_fn = trainer.make_train_epoch(spec, opt)
-        predict = trainer.make_predict(spec)
+        epoch_fn = trainer.make_train_epoch(spec, opt, precision=precision)
+        predict = trainer.make_predict(spec, precision=precision)
         state = ()
         Xe = X.reshape(nb, M, B // M, -1)
         Ye = Y.reshape(nb, M, B // M, -1)
@@ -155,10 +168,10 @@ def main():
     else:
         stacked, flags = E.init_stacked(spec, mesh)
     mb_sz = local_batch // M
-    epoch_fn = E.make_pipeline_epoch(mesh, spec, prog, mb_sz, opt)
+    epoch_fn = E.make_pipeline_epoch(mesh, spec, prog, mb_sz, opt, precision=precision)
     # validation runs the inference tick program with one full-batch microbatch
     # on a pp-only slice of the mesh semantics (dp shards the val batch too)
-    eval_step = E.make_pipeline_step(mesh, spec, eval_prog, B // args.dp)
+    eval_step = E.make_pipeline_step(mesh, spec, eval_prog, B // args.dp, precision=precision)
 
     def pipeline_accuracy(stacked):
         """Full-split accuracy; the ragged tail chunk is zero-padded up to B
