@@ -64,9 +64,18 @@ def main():
     ap.add_argument("schedule", nargs="?", choices=sorted(ALL), default=None)
     ap.add_argument("--mubatches", "-m", type=int, default=4)
     ap.add_argument("--stages", "-s", type=int, default=4)
-    ap.add_argument("--all", action="store_true", help="render every schedule")
+    ap.add_argument(
+        "--all",
+        action="store_true",
+        help="render every schedule, including the forward-only inference relay",
+    )
     args = ap.parse_args()
-    names = sorted(S.SCHEDULES) if args.all or not args.schedule else [args.schedule]
+    if args.schedule and not args.all:
+        names = [args.schedule]
+    elif args.all:
+        names = sorted(ALL)
+    else:
+        names = sorted(S.SCHEDULES)
     for name in names:
         render(name, args.mubatches, args.stages)
 

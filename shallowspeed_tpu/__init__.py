@@ -24,7 +24,16 @@ Architecture (TPU-first, not a port):
 - ``optimizer``  SGD over pytrees, applied on-device inside the jitted step.
 """
 
-from shallowspeed_tpu import data, model, ops, optimizer, schedules, utils
+from shallowspeed_tpu import (
+    checkpoint,
+    data,
+    model,
+    ops,
+    optimizer,
+    schedules,
+    trainer,
+    utils,
+)
 from shallowspeed_tpu.model import ModelSpec, StageSpec, init_model, partition_sizes
 
 __version__ = "0.1.0"

@@ -1,0 +1,24 @@
+"""Multi-host wrapper smoke tests (single-process semantics only — the CI
+environment has no second host; the executor itself is the tested surface)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from shallowspeed_tpu.parallel import make_mesh, multihost
+
+
+def test_initialize_is_noop_single_process():
+    multihost.initialize()  # must not raise without a coordinator
+    assert jax.process_count() == 1
+
+
+def test_shard_batch_for_process_places_on_mesh():
+    mesh = make_mesh(2, 4)
+    x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    arr = multihost.shard_batch_for_process(x, mesh, P("dp"))
+    assert arr.shape == (16, 3)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    # sharded over dp, replicated over pp: 8 devices, 2 distinct row-shards
+    assert len({s.index for s in arr.addressable_shards}) == 2

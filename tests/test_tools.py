@@ -8,11 +8,12 @@ ROOT = Path(__file__).resolve().parent.parent
 
 
 def test_show_schedule_renders_all(capsys):
-    sys.path.insert(0, str(ROOT / "scripts"))
+    scripts_dir = str(ROOT / "scripts")
+    sys.path.insert(0, scripts_dir)
     try:
         import show_schedule
     finally:
-        sys.path.pop(0)
+        sys.path.remove(scripts_dir)
     for name in ("gpipe", "naive", "pipedream", "inference"):
         show_schedule.render(name, 4, 4)
     out = capsys.readouterr().out
