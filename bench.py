@@ -94,11 +94,11 @@ def jax_sps(n_epochs=5):
     )
 
     state = ()
-    params, state = epoch(params, state, X, Y)  # compile + warmup
+    params, state, _ = epoch(params, state, X, Y)  # compile + warmup
     jax.block_until_ready(params)
     t0 = time.perf_counter()
     for _ in range(n_epochs):
-        params, state = epoch(params, state, X, Y)
+        params, state, _ = epoch(params, state, X, Y)
     jax.block_until_ready(params)
     dt = time.perf_counter() - t0
     return n_epochs * nb * B / dt

@@ -57,11 +57,11 @@ def bench_sequential(nb, reps):
     Xe = jnp.asarray(X.reshape(nb, M, B // M, -1))
     Ye = jnp.asarray(Y.reshape(nb, M, B // M, -1))
     st = ()
-    params, st = epoch(params, st, Xe, Ye)
+    params, st, _ = epoch(params, st, Xe, Ye)
     jax.block_until_ready(params)
     t0 = time.perf_counter()
     for _ in range(reps):
-        params, st = epoch(params, st, Xe, Ye)
+        params, st, _ = epoch(params, st, Xe, Ye)
     jax.block_until_ready(params)
     return reps * nb * B / (time.perf_counter() - t0)
 

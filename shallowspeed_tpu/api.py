@@ -1,0 +1,182 @@
+"""High-level programmatic API: one object that wires the whole framework.
+
+The reference's user assembles communicators, model, dataset, optimizer and
+Worker by hand in train.py (train.py:87-129); here the same wiring is a
+library object, so notebooks/tests/benchmarks get everything the CLI does:
+
+    from shallowspeed_tpu.api import TrainingSession
+
+    run = TrainingSession(dp=2, pp=4, schedule="gpipe", data_dir="data/mnist_784")
+    for _ in range(20):
+        loss = run.train_epoch()
+        print(run.epoch, loss, run.accuracy())
+    run.save("ck.npz")
+
+Layouts are uniform: dp=pp=1 uses the fast sequential jitted path, anything
+else the SPMD pipeline executor — same weights either way (tested layout
+equivalence).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from shallowspeed_tpu import model as Mo
+from shallowspeed_tpu import schedules as S
+from shallowspeed_tpu import trainer, utils
+from shallowspeed_tpu.checkpoint import load_checkpoint, save_checkpoint
+from shallowspeed_tpu.data import Dataset, default_data_dir
+from shallowspeed_tpu.optimizer import SGD
+from shallowspeed_tpu.parallel import executor as E
+from shallowspeed_tpu.parallel import lower_schedule, make_mesh
+
+FLAGSHIP_SIZES = (784, 128, 127, 126, 125, 124, 123, 10)
+
+_PRECISIONS = {
+    "highest": lax.Precision.HIGHEST,
+    "default": lax.Precision.DEFAULT,
+}
+
+
+class TrainingSession:
+    """End-to-end training run: data + model + layout + optimizer + eval."""
+
+    def __init__(
+        self,
+        sizes=FLAGSHIP_SIZES,
+        dp=1,
+        pp=1,
+        schedule="gpipe",
+        global_batch_size=128,
+        mubatches=4,
+        lr=0.006,
+        precision="highest",
+        data_dir=None,
+        resume=None,
+        devices=None,
+    ):
+        if global_batch_size % dp != 0:
+            raise ValueError("global batch size must be divisible by dp")
+        local_batch = global_batch_size // dp
+        if local_batch % mubatches != 0:
+            raise ValueError("mubatches must divide the local batch")
+        self.dp, self.pp = dp, pp
+        self.B, self.M = global_batch_size, mubatches
+        self.schedule = schedule
+        if precision not in _PRECISIONS:
+            raise ValueError(
+                f"precision must be one of {sorted(_PRECISIONS)}, got {precision!r}"
+            )
+        self.precision = _PRECISIONS[precision]
+        self.epoch = 0
+
+        data_dir = data_dir or default_data_dir()
+        self._train_ds = Dataset(data_dir, self.B, mubatch_size=local_batch // mubatches)
+        self._train_ds.load(0, 1)
+        self._val = Dataset(data_dir, self.B, mubatch_size=self.B, validation=True)
+        self._val.load(0, 1)
+        self._vx = jnp.asarray(self._val.input_X)
+        self._vy = jnp.asarray(self._val.target_y)
+
+        nb = self._train_ds.get_num_batches()
+        Xb, Yb = self._train_ds.epoch_arrays()
+        self._X = jnp.asarray(Xb.reshape(nb, self.B, Xb.shape[-1]))
+        self._Y = jnp.asarray(Yb.reshape(nb, self.B, Yb.shape[-1]))
+        self.batches_per_epoch = nb
+
+        self.spec = Mo.make_model_spec(sizes, pp, self.B)
+        opt = SGD(lr)
+        self._sequential = dp == 1 and pp == 1
+
+        if resume is not None:
+            host_params, loaded_spec, meta = load_checkpoint(resume, pp, self.B)
+            if tuple(loaded_spec.sizes) != tuple(self.spec.sizes):
+                raise ValueError(
+                    f"checkpoint sizes {loaded_spec.sizes} do not match the "
+                    f"requested model sizes {self.spec.sizes}"
+                )
+            self.spec = loaded_spec
+            self.epoch = meta["epoch"] + 1
+        else:
+            host_params = Mo.init_model(self.spec)
+
+        if self._sequential:
+            self._params = jax.tree.map(jnp.asarray, host_params)
+            self._opt_state = ()
+            self._epoch_fn = trainer.make_train_epoch(
+                self.spec, opt, precision=self.precision
+            )
+            self._predict = trainer.make_predict(self.spec, precision=self.precision)
+            self._Xe = self._X.reshape(nb, self.M, self.B // self.M, -1)
+            self._Ye = self._Y.reshape(nb, self.M, self.B // self.M, -1)
+            self._X = self._Y = None  # the microbatched views are the only users
+        else:
+            self.mesh = make_mesh(dp, pp, devices)
+            prog = lower_schedule(S.SCHEDULES[schedule], mubatches, pp)
+            eval_prog = lower_schedule(S.InferenceSchedule, 1, pp, training=False)
+            self._stacked, self._flags = E.put_stacked(
+                *E.stack_params(host_params, self.spec), self.mesh
+            )
+            self._epoch_fn = E.make_pipeline_epoch(
+                self.mesh, self.spec, prog, local_batch // mubatches, opt,
+                precision=self.precision,
+            )
+            self._eval_step = E.make_pipeline_step(
+                self.mesh, self.spec, eval_prog, self.B // dp, precision=self.precision
+            )
+
+    # -- training -----------------------------------------------------------
+
+    def train_epoch(self) -> float:
+        """One epoch over the training shard; returns the mean batch training
+        loss (same definition on both layouts: global-batch-scaled MSE of each
+        batch under its pre-update params, averaged over the epoch)."""
+        if self._sequential:
+            self._params, self._opt_state, mean_loss = self._epoch_fn(
+                self._params, self._opt_state, self._Xe, self._Ye
+            )
+        else:
+            self._stacked, mean_loss = self._epoch_fn(
+                self._stacked, self._flags, self._X, self._Y
+            )
+        self.epoch += 1
+        return float(mean_loss)
+
+    # -- evaluation ---------------------------------------------------------
+
+    def accuracy(self) -> float:
+        """Argmax accuracy over the full validation split."""
+        if self._sequential:
+            return trainer.accuracy(self._predict, self._params, self._vx, self._vy)
+        out_dim = self.spec.out_dim
+        correct = total = 0
+        for i in range(0, len(self._vx), self.B):
+            xb, yb = self._vx[i : i + self.B], self._vy[i : i + self.B]
+            n_valid = xb.shape[0]
+            if n_valid < self.B:
+                xb = jnp.pad(xb, ((0, self.B - n_valid), (0, 0)))
+            preds = self._eval_step(self._stacked, self._flags, xb)[:n_valid]
+            correct += int(
+                (jnp.argmax(preds[:, :out_dim], 1) == jnp.argmax(yb, 1)).sum()
+            )
+            total += n_valid
+        return correct / max(total, 1)
+
+    # -- state --------------------------------------------------------------
+
+    def params(self):
+        """Logical per-stage params (host numpy), layout-independent order."""
+        if self._sequential:
+            return jax.device_get(self._params)
+        return E.unstack_params(self._stacked, self.spec)
+
+    def model_hash(self) -> str:
+        return utils.model_hash(self.params())
+
+    def assert_replicas_in_sync(self):
+        if not self._sequential:
+            utils.assert_dp_replicas_in_sync(self._stacked)
+
+    def save(self, path):
+        save_checkpoint(path, self.params(), self.spec, self.epoch - 1)

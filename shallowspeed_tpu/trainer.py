@@ -44,27 +44,36 @@ def _make_batch_step(spec: ModelSpec, opt, precision, fuse_mubatches=False):
     """
 
     def batch_step(params, opt_state, xb, yb):
+        """Returns (params, opt_state, batch_loss) — the loss is the global-
+        batch-scaled MSE of the batch under the pre-update params."""
         if fuse_mubatches:
             rows = xb.shape[1]
             x = xb.reshape(-1, xb.shape[-1])
             y = yb.reshape(-1, yb.shape[-1])
-            _, res = model_forward(
+            out, res = model_forward(
                 params, spec, x, precision=precision, head_group_rows=rows
             )
             _, grads = model_backward(
                 params, spec, res, y, precision=precision, head_group_rows=rows
             )
-            return opt.apply(params, grads, opt_state)
+            loss = ops.mse_loss(out, y, spec.global_batch_size)
+            params, opt_state = opt.apply(params, grads, opt_state)
+            return params, opt_state, loss
 
-        def accumulate(acc, mxy):
+        def accumulate(carry, mxy):
+            acc, loss = carry
             x, y = mxy
-            _, res = model_forward(params, spec, x, precision=precision)
+            out, res = model_forward(params, spec, x, precision=precision)
             _, grads = model_backward(params, spec, res, y, precision=precision)
-            return jax.tree.map(jnp.add, acc, grads), None
+            loss = loss + ops.mse_loss(out, y, spec.global_batch_size)
+            return (jax.tree.map(jnp.add, acc, grads), loss), None
 
         zeros = jax.tree.map(jnp.zeros_like, params)
-        grads, _ = lax.scan(accumulate, zeros, (xb, yb))
-        return opt.apply(params, grads, opt_state)
+        (grads, loss), _ = lax.scan(
+            accumulate, (zeros, jnp.zeros(())), (xb, yb)
+        )
+        params, opt_state = opt.apply(params, grads, opt_state)
+        return params, opt_state, loss
 
     return batch_step
 
@@ -77,24 +86,34 @@ def make_train_step(
     ``xb``: (M, mubatch, in_dim); ``yb``: (M, mubatch, out_dim) one-hot.
     """
     batch_step = _make_batch_step(spec, opt, precision, fuse_mubatches)
-    return jax.jit(batch_step, donate_argnums=(0, 1))
+
+    def step(params, opt_state, xb, yb):
+        params, opt_state, _ = batch_step(params, opt_state, xb, yb)
+        return params, opt_state
+
+    return jax.jit(step, donate_argnums=(0, 1))
 
 
 def make_train_epoch(
     spec: ModelSpec, opt, precision=ops.DEFAULT_PRECISION, fuse_mubatches=False
 ):
-    """Whole-epoch scan: ``epoch(params, opt_state, X, Y)`` with
-    X: (num_batches, M, mubatch, in_dim). One XLA program per epoch."""
+    """Whole-epoch scan: ``epoch(params, opt_state, X, Y) -> (params,
+    opt_state, mean_loss)`` with X: (num_batches, M, mubatch, in_dim). One
+    XLA program per epoch; mean_loss is the true mean batch training loss
+    (same definition as the pipeline executor's)."""
     batch_step = _make_batch_step(spec, opt, precision, fuse_mubatches)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def epoch(params, opt_state, X, Y):
         def body(carry, xy):
-            new = batch_step(*carry, *xy)
-            return new, None
+            params, opt_state, loss_sum = carry
+            params, opt_state, loss = batch_step(params, opt_state, *xy)
+            return (params, opt_state, loss_sum + loss), None
 
-        (params, opt_state), _ = lax.scan(body, (params, opt_state), (X, Y))
-        return params, opt_state
+        (params, opt_state, loss_sum), _ = lax.scan(
+            body, (params, opt_state, jnp.zeros(())), (X, Y)
+        )
+        return params, opt_state, loss_sum / X.shape[0]
 
     return epoch
 

@@ -114,7 +114,8 @@ def test_epoch_scan_matches_per_batch_steps():
 
     params2 = jax.tree.map(jnp.asarray, M.init_model(spec))
     epoch = trainer.make_train_epoch(spec, SGD(LR))
-    params2, _ = epoch(params2, (), jnp.asarray(X), jnp.asarray(Y))
+    params2, _, mean_loss = epoch(params2, (), jnp.asarray(X), jnp.asarray(Y))
+    assert float(mean_loss) > 0.0
 
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),

@@ -3,16 +3,18 @@
 Same surface: ``python train.py [--dp N] [--pp M] [--schedule naive|gpipe|pipedream]``
 (reference train.py:62-74), same flagship model (sizes [784,128,127,126,125,
 124,123,10], train.py:98), same constants (EPOCHS=20, GLOBAL_BATCH_SIZE=128,
-N_MUBATCHES=4, lr=0.006), same epoch structure (per-epoch validation accuracy
-from the last stage, final replica-sync check).
+N_MUBATCHES=4, lr=0.006), same epoch structure (per-epoch validation accuracy,
+final replica-sync check).
 
 Differences by design:
 - no mpirun: ONE process drives the whole (dp, pp) device mesh; the two MPI
   communicators become mesh axes (parallel/mesh.py);
 - the per-batch instruction streams are compiled once to a tick program and
   the whole epoch runs as one jitted scan on device;
-- extra flags (epochs, batch size, lr, data dir, platform) are exposed
-  instead of module constants.
+- extra capability flags: checkpoints, resume, profiling, precision.
+
+All wiring lives in shallowspeed_tpu.api.TrainingSession — this file is the
+argument surface plus the reporting loop.
 
 Examples:
     python train.py                      # sequential, 1 device
@@ -25,9 +27,8 @@ On a single-chip host, multi-device layouts run on emulated CPU devices:
 """
 
 import argparse
+import contextlib
 import time
-
-LAYER_SIZES = (784, 128, 127, 126, 125, 124, 123, 10)
 
 
 def main():
@@ -69,147 +70,58 @@ def main():
     args = ap.parse_args()
 
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
-    from shallowspeed_tpu import model as Mo
-    from shallowspeed_tpu import schedules as S
-    from shallowspeed_tpu import trainer, utils
-    from shallowspeed_tpu.checkpoint import load_checkpoint, save_checkpoint
-    from shallowspeed_tpu.data import Dataset, default_data_dir
-    from shallowspeed_tpu.optimizer import SGD
-    from shallowspeed_tpu.parallel import executor as E
-    from shallowspeed_tpu.parallel import lower_schedule, make_mesh
+    from shallowspeed_tpu.api import TrainingSession
 
-    import contextlib
+    run = TrainingSession(
+        dp=args.dp,
+        pp=args.pp,
+        schedule=args.schedule,
+        global_batch_size=args.global_batch_size,
+        mubatches=args.mubatches,
+        lr=args.lr,
+        precision=args.precision,
+        data_dir=args.data_dir,
+        resume=args.resume,
+    )
+    if args.dp == 1 and args.pp == 1:
+        layout = "sequential"
+    elif args.pp == 1:
+        layout = "data-parallel"
+    else:
+        layout = f"{args.schedule} pipeline"
+    print(
+        f"devices={jax.devices()} layout: DP={args.dp} x PP={args.pp} ({layout}) "
+        f"batches/epoch={run.batches_per_epoch}"
+        + (f" resumed at epoch {run.epoch}" if args.resume else "")
+    )
 
-    def profiled(epoch_idx):
-        """Trace exactly one epoch (the second, past compile) when asked."""
-        if args.profile_dir and epoch_idx == min(1, args.epochs - 1):
+    def profiled(i):
+        # trace one post-compile epoch when asked
+        if args.profile_dir and i == min(1, args.epochs - 1):
             return jax.profiler.trace(args.profile_dir)
         return contextlib.nullcontext()
 
-    from jax import lax as _lax
-
-    precision = (
-        _lax.Precision.HIGHEST if args.precision == "highest" else _lax.Precision.DEFAULT
-    )
-
-    B, M = args.global_batch_size, args.mubatches
-    assert B % args.dp == 0, "batch size must be divisible by DP"
-    local_batch = B // args.dp
-    assert local_batch % M == 0, "microbatches must divide the local batch"
-    data_dir = args.data_dir or default_data_dir()
-
-    ds = Dataset(data_dir, B, mubatch_size=local_batch // M)
-    ds.load(0, 1)  # one process holds the global batch; the mesh shards it
-    val = Dataset(data_dir, B, mubatch_size=B, validation=True)
-    val.load(0, 1)
-    vx, vy = jnp.asarray(val.input_X), jnp.asarray(val.target_y)
-
-    spec = Mo.make_model_spec(LAYER_SIZES, args.pp, B)
-    opt = SGD(args.lr)
-    nb = ds.get_num_batches()
-    Xb, Yb = ds.epoch_arrays()  # (nb, M, mb_local*dp, d) ordering: global batches
-    X = jnp.asarray(Xb.reshape(nb, B, Xb.shape[-1]))
-    Y = jnp.asarray(Yb.reshape(nb, B, Yb.shape[-1]))
-
-    print(
-        f"devices={jax.devices()} layout: DP={args.dp} x PP={args.pp}"
-        f" schedule={args.schedule if args.pp > 1 else 'sequential'}"
-        f" batches/epoch={nb}"
-    )
-
-    start_epoch = 0
-    if args.dp == 1 and args.pp == 1:
-        if args.resume:
-            host_params, spec, meta = load_checkpoint(args.resume, 1, B)
-            start_epoch = meta["epoch"] + 1
-            print(f"resumed from {args.resume} (epoch {meta['epoch']})")
-            params = jax.tree.map(jnp.asarray, host_params)
-        else:
-            params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
-        epoch_fn = trainer.make_train_epoch(spec, opt, precision=precision)
-        predict = trainer.make_predict(spec, precision=precision)
-        state = ()
-        Xe = X.reshape(nb, M, B // M, -1)
-        Ye = Y.reshape(nb, M, B // M, -1)
-        t0 = time.time()
-        for e in range(start_epoch, start_epoch + args.epochs):
-            if not args.no_eval:
-                acc = trainer.accuracy(predict, params, vx, vy)
-                print(
-                    f"Epoch: {e}, Time Spent: {time.time() - t0:.2f}s, "
-                    f"Accuracy: {acc * 100:.2f}%"
-                )
-            with profiled(e - start_epoch):
-                params, state = epoch_fn(params, state, Xe, Ye)
-                jax.block_until_ready(params)
-            if args.checkpoint:
-                save_checkpoint(args.checkpoint, params, spec, e)
-        jax.block_until_ready(params)
-        acc = trainer.accuracy(predict, params, vx, vy)
-        print(
-            f"Epoch: {start_epoch + args.epochs}, Time Spent: {time.time() - t0:.2f}s, "
-            f"Accuracy: {acc * 100:.2f}%"
-        )
-        print("final model hash:", utils.model_hash(params))
-        return
-
-    mesh = make_mesh(args.dp, args.pp)
-    sched_cls = S.SCHEDULES[args.schedule]
-    prog = lower_schedule(sched_cls, M, args.pp)
-    eval_prog = lower_schedule(S.InferenceSchedule, 1, args.pp, training=False)
-    if args.resume:
-        host_params, spec, meta = load_checkpoint(args.resume, args.pp, B)
-        start_epoch = meta["epoch"] + 1
-        print(f"resumed from {args.resume} (epoch {meta['epoch']})")
-        stacked, flags = E.put_stacked(*E.stack_params(host_params, spec), mesh)
-    else:
-        stacked, flags = E.init_stacked(spec, mesh)
-    mb_sz = local_batch // M
-    epoch_fn = E.make_pipeline_epoch(mesh, spec, prog, mb_sz, opt, precision=precision)
-    # validation runs the inference tick program with one full-batch microbatch
-    # on a pp-only slice of the mesh semantics (dp shards the val batch too)
-    eval_step = E.make_pipeline_step(mesh, spec, eval_prog, B // args.dp, precision=precision)
-
-    def pipeline_accuracy(stacked):
-        """Full-split accuracy; the ragged tail chunk is zero-padded up to B
-        and only its valid rows are counted (eval shapes stay static)."""
-        correct = total = 0
-        for i in range(0, len(val.input_X), B):
-            xb, yb = vx[i : i + B], vy[i : i + B]
-            n_valid = xb.shape[0]
-            if n_valid < B:
-                xb = jnp.pad(xb, ((0, B - n_valid), (0, 0)))
-            preds = eval_step(stacked, flags, xb)[:n_valid]
-            correct += int((jnp.argmax(preds[:, :10], 1) == jnp.argmax(yb, 1)).sum())
-            total += n_valid
-        return correct / max(total, 1)
-
     t0 = time.time()
-    for e in range(start_epoch, start_epoch + args.epochs):
+    for i in range(args.epochs):
         if not args.no_eval:
-            acc = pipeline_accuracy(stacked)
             print(
-                f"Epoch: {e}, Time Spent: {time.time() - t0:.2f}s, "
-                f"Accuracy: {acc * 100:.2f}%"
+                f"Epoch: {run.epoch}, Time Spent: {time.time() - t0:.2f}s, "
+                f"Accuracy: {run.accuracy() * 100:.2f}%"
             )
-        with profiled(e - start_epoch):
-            stacked, mean_loss = epoch_fn(stacked, flags, X, Y)
-            jax.block_until_ready(stacked)
-        print(f"Epoch: {e}, mean train loss: {float(mean_loss):.5f}")
+        with profiled(i):
+            loss = run.train_epoch()
+        print(f"Epoch: {run.epoch - 1}, mean train loss: {loss:.5f}")
         if args.checkpoint:
-            save_checkpoint(args.checkpoint, E.unstack_params(stacked, spec), spec, e)
-    jax.block_until_ready(stacked)
-    acc = pipeline_accuracy(stacked)
+            run.save(args.checkpoint)
     print(
-        f"Epoch: {start_epoch + args.epochs}, Time Spent: {time.time() - t0:.2f}s, "
-        f"Accuracy: {acc * 100:.2f}%"
+        f"Epoch: {run.epoch}, Time Spent: {time.time() - t0:.2f}s, "
+        f"Accuracy: {run.accuracy() * 100:.2f}%"
     )
-    utils.assert_dp_replicas_in_sync(stacked)
-    print("DP replicas in sync ✓")
-    print("final model hash:", utils.model_hash(E.unstack_params(stacked, spec)))
+    run.assert_replicas_in_sync()
+    if args.dp > 1:
+        print("DP replicas in sync ✓")
+    print("final model hash:", run.model_hash())
 
 
 if __name__ == "__main__":
