@@ -18,9 +18,53 @@ report end-to-end training throughput.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+
+def _ensure_responsive_backend(probe_timeout_s=180):
+    """Never hang the benchmark on a wedged accelerator tunnel.
+
+    Backend init for a remote-tunneled TPU can block indefinitely if the
+    chip's claim is held by a dead client. When the tunnel plugin is active
+    (PALLAS_AXON_POOL_IPS — the only configuration where the hang exists),
+    probe device init in a subprocess; on timeout, fall back to the CPU
+    platform. Returns True when the fallback was taken so the caller can
+    label the published metric honestly.
+
+    Output pipes go to DEVNULL: with captured pipes, a tunnel helper
+    grandchild surviving the timeout kill would keep them open and make the
+    probe itself hang in communicate().
+    """
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return False  # no tunnel plugin, nothing to guard (and nothing to pay)
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout_s,
+            check=True,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        return False
+    except subprocess.TimeoutExpired:
+        print(
+            "bench: accelerator backend unresponsive "
+            f"(> {probe_timeout_s}s to init); falling back to CPU",
+            file=sys.stderr,
+        )
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except subprocess.CalledProcessError:
+        return False  # probe failed fast; let the real run report the error
 
 SIZES = (784, 128, 127, 126, 125, 124, 123, 10)
 B, M, LR = 128, 4, 0.006
@@ -105,12 +149,17 @@ def jax_sps(n_epochs=5):
 
 
 def main():
+    fell_back = _ensure_responsive_backend()
     baseline = numpy_baseline_sps()
     value = jax_sps()
+    metric = "mnist_mlp_train_samples_per_sec_per_chip"
+    if fell_back:
+        # make a degraded run unmistakable in the recorded metric itself
+        metric += "_CPU_FALLBACK_TUNNEL_DOWN"
     print(
         json.dumps(
             {
-                "metric": "mnist_mlp_train_samples_per_sec_per_chip",
+                "metric": metric,
                 "value": round(value, 1),
                 "unit": "samples/s",
                 "vs_baseline": round(value / baseline, 2),
