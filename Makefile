@@ -1,0 +1,30 @@
+# Convenience targets. The CPU_MESH prefix runs any layout on 8 emulated
+# devices (and keeps the TPU tunnel plugin out of CPU-only processes).
+CPU_MESH = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+           XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test data train train-mesh bench bench-scaling schedules clean
+
+test:
+	python -m pytest tests/ -q
+
+data:
+	python prepare_data.py
+
+train:
+	python train.py --epochs 5
+
+train-mesh:
+	$(CPU_MESH) python train.py --dp 2 --pp 4 --schedule gpipe --epochs 2
+
+bench:
+	python bench.py
+
+bench-scaling:
+	$(CPU_MESH) python scripts/bench_scaling.py
+
+schedules:
+	$(CPU_MESH) python scripts/show_schedule.py --all
+
+clean:
+	rm -rf .pytest_cache */__pycache__ __pycache__ tests/__pycache__
