@@ -6,5 +6,5 @@ setup(
     description="TPU-native distributed-training framework (DP x PP on a JAX mesh)",
     packages=find_packages(include=["shallowspeed_tpu", "shallowspeed_tpu.*"]),
     python_requires=">=3.10",
-    install_requires=["jax", "numpy"],
+    install_requires=["jax>=0.7", "numpy"],
 )

@@ -68,6 +68,10 @@ class TrainingSession:
             raise ValueError(
                 f"precision must be one of {sorted(_PRECISIONS)}, got {precision!r}"
             )
+        if schedule not in S.SCHEDULES:
+            raise ValueError(
+                f"schedule must be one of {sorted(S.SCHEDULES)}, got {schedule!r}"
+            )
         self.precision = _PRECISIONS[precision]
         self.epoch = 0
 
@@ -80,6 +84,11 @@ class TrainingSession:
         self._vy = jnp.asarray(self._val.target_y)
 
         nb = self._train_ds.get_num_batches()
+        if nb == 0:
+            raise ValueError(
+                f"training split has {self._train_ds.raw_len} samples — fewer "
+                f"than one global batch of {self.B}"
+            )
         Xb, Yb = self._train_ds.epoch_arrays()
         self._X = jnp.asarray(Xb.reshape(nb, self.B, Xb.shape[-1]))
         self._Y = jnp.asarray(Yb.reshape(nb, self.B, Yb.shape[-1]))

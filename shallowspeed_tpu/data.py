@@ -75,6 +75,7 @@ class Dataset:
 
         # drop-last so every batch is exactly global_batch_size long — keeps
         # training equivalent across microbatch counts (dataset.py:49-52)
+        self.raw_len = len(X)  # pre-drop-last size, for diagnostics
         full = len(X) - (len(X) % self.global_batch_size)
         # strided DP shard; contiguous copy for clean host->device transfers
         self.input_X = np.ascontiguousarray(X[DP_rank:full:DP_size])

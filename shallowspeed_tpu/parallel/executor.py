@@ -45,7 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from shallowspeed_tpu import ops
 from shallowspeed_tpu.model import ModelSpec, init_model
@@ -359,7 +359,7 @@ def make_pipeline_step(
             mesh=mesh,
             in_specs=(stacked_specs, flags_specs, dp_spec, dp_spec),
             out_specs=(stacked_specs, P()),
-            check_rep=False,
+            check_vma=False,
         )
 
         def step_impl(stacked, flags, x, y):
@@ -374,7 +374,7 @@ def make_pipeline_step(
         mesh=mesh,
         in_specs=(stacked_specs, flags_specs, dp_spec),
         out_specs=P("dp"),
-        check_rep=False,
+        check_vma=False,
     )
 
     def eval_impl(stacked, flags, x):

@@ -16,11 +16,24 @@ from jax.sharding import Mesh
 
 
 def make_mesh(dp: int, pp: int, devices=None) -> Mesh:
+    """2-D (dp, pp) mesh. When devices aren't pinned explicitly, use JAX's
+    topology-aware placement (jax.experimental.mesh_utils) so that on a real
+    slice the ``pp`` neighbors — which exchange a ppermute payload every
+    pipeline tick — sit on adjacent ICI links, and ``dp`` (one psum per
+    batch) takes the outer dimension."""
+    explicit = devices is not None
     if devices is None:
         devices = jax.devices()
     if dp * pp > len(devices):
         raise ValueError(
             f"need {dp * pp} devices for DP={dp} x PP={pp}, have {len(devices)}"
         )
+    if not explicit and dp * pp == len(devices):
+        try:
+            from jax.experimental import mesh_utils
+
+            return Mesh(mesh_utils.create_device_mesh((dp, pp)), ("dp", "pp"))
+        except Exception:
+            pass  # fall through to the order-preserving layout
     grid = np.asarray(devices[: dp * pp]).reshape(dp, pp)
     return Mesh(grid, ("dp", "pp"))

@@ -76,3 +76,9 @@ def test_invalid_config_rejected(data_dir):
         _session(data_dir, dp=3)  # 64 % 3 != 0
     with pytest.raises(ValueError):
         _session(data_dir, mubatches=7)
+    with pytest.raises(ValueError):
+        _session(data_dir, precision="float32")
+    with pytest.raises(ValueError):
+        _session(data_dir, pp=2, schedule="1f1b")  # not a registered name
+    with pytest.raises(ValueError):
+        _session(data_dir, global_batch_size=4096)  # > training split
