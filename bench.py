@@ -37,9 +37,10 @@ def _ensure_responsive_backend(probe_timeout_s=180):
     can label the published metric honestly and distinguish a hung tunnel
     from a backend that failed fast.
 
-    Output pipes go to DEVNULL: with captured pipes, a tunnel helper
-    grandchild surviving the timeout kill would keep them open and make the
-    probe itself hang in communicate().
+    stdout goes to DEVNULL and stderr to a temp FILE (never a pipe): a tunnel
+    helper grandchild surviving the timeout kill would keep a captured pipe
+    open and make the probe itself hang in communicate(), while a file lets
+    us still report the backend's last error line.
     """
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return ""  # no tunnel plugin, nothing to guard (and nothing to pay)
