@@ -76,8 +76,12 @@ def _ensure_responsive_backend(probe_timeout_s=180):
     jax.config.update("jax_platforms", "cpu")
     return tag
 
-SIZES = (784, 128, 127, 126, 125, 124, 123, 10)
-B, M, LR = 128, 4, 0.006
+from shallowspeed_tpu.api import (  # the reference's canonical config
+    FLAGSHIP_BATCH as B,
+    FLAGSHIP_LR as LR,
+    FLAGSHIP_MUBATCHES as M,
+    FLAGSHIP_SIZES as SIZES,
+)
 N_SAMPLES = 59392  # MNIST train size after drop-last to 128-multiples
 
 

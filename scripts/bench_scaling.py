@@ -32,8 +32,12 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-SIZES = (784, 128, 127, 126, 125, 124, 123, 10)
-B, M, LR = 128, 4, 0.006
+from shallowspeed_tpu.api import (  # the reference's canonical config
+    FLAGSHIP_BATCH as B,
+    FLAGSHIP_LR as LR,
+    FLAGSHIP_MUBATCHES as M,
+    FLAGSHIP_SIZES as SIZES,
+)
 
 
 def _data(nb, rng):

@@ -1,0 +1,108 @@
+"""Single-chip tuning matrix: precision x microbatch-fusion x kernel backend.
+
+For a healthy accelerator, sweeps the sequential-trainer configurations that
+matter on the MXU and prints one JSON line per cell:
+
+    {"config": "fused+default+pallas", "samples_per_sec": ..., "speedup_vs_ref_cfg": ...}
+
+Reference cell: scanned microbatches + HIGHEST precision + XLA kernels (the
+NumPy-parity configuration). Runs anywhere (CPU included) — on CPU it mostly
+measures XLA CPU codegen, which is still useful for regression tracking.
+
+    python scripts/bench_tpu_matrix.py --batches 116 --reps 3
+"""
+
+import argparse
+import itertools
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from shallowspeed_tpu.api import (  # the reference's canonical config
+    FLAGSHIP_BATCH as B,
+    FLAGSHIP_LR as LR,
+    FLAGSHIP_MUBATCHES as M,
+    FLAGSHIP_SIZES as SIZES,
+)
+
+
+def measure(fused, precision_name, pallas, nb, reps):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from shallowspeed_tpu import model as Mo
+    from shallowspeed_tpu import ops, trainer
+    from shallowspeed_tpu.optimizer import SGD
+
+    ops.set_pallas(pallas)
+    try:
+        precision = (
+            lax.Precision.HIGHEST if precision_name == "highest" else lax.Precision.DEFAULT
+        )
+        spec = Mo.make_model_spec(SIZES, 1, B)
+        params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+        epoch = trainer.make_train_epoch(
+            spec, SGD(LR), precision=precision, fuse_mubatches=fused
+        )
+        rng = np.random.RandomState(0)
+        X = jnp.asarray(rng.rand(nb, M, B // M, SIZES[0]).astype(np.float32))
+        Y = jnp.asarray(
+            np.eye(SIZES[-1], dtype=np.float32)[
+                rng.randint(0, SIZES[-1], (nb, M, B // M))
+            ]
+        )
+        st = ()
+        params, st, _ = epoch(params, st, X, Y)
+        jax.block_until_ready(params)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            params, st, _ = epoch(params, st, X, Y)
+        jax.block_until_ready(params)
+        return reps * nb * B / (time.perf_counter() - t0)
+    finally:
+        ops.set_pallas(False)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, default=116)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--skip-pallas", action="store_true")
+    args = ap.parse_args()
+
+    ref_key = ("scanned", "highest", "xla")
+    results = {}
+    for fused, prec, pallas in itertools.product(
+        (False, True), ("highest", "default"), (False, True)
+    ):
+        if pallas and args.skip_pallas:
+            continue
+        key = (
+            "fused" if fused else "scanned",
+            prec,
+            "pallas" if pallas else "xla",
+        )
+        sps = measure(fused, prec, pallas, args.batches, args.reps)
+        results[key] = sps
+        print(
+            json.dumps(
+                {
+                    "config": "+".join(key),
+                    "samples_per_sec": round(sps, 1),
+                    "speedup_vs_ref_cfg": round(sps / results[ref_key], 3)
+                    if ref_key in results
+                    else None,
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

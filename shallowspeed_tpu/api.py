@@ -31,7 +31,12 @@ from shallowspeed_tpu.optimizer import SGD
 from shallowspeed_tpu.parallel import executor as E
 from shallowspeed_tpu.parallel import lower_schedule, make_mesh
 
+# The reference's canonical training configuration (train.py:56-59,98,107) —
+# the single source of truth for every benchmark script in this repo.
 FLAGSHIP_SIZES = (784, 128, 127, 126, 125, 124, 123, 10)
+FLAGSHIP_BATCH = 128
+FLAGSHIP_MUBATCHES = 4
+FLAGSHIP_LR = 0.006
 
 _PRECISIONS = {
     "highest": lax.Precision.HIGHEST,
