@@ -316,6 +316,8 @@ def make_pipeline_step(
                 payload = jnp.where(row["sb"][stage] == 1, _fit(dx, D_out), 0.0)
                 return c, zero_fwd, payload
 
+            # branch order is the op-code encoding: OP_NOOP=0, OP_FWD=1, OP_BWD=2
+            assert (OP_FWD, OP_BWD) == (1, 2)
             branches = [noop, forward] + ([backward] if training else [noop])
             carry, fwd_out, bwd_out = lax.switch(opv, branches, carry)
 
