@@ -84,3 +84,14 @@ def test_random_layout_matches_sequential(seed):
         np.testing.assert_allclose(
             np.asarray(a["b"]).reshape(-1), b["b"].reshape(-1), rtol=5e-4, atol=5e-6
         )
+
+    # inference path on the trained pipeline weights vs sequential predict
+    eval_prog = lower_schedule(S.InferenceSchedule, M, pp, training=False)
+    eval_step = E.make_pipeline_step(mesh, spec_pp, eval_prog, B // dp // M)
+    preds = np.asarray(eval_step(stacked, flags, jnp.asarray(X[0])))
+    seq_preds = np.asarray(trainer.make_predict(spec1)(params, jnp.asarray(X[0])))
+    np.testing.assert_allclose(
+        preds[:, : sizes[-1]], seq_preds, rtol=1e-3, atol=1e-5,
+        err_msg=f"eval case: sizes={sizes} dp={dp} pp={pp} M={M}",
+    )
+    assert (preds[:, sizes[-1] :] == 0).all()
