@@ -42,7 +42,7 @@ def _random_case(seed):
     return sizes, dp, pp, int(M), B, sched
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("seed", range(12))
 def test_random_layout_matches_sequential(seed):
     sizes, dp, pp, M, B, sched = _random_case(seed)
     spec_pp = Mo.make_model_spec(sizes, pp, B)
