@@ -87,11 +87,11 @@ def bench_pipeline(dp, pp, sched_name, nb, reps):
     epoch = E.make_pipeline_epoch(mesh, spec, prog, B // dp // M, SGD(LR))
     X, Y = _data(nb, np.random.RandomState(0))
     Xj, Yj = jnp.asarray(X), jnp.asarray(Y)
-    stacked, _ = epoch(stacked, flags, Xj, Yj)
+    stacked, st, _ = epoch(stacked, flags, (), Xj, Yj)
     jax.block_until_ready(stacked["W"])
     t0 = time.perf_counter()
     for _ in range(reps):
-        stacked, _ = epoch(stacked, flags, Xj, Yj)
+        stacked, st, _ = epoch(stacked, flags, st, Xj, Yj)
     jax.block_until_ready(stacked["W"])
     return reps * nb * B / (time.perf_counter() - t0)
 

@@ -33,11 +33,14 @@ import torch
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from shallowspeed_tpu.api import (  # noqa: E402 — the canonical config
+    FLAGSHIP_BATCH as B,
+    FLAGSHIP_LR as LR,
+    FLAGSHIP_MUBATCHES as M,
+    FLAGSHIP_SIZES as SIZES,
+)
 from shallowspeed_tpu.data import Dataset, default_data_dir  # noqa: E402
 from shallowspeed_tpu.init import linear_init  # noqa: E402
-
-SIZES = (784, 128, 127, 126, 125, 124, 123, 10)
-B, M, LR = 128, 4, 0.006
 
 
 def build_params():

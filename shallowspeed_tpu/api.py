@@ -83,7 +83,10 @@ class TrainingSession:
         data_dir = data_dir or default_data_dir()
         self._train_ds = Dataset(data_dir, self.B, mubatch_size=local_batch // mubatches)
         self._train_ds.load(0, 1)
-        self._val = Dataset(data_dir, self.B, mubatch_size=self.B, validation=True)
+        # global_batch_size=1 so drop-last keeps EVERY validation sample (the
+        # reference's val loader silently drops the tail to a batch multiple;
+        # our accuracy() pads the ragged tail chunk instead)
+        self._val = Dataset(data_dir, 1, mubatch_size=1, validation=True)
         self._val.load(0, 1)
         self._vx = jnp.asarray(self._val.input_X)
         self._vy = jnp.asarray(self._val.target_y)
@@ -132,6 +135,7 @@ class TrainingSession:
             self._stacked, self._flags = E.put_stacked(
                 *E.stack_params(host_params, self.spec), self.mesh
             )
+            self._opt_state = opt.init(self._stacked)
             self._epoch_fn = E.make_pipeline_epoch(
                 self.mesh, self.spec, prog, local_batch // mubatches, opt,
                 precision=self.precision,
@@ -151,8 +155,8 @@ class TrainingSession:
                 self._params, self._opt_state, self._Xe, self._Ye
             )
         else:
-            self._stacked, mean_loss = self._epoch_fn(
-                self._stacked, self._flags, self._X, self._Y
+            self._stacked, self._opt_state, mean_loss = self._epoch_fn(
+                self._stacked, self._flags, self._opt_state, self._X, self._Y
             )
         self.epoch += 1
         return float(mean_loss)

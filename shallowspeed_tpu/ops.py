@@ -31,6 +31,13 @@ _PALLAS = os.environ.get("SHALLOWSPEED_PALLAS", "0") == "1"
 
 
 def set_pallas(enabled: bool) -> None:
+    """Select the kernel backend for functions built AFTER this call.
+
+    The flag is read at TRACE time: step/predict functions that are already
+    jitted keep whichever backend they were traced with (their compiled
+    executables are cached). Rebuild the function (e.g. construct a new
+    TrainingSession / call make_train_epoch again) after toggling.
+    """
     global _PALLAS
     _PALLAS = bool(enabled)
 

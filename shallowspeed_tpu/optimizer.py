@@ -25,3 +25,27 @@ class SGD:
     def apply(self, params, grads, state=()):
         new = jax.tree.map(lambda p, g: p - self.lr * g, params, grads)
         return new, state
+
+
+@dataclasses.dataclass(frozen=True)
+class MomentumSGD:
+    """Heavy-ball SGD: v <- mu*v + g; p <- p - lr*v.
+
+    The reference ships only plain SGD; this exists to exercise (and prove)
+    the optimizer-state plumbing: state is a pytree mirroring the params, it
+    threads through the sequential trainer AND the pipeline executor
+    identically, so stateful optimizers keep the distributed == sequential
+    invariant (tests/test_optimizer_state.py)."""
+
+    lr: float
+    momentum: float = 0.9
+
+    def init(self, params):
+        import jax.numpy as jnp
+
+        return jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype), params)
+
+    def apply(self, params, grads, state):
+        velocity = jax.tree.map(lambda v, g: self.momentum * v + g, state, grads)
+        new = jax.tree.map(lambda p, v: p - self.lr * v, params, velocity)
+        return new, velocity

@@ -204,20 +204,27 @@ def make_pipeline_step(
     """Build the jitted SPMD step executing one TickProgram over the mesh.
 
     Training (prog.is_training, opt required):
-        step(stacked, flags, x, y) -> (stacked, loss)
+        step(stacked, flags, opt_state, x, y) -> (stacked, opt_state, loss)
       x: (global_batch, in_dim) sharded P('dp'); y: (global_batch, out_dim).
-      loss is the global-batch MSE (computed on the fly at the head stage —
-      an observability bonus the reference never offers, train.py never
-      computes the training loss).
+      opt_state is threaded exactly like the sequential trainer's, so
+      stateful optimizers (momentum et al.) behave identically on every
+      layout; loss is the global-batch MSE (computed on the fly at the head
+      stage — an observability bonus the reference never offers).
 
     Inference:
         step(stacked, flags, x) -> preds (global_eval_batch, out_width) P('dp')
+
+    Activation residuals live in stash slots assigned by the lowering, so a
+    schedule's real peak activation memory is its scheduling property:
+    GPipe allocates M slots, PipeDream-Flush min(M, depth) — the 1F1B memory
+    advantage is physical buffer sizes here, not just a diagram.
     """
     dims = slot_shapes(spec)
     S_, L = spec.n_stages, len(dims)
     D_in, D_out = dims[0][1], dims[-1][0]
     M = prog.num_micro_batches
     Kf, Kb = prog.n_fwd_slots, prog.n_bwd_slots
+    Ks = prog.n_stash_slots
     mb_sz = mubatch_size
     B_global = spec.global_batch_size
     training = prog.is_training
@@ -237,12 +244,14 @@ def make_pipeline_step(
             inb=prog.in_bwd_slot,
             sf=prog.send_fwd,
             sb=prog.send_bwd,
+            sw=prog.stash_write,
+            sr=prog.stash_read,
         ),
     )
     fwd_perm = [(s, s + 1) for s in range(S_ - 1)]
     bwd_perm = [(s, s - 1) for s in range(1, S_)]
 
-    def per_device(stacked, flags, x, y):
+    def per_device(stacked, flags, opt_state, x, y):
         # local views: stage axis is sharded to size 1 on pp
         Ws = [w[0] for w in stacked["W"]]  # per slot (out_l, in_l)
         bs = [b[0] for b in stacked["b"]]
@@ -257,9 +266,10 @@ def make_pipeline_step(
         y = y.reshape(M, mb_sz, D_out) if y is not None else None
 
         carry = dict(
-            xs=tuple(jnp.zeros((M + 1, mb_sz, i), jnp.float32) for _, i in dims),
-            masks=tuple(jnp.zeros((M + 1, mb_sz, o), jnp.bool_) for o, _ in dims),
-            z=jnp.zeros((M + 1, mb_sz, D_out), jnp.float32),
+            # residual stashes are indexed by lowering-assigned slots (+1 trash)
+            xs=tuple(jnp.zeros((Ks + 1, mb_sz, i), jnp.float32) for _, i in dims),
+            masks=tuple(jnp.zeros((Ks + 1, mb_sz, o), jnp.bool_) for o, _ in dims),
+            z=jnp.zeros((Ks + 1, mb_sz, D_out), jnp.float32),
             preds=jnp.zeros((M + 1, mb_sz, D_out), jnp.float32),
             fwd_mail=jnp.zeros((Kf + 1, mb_sz, D_in), jnp.float32),
             bwd_mail=jnp.zeros((Kb + 1, mb_sz, D_out), jnp.float32),
@@ -284,15 +294,16 @@ def make_pipeline_step(
                     Ws, bs, active, relu, dims, x_in, precision
                 )
                 c = dict(c)
+                sw = row["sw"][stage]  # stash slot (Ks = trash for inference)
                 c["xs"] = tuple(
-                    buf.at[mb_i].set(v) for buf, v in zip(c["xs"], xs_l)
+                    buf.at[sw].set(v) for buf, v in zip(c["xs"], xs_l)
                 )
                 c["masks"] = tuple(
-                    buf.at[mb_i].set(v) for buf, v in zip(c["masks"], masks_l)
+                    buf.at[sw].set(v) for buf, v in zip(c["masks"], masks_l)
                 )
                 p = ops.softmax(out, valid_mask=head_mask[None, :])
                 if training:
-                    c["z"] = c["z"].at[mb_i].set(out)
+                    c["z"] = c["z"].at[sw].set(out)
                     mb_loss = ops.mse_loss(p, y[mb_r], B_global)
                     c["loss"] = c["loss"] + jnp.where(is_last, mb_loss, 0.0)
                 else:
@@ -301,12 +312,15 @@ def make_pipeline_step(
                 return c, payload, zero_bwd
 
             def backward(c):
+                # lowering guarantees every training backward has a real
+                # stash slot in [0, Ks) (replay-asserted), so no clamp needed
+                sr = row["sr"][stage]
                 g0 = ops.softmax_mse_head_grad(
-                    c["z"][mb_r], y[mb_r], B_global, valid_mask=head_mask[None, :]
+                    c["z"][sr], y[mb_r], B_global, valid_mask=head_mask[None, :]
                 )
                 g_in = jnp.where(is_last, g0, c["bwd_mail"][row["rb"][stage]])
-                xs_r = tuple(buf[mb_r] for buf in c["xs"])
-                masks_r = tuple(buf[mb_r] for buf in c["masks"])
+                xs_r = tuple(buf[sr] for buf in c["xs"])
+                masks_r = tuple(buf[sr] for buf in c["masks"])
                 dx, gW_d, gb_d = _stage_bwd(
                     Ws, active, relu, dims, xs_r, masks_r, g_in, precision
                 )
@@ -347,8 +361,8 @@ def make_pipeline_step(
             "W": tuple(g[None] for g in gW),
             "b": tuple(g[None] for g in gb),
         }
-        new_local, _ = opt.apply(local, grads, ())
-        return new_local, loss
+        new_local, opt_state = opt.apply(local, grads, opt_state)
+        return new_local, opt_state, loss
 
     pp = P("pp")
     dp_spec = P("dp")
@@ -356,23 +370,38 @@ def make_pipeline_step(
     stacked_specs = {"W": (pp,) * L, "b": (pp,) * L}
 
     if training:
+        # optimizer-state specs mirror the state's pytree: stage-axis sharded
+        # like the params it tracks (SGD's state is the empty tuple)
+        stacked_struct = {
+            "W": tuple(jax.ShapeDtypeStruct((S_, o, i), jnp.float32) for o, i in dims),
+            "b": tuple(jax.ShapeDtypeStruct((S_, o), jnp.float32) for o, _ in dims),
+        }
+        state_struct = jax.eval_shape(opt.init, stacked_struct)
+        # stage-stacked state leaves (leading axis S, like the params they
+        # track) shard over pp; anything else (scalar step counts etc.) is
+        # replicated
+        state_specs = jax.tree.map(
+            lambda leaf: pp if leaf.ndim > 0 and leaf.shape[0] == S_ else P(),
+            state_struct,
+        )
+
         smapped = shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(stacked_specs, flags_specs, dp_spec, dp_spec),
-            out_specs=(stacked_specs, P()),
+            in_specs=(stacked_specs, flags_specs, state_specs, dp_spec, dp_spec),
+            out_specs=(stacked_specs, state_specs, P()),
             check_vma=False,
         )
 
-        def step_impl(stacked, flags, x, y):
-            return smapped(stacked, flags, _fit(x, D_in), _fit(y, D_out))
+        def step_impl(stacked, flags, opt_state, x, y):
+            return smapped(stacked, flags, opt_state, _fit(x, D_in), _fit(y, D_out))
 
         if jit:
-            return jax.jit(step_impl, donate_argnums=(0,))
+            return jax.jit(step_impl, donate_argnums=(0, 2))
         return step_impl
 
     smapped = shard_map(
-        lambda stacked, flags, x: per_device(stacked, flags, x, None),
+        lambda stacked, flags, x: per_device(stacked, flags, (), x, None),
         mesh=mesh,
         in_specs=(stacked_specs, flags_specs, dp_spec),
         out_specs=P("dp"),
@@ -388,17 +417,20 @@ def make_pipeline_step(
 def make_pipeline_epoch(mesh, spec, prog, mubatch_size, opt, precision=ops.DEFAULT_PRECISION):
     """Scan the pipeline train step over all batches of an epoch: one XLA
     program per epoch. X: (num_batches, global_batch, in_dim), batch axis
-    sharded over dp."""
+    sharded over dp. ``epoch(stacked, flags, opt_state, X, Y) -> (stacked,
+    opt_state, mean_loss)``."""
     step = make_pipeline_step(mesh, spec, prog, mubatch_size, opt, precision, jit=False)
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def epoch(stacked, flags, X, Y):
+    @partial(jax.jit, donate_argnums=(0, 2))
+    def epoch(stacked, flags, opt_state, X, Y):
         def body(carry, xy):
-            stacked, loss_sum = carry
-            stacked, loss = step(stacked, flags, xy[0], xy[1])
-            return (stacked, loss_sum + loss), None
+            stacked, opt_state, loss_sum = carry
+            stacked, opt_state, loss = step(stacked, flags, opt_state, xy[0], xy[1])
+            return (stacked, opt_state, loss_sum + loss), None
 
-        (stacked, loss_sum), _ = lax.scan(body, (stacked, jnp.zeros(())), (X, Y))
-        return stacked, loss_sum / X.shape[0]
+        (stacked, opt_state, loss_sum), _ = lax.scan(
+            body, (stacked, opt_state, jnp.zeros(())), (X, Y)
+        )
+        return stacked, opt_state, loss_sum / X.shape[0]
 
     return epoch

@@ -61,6 +61,7 @@ class TickProgram:
     num_micro_batches: int
     n_fwd_slots: int  # mailbox depths (trash slot = index n_slots)
     n_bwd_slots: int
+    n_stash_slots: int  # activation-stash depth (trash = index n_stash_slots)
     is_training: bool
     op: np.ndarray  # (T, S) int32: OP_NOOP/FWD/BWD
     mb: np.ndarray  # (T, S) int32: microbatch id, trash = M
@@ -70,6 +71,8 @@ class TickProgram:
     in_bwd_slot: np.ndarray  # (T, S) int32: slot storing payload arriving from s+1
     send_fwd: np.ndarray  # (T, S) int32 0/1: emit fwd payload this tick
     send_bwd: np.ndarray  # (T, S) int32 0/1: emit bwd payload this tick
+    stash_write: np.ndarray  # (T, S) int32: stash slot a forward fills (trash if none)
+    stash_read: np.ndarray  # (T, S) int32: stash slot a backward consumes (trash)
 
 
 class ScheduleLoweringError(ValueError):
@@ -243,6 +246,13 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
     ptr = [0] * num_stages
     fwd_mail = [_Mailbox() for _ in range(num_stages)]  # from s-1
     bwd_mail = [_Mailbox() for _ in range(num_stages)]  # from s+1
+    # activation-stash allocation (training only): a forward claims a slot
+    # for its residuals; the matching backward frees it. Slot pressure is
+    # therefore the schedule's REAL activation memory — GPipe peaks at M,
+    # PipeDream-Flush at min(M, depth - stage): 1F1B's memory advantage
+    # becomes physical buffer sizes, not just an instruction-stream property.
+    stash_free_from = [[] for _ in range(num_stages)]  # per stage, per slot
+    stash_of = [dict() for _ in range(num_stages)]  # mubatch -> slot
     rows = []  # per tick: list of per-stage dicts
     t = 0
     limit = 4 * num_micro_batches * num_stages + 8 * num_stages + 16
@@ -250,7 +260,10 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
         if t > limit:
             raise ScheduleLoweringError("schedule failed to converge (livelock?)")
         row = [
-            dict(op=OP_NOOP, mb=num_micro_batches, rf=-1, rb=-1, sf=0, sb=0, inf=-1, inb=-1)
+            dict(
+                op=OP_NOOP, mb=num_micro_batches, rf=-1, rb=-1, sf=0, sb=0,
+                inf=-1, inb=-1, sw=-1, sr=-1,
+            )
             for _ in range(num_stages)
         ]
         arrivals = []  # (direction, to_stage)
@@ -270,6 +283,21 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
                 r["rf"] = fwd_mail[s].consume(t, item.mubatch_id)
             if item.needs_bwd_msg:
                 r["rb"] = bwd_mail[s].consume(t, item.mubatch_id)
+            if training and item.kind == OP_FWD:
+                free = stash_free_from[s]
+                for slot, f in enumerate(free):
+                    if f <= t:
+                        break
+                else:
+                    free.append(0)
+                    slot = len(free) - 1
+                free[slot] = np.inf  # occupied until the matching backward
+                stash_of[s][item.mubatch_id] = slot
+                r["sw"] = slot
+            elif training and item.kind == OP_BWD:
+                slot = stash_of[s].pop(item.mubatch_id)
+                stash_free_from[s][slot] = t + 1  # reusable next tick
+                r["sr"] = slot
             if item.sends_fwd:
                 r["sf"] = 1
                 arrivals.append(("fwd", s + 1, item.mubatch_id))
@@ -292,8 +320,13 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
         if fwd_mail[s].msgs or bwd_mail[s].msgs:
             raise ScheduleLoweringError(f"stage {s}: unconsumed messages at end")
 
+    for s in range(num_stages):
+        if stash_of[s]:
+            raise ScheduleLoweringError(f"stage {s}: unfreed activation stash")
+
     K_f = max((m.depth for m in fwd_mail), default=0) or 1
     K_b = max((m.depth for m in bwd_mail), default=0) or 1
+    K_s = max((len(f) for f in stash_free_from), default=0) or 1
     T = len(rows)
 
     def table(key, trash):
@@ -310,6 +343,7 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
         num_micro_batches=num_micro_batches,
         n_fwd_slots=K_f,
         n_bwd_slots=K_b,
+        n_stash_slots=K_s,
         is_training=training,
         op=np.array([[r[s]["op"] for s in range(num_stages)] for r in rows], np.int32),
         mb=np.array([[r[s]["mb"] for s in range(num_stages)] for r in rows], np.int32),
@@ -319,4 +353,6 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
         in_bwd_slot=table("inb", K_b),
         send_fwd=np.array([[r[s]["sf"] for s in range(num_stages)] for r in rows], np.int32),
         send_bwd=np.array([[r[s]["sb"] for s in range(num_stages)] for r in rows], np.int32),
+        stash_write=table("sw", K_s),
+        stash_read=table("sr", K_s),
     )

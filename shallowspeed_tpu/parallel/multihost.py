@@ -29,15 +29,19 @@ import jax
 
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None):
-    """Join the global JAX runtime; no-op when already initialized or when
-    running single-process.
+    """Join the global JAX runtime; must run BEFORE any other JAX call that
+    initializes a backend (jax.devices(), first jit, ...). No-op when the
+    distributed runtime is already up, or — with no explicit coordinator —
+    when no cluster environment is configured (single-process run).
 
     On TPU pods all three arguments are inferred from the environment
     (``jax.distributed.initialize()`` with no args); pass them explicitly for
     CPU/GPU clusters.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    # NOTE: deliberately no jax.devices()/process_count() probe here — those
+    # initialize the XLA backend and would make distributed init impossible.
+    if jax.distributed.is_initialized():
+        return
     kwargs = {}
     if coordinator_address is not None:
         kwargs = dict(
@@ -48,7 +52,9 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
     try:
         jax.distributed.initialize(**kwargs)
     except (ValueError, RuntimeError) as e:
-        # single-process run with no coordinator configured — fine
+        # no coordinator given and none configured in the environment:
+        # a plain single-process run — fine. Explicit args must not fail
+        # silently, and the cause stays in the log either way.
         if coordinator_address is not None:
             raise
         import logging

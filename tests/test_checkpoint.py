@@ -77,7 +77,7 @@ def test_cross_layout_resume_sequential_to_pipeline(tmp_path):
 
     prog = lower_schedule(S.GPipeSchedule, M, 4)
     step4 = E.make_pipeline_step(mesh, spec4, prog, B // 2 // M, SGD(0.01))
-    stacked, _ = step4(stacked, flags, jnp.asarray(xb), jnp.asarray(yb))
+    stacked, _, _ = step4(stacked, flags, (), jnp.asarray(xb), jnp.asarray(yb))
 
     want = [l for s in seq_params for l in s]
     got = [l for s in E.unstack_params(stacked, spec4) for l in s]
@@ -97,7 +97,7 @@ def test_cross_layout_resume_pipeline_to_sequential(tmp_path):
     xb = rng.randn(B, SIZES[0]).astype(np.float32)
     yb = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, 10, B)]
     step4 = E.make_pipeline_step(mesh, spec4, prog, B // 2 // M, SGD(0.01))
-    stacked, _ = step4(stacked, flags, jnp.asarray(xb), jnp.asarray(yb))
+    stacked, _, _ = step4(stacked, flags, (), jnp.asarray(xb), jnp.asarray(yb))
 
     p = tmp_path / "ck.npz"
     save_checkpoint(p, E.unstack_params(stacked, spec4), spec4, epoch=1)

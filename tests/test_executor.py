@@ -55,11 +55,11 @@ def _pipeline_params(sizes, X, Y, dp, pp, sched_cls, use_epoch=False):
     mb_sz = B // dp // M
     if use_epoch:
         epoch = E.make_pipeline_epoch(mesh, spec, prog, mb_sz, SGD(LR))
-        stacked, _ = epoch(stacked, flags, jnp.asarray(X), jnp.asarray(Y))
+        stacked, _, _ = epoch(stacked, flags, (), jnp.asarray(X), jnp.asarray(Y))
     else:
         step = E.make_pipeline_step(mesh, spec, prog, mb_sz, SGD(LR))
         for i in range(NB):
-            stacked, _ = step(stacked, flags, jnp.asarray(X[i]), jnp.asarray(Y[i]))
+            stacked, _, _ = step(stacked, flags, (), jnp.asarray(X[i]), jnp.asarray(Y[i]))
     return stacked, spec, flags, mesh
 
 
@@ -195,7 +195,7 @@ def test_train_loss_decreases():
     losses = []
     for e in range(6):
         for i in range(8):
-            stacked, loss = step(stacked, flags, jnp.asarray(X[i]), jnp.asarray(Y[i]))
+            stacked, _, loss = step(stacked, flags, (), jnp.asarray(X[i]), jnp.asarray(Y[i]))
         losses.append(float(loss))
     assert all(b < a for a, b in zip(losses, losses[1:])), losses
     assert losses[-1] < losses[0] - 5e-3, losses

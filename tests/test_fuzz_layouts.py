@@ -72,7 +72,7 @@ def test_random_layout_matches_sequential(seed):
     stacked, flags = E.init_stacked(spec_pp, mesh)
     step = E.make_pipeline_step(mesh, spec_pp, prog, B // dp // M, SGD(0.01))
     for i in range(2):
-        stacked, _ = step(stacked, flags, jnp.asarray(X[i]), jnp.asarray(Y[i]))
+        stacked, _, _ = step(stacked, flags, (), jnp.asarray(X[i]), jnp.asarray(Y[i]))
     got = [l for stage in E.unstack_params(stacked, spec_pp) for l in stage]
 
     assert len(want) == len(got)

@@ -30,9 +30,10 @@ def replay(p):
     Payloads are tuples ("act"|"grad", mubatch, from_stage). Raises on any
     mailbox misuse; returns events[(t, s)] = (op, mb, consumed_payload).
     """
-    Kf, Kb = p.n_fwd_slots, p.n_bwd_slots
+    Kf, Kb, Ks = p.n_fwd_slots, p.n_bwd_slots, p.n_stash_slots
     fwd_mail = [[None] * Kf for _ in range(p.num_stages)]
     bwd_mail = [[None] * Kb for _ in range(p.num_stages)]
+    stash = [[None] * Ks for _ in range(p.num_stages)]
     events = {}
     for t in range(p.num_ticks):
         outgoing = []  # (dst, direction, slot, payload)
@@ -49,6 +50,22 @@ def replay(p):
                 consumed = bwd_mail[s][rb]
                 assert consumed is not None, f"read from empty bwd slot at t={t} s={s}"
                 bwd_mail[s][rb] = None
+            # activation stash: forwards write a free slot, the matching
+            # backward (same stage, same microbatch) reads and frees it
+            sw, sr = int(p.stash_write[t, s]), int(p.stash_read[t, s])
+            if sw != Ks:
+                assert op == OP_FWD
+                assert stash[s][sw] is None, f"stash overwrite t={t} s={s}"
+                stash[s][sw] = mb
+            if sr != Ks:
+                assert op == OP_BWD
+                assert stash[s][sr] == mb, (
+                    f"backward reads wrong stash at t={t} s={s}: "
+                    f"expected mb={mb}, slot holds {stash[s][sr]}"
+                )
+                stash[s][sr] = None
+            if p.is_training and op == OP_BWD:
+                assert sr != Ks, f"backward without stash read at t={t} s={s}"
             if op != OP_NOOP:
                 events[(t, s)] = (op, mb, consumed)
             if p.send_fwd[t, s]:
@@ -68,6 +85,7 @@ def replay(p):
             mail[dst][slot] = payload
     for s in range(p.num_stages):
         assert all(x is None for x in fwd_mail[s] + bwd_mail[s]), "leftover messages"
+        assert all(x is None for x in stash[s]), "leaked activation stash"
     return events
 
 
