@@ -23,6 +23,9 @@ bench:
 bench-scaling:
 	$(CPU_MESH) python scripts/bench_scaling.py
 
+bench-matrix:
+	python scripts/bench_tpu_matrix.py
+
 schedules:
 	$(CPU_MESH) python scripts/show_schedule.py --all
 
