@@ -97,11 +97,14 @@ def bench_pipeline(dp, pp, sched_name, nb, reps):
 
 
 CONFIGS = [
+    # the five BASELINE.md configs...
     ("seq", 1, 1, None),
     ("dp4", 4, 1, "gpipe"),
     ("pp4-naive", 1, 4, "naive"),
     ("pp4-gpipe", 1, 4, "gpipe"),
     ("dp2pp4-gpipe", 2, 4, "gpipe"),
+    # ...plus the 1F1B schedule the reference never implemented
+    ("pp4-pipedream", 1, 4, "pipedream"),
 ]
 
 
