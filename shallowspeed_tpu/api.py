@@ -60,6 +60,7 @@ class TrainingSession:
         data_dir=None,
         resume=None,
         devices=None,
+        fuse_mubatches=False,
     ):
         if global_batch_size % dp != 0:
             raise ValueError("global batch size must be divisible by dp")
@@ -78,6 +79,12 @@ class TrainingSession:
                 f"schedule must be one of {sorted(S.SCHEDULES)}, got {schedule!r}"
             )
         self.precision = _PRECISIONS[precision]
+        if fuse_mubatches and not (dp == 1 and pp == 1):
+            raise ValueError(
+                "fuse_mubatches applies to the sequential path only; in the "
+                "pipeline executor microbatches are semantic (they ARE the "
+                "pipeline's unit of work)"
+            )
         self.epoch = 0
 
         data_dir = data_dir or default_data_dir()
@@ -122,7 +129,8 @@ class TrainingSession:
             self._params = jax.tree.map(jnp.asarray, host_params)
             self._opt_state = ()
             self._epoch_fn = trainer.make_train_epoch(
-                self.spec, opt, precision=self.precision
+                self.spec, opt, precision=self.precision,
+                fuse_mubatches=fuse_mubatches,
             )
             self._predict = trainer.make_predict(self.spec, precision=self.precision)
             self._Xe = self._X.reshape(nb, self.M, self.B // self.M, -1)

@@ -58,6 +58,15 @@ def test_sequential_cli(tiny_data):
     assert "(sequential)" in out
 
 
+def test_sequential_cli_fused(tiny_data):
+    out = _run(
+        ["--epochs", "1", "--global-batch-size", "32", "--mubatches", "2",
+         "--no-eval", "--fuse-mubatches"],
+        tiny_data,
+    )
+    assert re.search(r"final model hash: [0-9a-f]{40}", out)
+
+
 def test_mesh_cli_dp2_pp2(tiny_data):
     out = _run(
         [

@@ -61,6 +61,13 @@ def main():
         help="write a jax.profiler trace of one training epoch to this directory",
     )
     ap.add_argument(
+        "--fuse-mubatches",
+        action="store_true",
+        help="sequential path only: one full-batch forward/backward per step "
+        "instead of the microbatch scan — same training (see docs/numerics.md), "
+        "larger matmuls for the MXU",
+    )
+    ap.add_argument(
         "--precision",
         choices=["highest", "default"],
         default="highest",
@@ -83,6 +90,7 @@ def main():
         precision=args.precision,
         data_dir=args.data_dir,
         resume=args.resume,
+        fuse_mubatches=args.fuse_mubatches,
     )
     if args.dp == 1 and args.pp == 1:
         layout = "sequential"
