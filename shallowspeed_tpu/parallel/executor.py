@@ -81,6 +81,21 @@ def slot_shapes(spec: ModelSpec):
     return dims
 
 
+def relay_width(spec: ModelSpec) -> int:
+    """True maximum inter-stage boundary width: the widest activation (and
+    therefore activation-gradient) ever shipped over the ``pp`` axis.
+
+    Stage ``s`` sends its out_dim forward (= stage ``s+1``'s in_dim) and its
+    in_dim backward, so both relay directions are bounded by
+    ``max(in_dim of stages 1..S-1)``. For the flagship model at PP=4 that is
+    127 (stage in_dims 127/125/123) —
+    ~6x narrower than sizing payloads to the model input width (784), which
+    is what the reference's per-boundary buffers get for free
+    (pipe.py:446-454) and the padded SPMD program must compute explicitly.
+    """
+    return max((s.in_dim for s in spec.stages[1:]), default=1)
+
+
 def stack_params(params_list, spec: ModelSpec):
     """Per-stage ragged params -> per-slot zero-padded stacks + flags.
 
@@ -222,6 +237,7 @@ def make_pipeline_step(
     dims = slot_shapes(spec)
     S_, L = spec.n_stages, len(dims)
     D_in, D_out = dims[0][1], dims[-1][0]
+    W_rel = relay_width(spec)  # ppermute payload / mailbox width (<= D_in)
     M = prog.num_micro_batches
     Kf, Kb = prog.n_fwd_slots, prog.n_bwd_slots
     Ks = prog.n_stash_slots
@@ -266,19 +282,28 @@ def make_pipeline_step(
         y = y.reshape(M, mb_sz, D_out) if y is not None else None
 
         carry = dict(
-            # residual stashes are indexed by lowering-assigned slots (+1 trash)
-            xs=tuple(jnp.zeros((Ks + 1, mb_sz, i), jnp.float32) for _, i in dims),
-            masks=tuple(jnp.zeros((Ks + 1, mb_sz, o), jnp.bool_) for o, _ in dims),
-            z=jnp.zeros((Ks + 1, mb_sz, D_out), jnp.float32),
-            preds=jnp.zeros((M + 1, mb_sz, D_out), jnp.float32),
-            fwd_mail=jnp.zeros((Kf + 1, mb_sz, D_in), jnp.float32),
-            bwd_mail=jnp.zeros((Kb + 1, mb_sz, D_out), jnp.float32),
-            gW=tuple(jnp.zeros((o, i), jnp.float32) for o, i in dims),
-            gb=tuple(jnp.zeros((o,), jnp.float32) for o, _ in dims),
-            loss=jnp.zeros((), jnp.float32),
+            fwd_mail=jnp.zeros((Kf + 1, mb_sz, W_rel), jnp.float32),
+            bwd_mail=jnp.zeros((Kb + 1, mb_sz, W_rel), jnp.float32),
         )
-        zero_fwd = jnp.zeros((mb_sz, D_in), jnp.float32)
-        zero_bwd = jnp.zeros((mb_sz, D_out), jnp.float32)
+        if training:
+            # residual stashes (lowering-assigned slots, +1 trash), grad
+            # accumulators, head-logit stash and the loss tally only exist in
+            # training programs — inference never runs a backward, so it
+            # carries only its predictions
+            carry.update(
+                xs=tuple(jnp.zeros((Ks + 1, mb_sz, i), jnp.float32) for _, i in dims),
+                masks=tuple(
+                    jnp.zeros((Ks + 1, mb_sz, o), jnp.bool_) for o, _ in dims
+                ),
+                z=jnp.zeros((Ks + 1, mb_sz, D_out), jnp.float32),
+                gW=tuple(jnp.zeros((o, i), jnp.float32) for o, i in dims),
+                gb=tuple(jnp.zeros((o,), jnp.float32) for o, _ in dims),
+                loss=jnp.zeros((), jnp.float32),
+            )
+        else:
+            carry.update(preds=jnp.zeros((M + 1, mb_sz, D_out), jnp.float32))
+        zero_fwd = jnp.zeros((mb_sz, W_rel), jnp.float32)
+        zero_bwd = jnp.zeros((mb_sz, W_rel), jnp.float32)
 
         def tick(carry, row):
             opv = row["op"][stage]
@@ -289,26 +314,31 @@ def make_pipeline_step(
                 return c, zero_fwd, zero_bwd
 
             def forward(c):
-                x_in = jnp.where(is_first, x[mb_r], c["fwd_mail"][row["rf"][stage]])
+                # non-first stages receive a W_rel-wide relay; pad it up to
+                # D_in so both branches of the where agree (exact: relayed
+                # activations are zero beyond their true boundary width)
+                x_in = jnp.where(
+                    is_first, x[mb_r], _fit(c["fwd_mail"][row["rf"][stage]], D_in)
+                )
                 out, xs_l, masks_l = _stage_fwd(
                     Ws, bs, active, relu, dims, x_in, precision
                 )
                 c = dict(c)
-                sw = row["sw"][stage]  # stash slot (Ks = trash for inference)
-                c["xs"] = tuple(
-                    buf.at[sw].set(v) for buf, v in zip(c["xs"], xs_l)
-                )
-                c["masks"] = tuple(
-                    buf.at[sw].set(v) for buf, v in zip(c["masks"], masks_l)
-                )
                 p = ops.softmax(out, valid_mask=head_mask[None, :])
                 if training:
+                    sw = row["sw"][stage]  # lowering-assigned stash slot
+                    c["xs"] = tuple(
+                        buf.at[sw].set(v) for buf, v in zip(c["xs"], xs_l)
+                    )
+                    c["masks"] = tuple(
+                        buf.at[sw].set(v) for buf, v in zip(c["masks"], masks_l)
+                    )
                     c["z"] = c["z"].at[sw].set(out)
                     mb_loss = ops.mse_loss(p, y[mb_r], B_global)
                     c["loss"] = c["loss"] + jnp.where(is_last, mb_loss, 0.0)
                 else:
                     c["preds"] = c["preds"].at[mb_i].set(jnp.where(is_last, p, 0.0))
-                payload = jnp.where(row["sf"][stage] == 1, _fit(out, D_in), 0.0)
+                payload = jnp.where(row["sf"][stage] == 1, _fit(out, W_rel), 0.0)
                 return c, payload, zero_bwd
 
             def backward(c):
@@ -318,7 +348,12 @@ def make_pipeline_step(
                 g0 = ops.softmax_mse_head_grad(
                     c["z"][sr], y[mb_r], B_global, valid_mask=head_mask[None, :]
                 )
-                g_in = jnp.where(is_last, g0, c["bwd_mail"][row["rb"][stage]])
+                # head grad is D_out wide, relayed grads W_rel wide; fit both
+                # to the wider so the where agrees (padding is exact zeros)
+                Wb = max(D_out, W_rel)
+                g_in = jnp.where(
+                    is_last, _fit(g0, Wb), _fit(c["bwd_mail"][row["rb"][stage]], Wb)
+                )
                 xs_r = tuple(buf[sr] for buf in c["xs"])
                 masks_r = tuple(buf[sr] for buf in c["masks"])
                 dx, gW_d, gb_d = _stage_bwd(
@@ -327,7 +362,7 @@ def make_pipeline_step(
                 c = dict(c)
                 c["gW"] = tuple(a + d for a, d in zip(c["gW"], gW_d))
                 c["gb"] = tuple(a + d for a, d in zip(c["gb"], gb_d))
-                payload = jnp.where(row["sb"][stage] == 1, _fit(dx, D_out), 0.0)
+                payload = jnp.where(row["sb"][stage] == 1, _fit(dx, W_rel), 0.0)
                 return c, zero_fwd, payload
 
             # branch order is the op-code encoding: OP_NOOP=0, OP_FWD=1, OP_BWD=2
