@@ -27,7 +27,7 @@ from shallowspeed_tpu import schedules as S
 from shallowspeed_tpu import trainer, utils
 from shallowspeed_tpu.checkpoint import load_checkpoint, save_checkpoint
 from shallowspeed_tpu.data import Dataset, default_data_dir
-from shallowspeed_tpu.optimizer import SGD
+from shallowspeed_tpu.optimizer import make_optimizer
 from shallowspeed_tpu.parallel import executor as E
 from shallowspeed_tpu.parallel import lower_schedule, make_mesh
 
@@ -61,6 +61,8 @@ class TrainingSession:
         resume=None,
         devices=None,
         fuse_mubatches=False,
+        optimizer="sgd",
+        momentum=0.9,
     ):
         if global_batch_size % dp != 0:
             raise ValueError("global batch size must be divisible by dp")
@@ -88,15 +90,13 @@ class TrainingSession:
         self.epoch = 0
 
         data_dir = data_dir or default_data_dir()
+        self._data_dir = data_dir
         self._train_ds = Dataset(data_dir, self.B, mubatch_size=local_batch // mubatches)
         self._train_ds.load(0, 1)
-        # global_batch_size=1 so drop-last keeps EVERY validation sample (the
-        # reference's val loader silently drops the tail to a batch multiple;
-        # our accuracy() pads the ragged tail chunk instead)
-        self._val = Dataset(data_dir, 1, mubatch_size=1, validation=True)
-        self._val.load(0, 1)
-        self._vx = jnp.asarray(self._val.input_X)
-        self._vy = jnp.asarray(self._val.target_y)
+        # validation split is loaded lazily on the first accuracy() call, so
+        # eval-free runs (train.py --no-eval, benchmarks) pay neither the host
+        # load nor the device transfer
+        self._vx = self._vy = None
 
         nb = self._train_ds.get_num_batches()
         if nb == 0:
@@ -110,16 +110,42 @@ class TrainingSession:
         self.batches_per_epoch = nb
 
         self.spec = Mo.make_model_spec(sizes, pp, self.B)
-        opt = SGD(lr)
+        opt = make_optimizer(optimizer, lr, momentum)
+        self._opt_config = {"name": optimizer, "lr": lr, "momentum": momentum}
         self._sequential = dp == 1 and pp == 1
 
+        host_opt_state = None  # logical (per-stage ragged) saved state, if any
         if resume is not None:
-            host_params, loaded_spec, meta = load_checkpoint(resume, pp, self.B)
+            host_params, loaded_spec, meta, host_opt_state = load_checkpoint(
+                resume, pp, self.B, with_opt_state=True
+            )
             if tuple(loaded_spec.sizes) != tuple(self.spec.sizes):
                 raise ValueError(
                     f"checkpoint sizes {loaded_spec.sizes} do not match the "
                     f"requested model sizes {self.spec.sizes}"
                 )
+            saved_opt = meta.get("extra", {}).get("optimizer")
+            if saved_opt is not None:
+                # name must match, and for stateful optimizers so must the
+                # coefficient the saved state was accumulated under — a
+                # mismatch would silently reinterpret the velocity. lr is
+                # deliberately free (changing it on resume is a schedule, not
+                # a reinterpretation of saved state).
+                if saved_opt["name"] != optimizer:
+                    raise ValueError(
+                        f"checkpoint was trained with optimizer "
+                        f"{saved_opt['name']!r}; resuming with {optimizer!r} "
+                        f"would silently change the trajectory — pass "
+                        f"optimizer={saved_opt['name']!r} to continue it, or "
+                        f"start a fresh run without resume"
+                    )
+                if optimizer == "momentum" and saved_opt.get("momentum") != momentum:
+                    raise ValueError(
+                        f"checkpoint velocity was accumulated with "
+                        f"momentum={saved_opt.get('momentum')}; resuming with "
+                        f"momentum={momentum} would reinterpret it — pass the "
+                        f"saved coefficient"
+                    )
             self.spec = loaded_spec
             self.epoch = meta["epoch"] + 1
         else:
@@ -127,7 +153,9 @@ class TrainingSession:
 
         if self._sequential:
             self._params = jax.tree.map(jnp.asarray, host_params)
-            self._opt_state = ()
+            self._opt_state = opt.init(self._params)
+            if host_opt_state is not None and self._opt_state != ():
+                self._opt_state = jax.tree.map(jnp.asarray, host_opt_state)
             self._epoch_fn = trainer.make_train_epoch(
                 self.spec, opt, precision=self.precision,
                 fuse_mubatches=fuse_mubatches,
@@ -139,18 +167,22 @@ class TrainingSession:
         else:
             self.mesh = make_mesh(dp, pp, devices)
             prog = lower_schedule(S.SCHEDULES[schedule], mubatches, pp)
-            eval_prog = lower_schedule(S.InferenceSchedule, 1, pp, training=False)
             self._stacked, self._flags = E.put_stacked(
                 *E.stack_params(host_params, self.spec), self.mesh
             )
             self._opt_state = opt.init(self._stacked)
+            if host_opt_state is not None and self._opt_state != ():
+                # stack + place the logical state exactly like the params it
+                # mirrors (zero padding is consistent: padded grads are
+                # exactly zero, so padded velocity stays zero)
+                self._opt_state, _ = E.put_stacked(
+                    *E.stack_params(host_opt_state, self.spec), self.mesh
+                )
             self._epoch_fn = E.make_pipeline_epoch(
                 self.mesh, self.spec, prog, local_batch // mubatches, opt,
                 precision=self.precision,
             )
-            self._eval_step = E.make_pipeline_step(
-                self.mesh, self.spec, eval_prog, self.B // dp, precision=self.precision
-            )
+            self._eval_step = None  # built lazily, sized to the val split
 
     # -- training -----------------------------------------------------------
 
@@ -171,23 +203,42 @@ class TrainingSession:
 
     # -- evaluation ---------------------------------------------------------
 
+    def _load_val(self):
+        """First-eval setup: load the split and (on mesh layouts) build ONE
+        padded whole-split inference program instead of host-looping
+        batch-sized steps — the full split flows through the pipeline in a
+        single dispatch (the reference evaluates the whole split per epoch
+        too, train.py:21-47, just one μbatch at a time)."""
+        # global_batch_size=1 so drop-last keeps EVERY validation sample (the
+        # reference's val loader silently drops the tail to a batch multiple;
+        # we pad the ragged tail instead)
+        val = Dataset(self._data_dir, 1, mubatch_size=1, validation=True)
+        val.load(0, 1)
+        self._vx = jnp.asarray(val.input_X)
+        self._vy = jnp.asarray(val.target_y)
+        if not self._sequential:
+            n_val = self._vx.shape[0]
+            # one row-shard per dp replica, padded up to a dp multiple
+            eval_rows = -(-n_val // self.dp) * self.dp
+            self._vx_padded = jnp.pad(self._vx, ((0, eval_rows - n_val), (0, 0)))
+            self._vy_labels = jnp.argmax(self._vy, 1)
+            eval_prog = lower_schedule(S.InferenceSchedule, 1, self.pp, training=False)
+            self._eval_step = E.make_pipeline_step(
+                self.mesh, self.spec, eval_prog, eval_rows // self.dp,
+                precision=self.precision,
+            )
+
     def accuracy(self) -> float:
         """Argmax accuracy over the full validation split."""
+        if self._vx is None:
+            self._load_val()
         if self._sequential:
             return trainer.accuracy(self._predict, self._params, self._vx, self._vy)
+        n_val = self._vx.shape[0]
+        preds = self._eval_step(self._stacked, self._flags, self._vx_padded)[:n_val]
         out_dim = self.spec.out_dim
-        correct = total = 0
-        for i in range(0, len(self._vx), self.B):
-            xb, yb = self._vx[i : i + self.B], self._vy[i : i + self.B]
-            n_valid = xb.shape[0]
-            if n_valid < self.B:
-                xb = jnp.pad(xb, ((0, self.B - n_valid), (0, 0)))
-            preds = self._eval_step(self._stacked, self._flags, xb)[:n_valid]
-            correct += int(
-                (jnp.argmax(preds[:, :out_dim], 1) == jnp.argmax(yb, 1)).sum()
-            )
-            total += n_valid
-        return correct / max(total, 1)
+        correct = int((jnp.argmax(preds[:, :out_dim], 1) == self._vy_labels).sum())
+        return correct / max(n_val, 1)
 
     # -- state --------------------------------------------------------------
 
@@ -204,5 +255,21 @@ class TrainingSession:
         if not self._sequential:
             utils.assert_dp_replicas_in_sync(self._stacked)
 
+    def opt_state_logical(self):
+        """Stateful-optimizer state as per-stage ragged host numpy mirroring
+        ``params()``, or None for stateless optimizers."""
+        if self._opt_state == ():
+            return None
+        if self._sequential:
+            return jax.device_get(self._opt_state)
+        return E.unstack_params(self._opt_state, self.spec)
+
     def save(self, path):
-        save_checkpoint(path, self.params(), self.spec, self.epoch - 1)
+        save_checkpoint(
+            path,
+            self.params(),
+            self.spec,
+            self.epoch - 1,
+            extra={"optimizer": self._opt_config},
+            opt_state_list=self.opt_state_logical(),
+        )
