@@ -9,8 +9,9 @@ sequentially, or vice versa — the layout is a property of the run, not of
 the checkpoint.
 
 Format: a single .npz (atomic rename on save) with arrays ``w{i}``/``b{i}``
-per global layer plus a JSON metadata blob (sizes, global batch size, epoch,
-optimizer state).
+per global layer, optional optimizer-state arrays ``ow{i}``/``ob{i}`` in the
+same logical order (for stateful optimizers, e.g. momentum velocity), plus a
+JSON metadata blob (sizes, global batch size, epoch, optimizer config).
 """
 
 import json
@@ -41,8 +42,16 @@ def _flatten_logical(params_list):
     return out
 
 
-def save_checkpoint(path, params_list, spec: ModelSpec, epoch: int, extra=None):
-    """Atomically write params (+ metadata) to ``path`` (.npz)."""
+def save_checkpoint(
+    path, params_list, spec: ModelSpec, epoch: int, extra=None, opt_state_list=None
+):
+    """Atomically write params (+ metadata) to ``path`` (.npz).
+
+    ``opt_state_list``: optional per-stage ragged pytree with the SAME
+    structure as ``params_list`` (stateful optimizers' state mirrors the
+    params, e.g. momentum velocity) — stored in the same logical layer order,
+    so it is exactly as layout-independent as the weights.
+    """
     path = Path(path)
     flat = _flatten_logical(params_list)
     if len(flat) != len(spec.sizes) - 1:
@@ -54,12 +63,27 @@ def save_checkpoint(path, params_list, spec: ModelSpec, epoch: int, extra=None):
         "sizes": list(spec.sizes),
         "global_batch_size": spec.global_batch_size,
         "epoch": int(epoch),
+        "has_opt_state": opt_state_list is not None,
         "extra": extra or {},
     }
     arrays = {"meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)}
     for i, (w, b) in enumerate(flat):
         arrays[f"w{i}"] = w
         arrays[f"b{i}"] = b
+    if opt_state_list is not None:
+        flat_opt = _flatten_logical(opt_state_list)
+        if len(flat_opt) != len(flat):
+            raise ValueError(
+                f"optimizer-state layer count {len(flat_opt)} != param count {len(flat)}"
+            )
+        for i, (ow, ob) in enumerate(flat_opt):
+            if ow.shape != flat[i][0].shape or ob.shape != flat[i][1].shape:
+                raise ValueError(
+                    f"optimizer-state layer {i} shape {ow.shape}/{ob.shape} does "
+                    f"not mirror the params {flat[i][0].shape}/{flat[i][1].shape}"
+                )
+            arrays[f"ow{i}"] = ow
+            arrays[f"ob{i}"] = ob
     path.parent.mkdir(parents=True, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".npz.tmp")
     try:
@@ -72,7 +96,20 @@ def save_checkpoint(path, params_list, spec: ModelSpec, epoch: int, extra=None):
         raise
 
 
-def load_checkpoint(path, n_stages: int, global_batch_size=None):
+def _partition(flat, spec: ModelSpec):
+    """Flat global layer list -> per-stage ragged list for ``spec``."""
+    out, k = [], 0
+    for sspec in spec.stages:
+        layers = []
+        for _ in range(sspec.n_linears):
+            w, b = flat[k]
+            layers.append({"W": w, "b": b})
+            k += 1
+        out.append(layers)
+    return out
+
+
+def load_checkpoint(path, n_stages: int, global_batch_size=None, with_opt_state=False):
     """Load a checkpoint and re-partition it for an ``n_stages`` layout.
 
     ``global_batch_size``: the CURRENT run's global batch size — it feeds the
@@ -82,7 +119,9 @@ def load_checkpoint(path, n_stages: int, global_batch_size=None):
 
     Returns (params_list, spec, meta): params_list is per-stage ragged host
     numpy ready for ``jax.tree.map(jnp.asarray, ...)`` (sequential) or
-    ``executor.stack_params`` (pipeline).
+    ``executor.stack_params`` (pipeline). With ``with_opt_state=True``,
+    returns (params_list, spec, meta, opt_state_list) where opt_state_list
+    mirrors params_list, or None when the checkpoint stored none.
     """
     with np.load(Path(path)) as z:
         meta = json.loads(bytes(z["meta"]).decode())
@@ -90,17 +129,13 @@ def load_checkpoint(path, n_stages: int, global_batch_size=None):
             raise ValueError(f"unsupported checkpoint version: {meta}")
         n_layers = len(meta["sizes"]) - 1
         flat = [(z[f"w{i}"], z[f"b{i}"]) for i in range(n_layers)]
+        flat_opt = None
+        if meta.get("has_opt_state"):
+            flat_opt = [(z[f"ow{i}"], z[f"ob{i}"]) for i in range(n_layers)]
     if global_batch_size is None:
         global_batch_size = meta["global_batch_size"]
     spec = make_model_spec(meta["sizes"], n_stages, global_batch_size)
-    params_list, k = [], 0
-    for sspec in spec.stages:
-        layers = []
-        for _ in range(sspec.n_linears):
-            w, b = flat[k]
-            layers.append({"W": w, "b": b})
-            k += 1
-        params_list.append(layers)
+    params_list = _partition(flat, spec)
     # shape sanity against the re-partitioned spec
     for sspec, layers in zip(spec.stages, params_list):
         for l, layer in enumerate(layers):
@@ -109,4 +144,7 @@ def load_checkpoint(path, n_stages: int, global_batch_size=None):
                 raise ValueError(
                     f"checkpoint layer shape {layer['W'].shape} != spec {want}"
                 )
-    return params_list, spec, meta
+    if not with_opt_state:
+        return params_list, spec, meta
+    opt_state_list = None if flat_opt is None else _partition(flat_opt, spec)
+    return params_list, spec, meta, opt_state_list

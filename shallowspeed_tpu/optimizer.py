@@ -49,3 +49,13 @@ class MomentumSGD:
         velocity = jax.tree.map(lambda v, g: self.momentum * v + g, state, grads)
         new = jax.tree.map(lambda p, v: p - self.lr * v, params, velocity)
         return new, velocity
+
+
+def make_optimizer(name: str, lr: float, momentum: float = 0.9):
+    """Optimizer registry for the CLI/API surface (reference hardwires SGD,
+    train.py:107)."""
+    if name == "sgd":
+        return SGD(lr)
+    if name == "momentum":
+        return MomentumSGD(lr, momentum)
+    raise ValueError(f"optimizer must be one of ['momentum', 'sgd'], got {name!r}")

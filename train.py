@@ -45,6 +45,14 @@ def main():
     ap.add_argument("--global-batch-size", type=int, default=128)
     ap.add_argument("--mubatches", type=int, default=4)
     ap.add_argument("--lr", type=float, default=0.006)
+    ap.add_argument(
+        "--optimizer",
+        choices=["sgd", "momentum"],
+        default="sgd",
+        help="sgd = reference parity; momentum = heavy-ball SGD (state is "
+        "saved in checkpoints and restored on --resume, any layout)",
+    )
+    ap.add_argument("--momentum", type=float, default=0.9)
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--no-eval", action="store_true", help="skip per-epoch accuracy")
     ap.add_argument(
@@ -91,6 +99,8 @@ def main():
         data_dir=args.data_dir,
         resume=args.resume,
         fuse_mubatches=args.fuse_mubatches,
+        optimizer=args.optimizer,
+        momentum=args.momentum,
     )
     if args.dp == 1 and args.pp == 1:
         layout = "sequential"
