@@ -1,0 +1,108 @@
+"""Worker process for the REAL multi-host test (spawned by test_multihost.py).
+
+Two of these run concurrently, each contributing 2 emulated CPU devices to a
+4-device global runtime via ``jax.distributed`` — the JAX-native analogue of
+the reference's ``mpirun -n N`` launch (reference train.py:87-94). Together
+they exercise the full multihost surface:
+
+  1. ``multihost.initialize`` against a localhost coordinator;
+  2. ``multihost.shard_batch_for_process`` building a global batch from
+     per-process shards;
+  3. a cross-process ``psum`` over the ``dp`` axis (the DP gradient
+     all-reduce path);
+  4. one REAL pipeline-executor training step (DP=2 x PP=2, GPipe) over the
+     process-spanning mesh, with ``dp`` laid across the process boundary the
+     way it would be laid across hosts on a pod.
+
+Prints one JSON line {"pid", "psum_ok", "loss"} on success; any assertion
+failure exits non-zero and fails the parent test.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid, port = int(sys.argv[1]), int(sys.argv[2])
+    # CPU-only: keep the single-client TPU tunnel plugin out (see conftest.py)
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f
+    ]
+    os.environ["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=2"]
+    )
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from shallowspeed_tpu.parallel import multihost
+
+    # must run BEFORE any backend-initializing call
+    multihost.initialize(
+        coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid
+    )
+
+    import numpy as np
+    from jax import lax, shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from shallowspeed_tpu import model as Mo
+    from shallowspeed_tpu import schedules as S
+    from shallowspeed_tpu.optimizer import SGD
+    from shallowspeed_tpu.parallel import executor as E
+    from shallowspeed_tpu.parallel import lower_schedule, make_mesh
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.local_devices()) == 2
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    assert len(devs) == 4
+    # dp rows == processes: stage relays (every tick) stay process-local,
+    # the once-per-batch dp psum crosses the process boundary — the layout
+    # multihost.py prescribes for real pods (pp on ICI, dp outer)
+    mesh = make_mesh(2, 2, devices=devs)
+
+    # --- cross-process DP psum over a process-locally-fed global array -----
+    local = np.full((1, 4), float(pid + 1), np.float32)
+    arr = multihost.shard_batch_for_process(local, mesh, P("dp"))
+    summed = jax.jit(
+        shard_map(
+            lambda x: lax.psum(x, "dp"), mesh=mesh, in_specs=P("dp"), out_specs=P()
+        )
+    )(arr)
+    np.testing.assert_array_equal(np.asarray(summed), np.full((1, 4), 3.0))
+
+    # --- one real pipeline training step over the process-spanning mesh ----
+    SIZES, B, M = (12, 10, 9, 8), 16, 2
+    spec = Mo.make_model_spec(SIZES, 2, B)
+    prog = lower_schedule(S.GPipeSchedule, M, 2)
+    stacked, fl = E.stack_params(Mo.init_model(spec), spec)
+
+    def put_global(x, pspec):
+        sh = NamedSharding(mesh, pspec)
+        return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+
+    stacked = jax.tree.map(lambda x: put_global(x, P("pp")), stacked)
+    fl = jax.tree.map(lambda x: put_global(x, P("pp")), fl)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(B, SIZES[0]).astype(np.float32)
+    Y = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], B)]
+    half = B // 2
+    xg = multihost.shard_batch_for_process(X[pid * half : (pid + 1) * half], mesh, P("dp"))
+    yg = multihost.shard_batch_for_process(Y[pid * half : (pid + 1) * half], mesh, P("dp"))
+
+    step = E.make_pipeline_step(mesh, spec, prog, half // M, SGD(0.05))
+    _, _, loss = step(stacked, fl, (), xg, yg)
+    print(json.dumps({"pid": pid, "psum_ok": True, "loss": float(loss)}))
+
+
+if __name__ == "__main__":
+    main()
