@@ -215,6 +215,7 @@ def make_pipeline_step(
     opt=None,
     precision=ops.DEFAULT_PRECISION,
     jit=True,
+    tick_unroll=1,
 ):
     """Build the jitted SPMD step executing one TickProgram over the mesh.
 
@@ -377,7 +378,9 @@ def make_pipeline_step(
             carry["bwd_mail"] = carry["bwd_mail"].at[row["inb"][stage]].set(incoming_b)
             return carry, None
 
-        carry, _ = lax.scan(tick, carry, tabs)
+        # tick_unroll amortizes the scan's per-tick loop overhead (each tick
+        # body is one small stage compute + two ppermutes); numerics identical
+        carry, _ = lax.scan(tick, carry, tabs, unroll=tick_unroll)
 
         if not training:
             preds = carry["preds"][:M].reshape(M * mb_sz, D_out)
@@ -449,12 +452,26 @@ def make_pipeline_step(
     return jax.jit(eval_impl) if jit else eval_impl
 
 
-def make_pipeline_epoch(mesh, spec, prog, mubatch_size, opt, precision=ops.DEFAULT_PRECISION):
+def make_pipeline_epoch(
+    mesh,
+    spec,
+    prog,
+    mubatch_size,
+    opt,
+    precision=ops.DEFAULT_PRECISION,
+    unroll=1,
+    tick_unroll=1,
+):
     """Scan the pipeline train step over all batches of an epoch: one XLA
     program per epoch. X: (num_batches, global_batch, in_dim), batch axis
     sharded over dp. ``epoch(stacked, flags, opt_state, X, Y) -> (stacked,
-    opt_state, mean_loss)``."""
-    step = make_pipeline_step(mesh, spec, prog, mubatch_size, opt, precision, jit=False)
+    opt_state, mean_loss)``. ``unroll``/``tick_unroll``: lax.scan unroll
+    factors for the batch loop / the per-tick loop (throughput knobs,
+    identical numerics)."""
+    step = make_pipeline_step(
+        mesh, spec, prog, mubatch_size, opt, precision, jit=False,
+        tick_unroll=tick_unroll,
+    )
 
     @partial(jax.jit, donate_argnums=(0, 2))
     def epoch(stacked, flags, opt_state, X, Y):
@@ -464,7 +481,7 @@ def make_pipeline_epoch(mesh, spec, prog, mubatch_size, opt, precision=ops.DEFAU
             return (stacked, opt_state, loss_sum + loss), None
 
         (stacked, opt_state, loss_sum), _ = lax.scan(
-            body, (stacked, opt_state, jnp.zeros(())), (X, Y)
+            body, (stacked, opt_state, jnp.zeros(())), (X, Y), unroll=unroll
         )
         return stacked, opt_state, loss_sum / X.shape[0]
 

@@ -95,12 +95,21 @@ def make_train_step(
 
 
 def make_train_epoch(
-    spec: ModelSpec, opt, precision=ops.DEFAULT_PRECISION, fuse_mubatches=False
+    spec: ModelSpec,
+    opt,
+    precision=ops.DEFAULT_PRECISION,
+    fuse_mubatches=False,
+    unroll=1,
 ):
     """Whole-epoch scan: ``epoch(params, opt_state, X, Y) -> (params,
     opt_state, mean_loss)`` with X: (num_batches, M, mubatch, in_dim). One
     XLA program per epoch; mean_loss is the true mean batch training loss
-    (same definition as the pipeline executor's)."""
+    (same definition as the pipeline executor's).
+
+    ``unroll``: lax.scan unroll factor over batches — for this model each
+    batch body is a handful of small matmuls, so unrolling amortizes the
+    per-iteration loop overhead (a throughput knob; identical numerics).
+    """
     batch_step = _make_batch_step(spec, opt, precision, fuse_mubatches)
 
     @partial(jax.jit, donate_argnums=(0, 1))
@@ -111,7 +120,7 @@ def make_train_epoch(
             return (params, opt_state, loss_sum + loss), None
 
         (params, opt_state, loss_sum), _ = lax.scan(
-            body, (params, opt_state, jnp.zeros(())), (X, Y)
+            body, (params, opt_state, jnp.zeros(())), (X, Y), unroll=unroll
         )
         return params, opt_state, loss_sum / X.shape[0]
 

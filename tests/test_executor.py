@@ -181,6 +181,27 @@ def test_pipeline_inference_matches_sequential_predict():
     assert (np.asarray(preds)[:, SMALL[-1] :] == 0).all()
 
 
+def test_tick_and_batch_unroll_bit_identical():
+    """Scan unroll factors are scheduling-only: identical results."""
+    X, Y = _data(SMALL)
+    mesh = make_mesh(2, 4)
+    spec = Mo.make_model_spec(SMALL, 4, B)
+    prog = lower_schedule(S.GPipeSchedule, M, 4)
+    outs = []
+    for unroll, tick_unroll in ((1, 1), (2, 4)):
+        stacked, flags = E.init_stacked(spec, mesh)
+        epoch = E.make_pipeline_epoch(
+            mesh, spec, prog, B // 2 // M, SGD(LR),
+            unroll=unroll, tick_unroll=tick_unroll,
+        )
+        stacked, _, loss = epoch(stacked, flags, (), jnp.asarray(X), jnp.asarray(Y))
+        outs.append((E.unstack_params(stacked, spec), float(loss)))
+    assert outs[0][1] == outs[1][1]
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, b), outs[0][0], outs[1][0]
+    )
+
+
 def test_train_loss_decreases():
     rng = np.random.RandomState(7)
     labels = rng.randint(0, 10, (8, B))

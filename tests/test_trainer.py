@@ -124,6 +124,24 @@ def test_epoch_scan_matches_per_batch_steps():
     )
 
 
+def test_epoch_unroll_is_bit_identical():
+    """unroll is a scheduling knob: same ops in the same order, so the
+    trained weights must match bit-for-bit."""
+    spec = M.make_model_spec(SIZES, 1, B)
+    rng = np.random.RandomState(3)
+    X, Y = _data(6, 4, rng)
+    outs = []
+    for unroll in (1, 3):
+        params = jax.tree.map(jnp.asarray, M.init_model(spec))
+        epoch = trainer.make_train_epoch(spec, SGD(LR), unroll=unroll)
+        params, _, loss = epoch(params, (), jnp.asarray(X), jnp.asarray(Y))
+        outs.append((jax.device_get(params), float(loss)))
+    assert outs[0][1] == outs[1][1]
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, b), outs[0][0], outs[1][0]
+    )
+
+
 def test_training_learns_separable_data():
     spec = M.make_model_spec((8, 16, 10), 1, B)
     params = jax.tree.map(jnp.asarray, M.init_model(spec))
