@@ -3,16 +3,31 @@
 The framework's compute path is XLA-compiled jax.numpy (ops.py) — for this
 model class XLA already fuses bias-add and ReLU into the matmul. These Pallas
 kernels exist for the cases XLA can't schedule as one unit and as the
-framework's custom-kernel layer (per-stage tensors here are small enough that
-a whole layer fits VMEM, so each kernel is a single block: HBM -> VMEM once,
-matmul on the MXU with fp32 accumulation, activation + bitmask on the VPU,
-one write back).
+framework's custom-kernel layer.
+
+Two regimes, auto-selected per shape at trace time:
+
+- **single block** (the flagship model's regime): every operand of a layer
+  fits VMEM at once, so each kernel is one block — HBM -> VMEM once, matmul
+  on the MXU with fp32 accumulation, activation + bitmask on the VPU, one
+  write back.
+- **grid-tiled** (shapes beyond the VMEM budget): every dimension —
+  including the contraction — is tiled, so per-block VMEM is ~4 tile^2
+  floats (~4 MiB at tile=512) regardless of layer size. The innermost grid
+  dimension accumulates partial products into the revisited output block:
+  the forward accumulates z over contraction tiles and runs the
+  bias+relu+mask epilogue on the final one; the backward splits into a dx
+  kernel (accumulating over out-col tiles) and a dw/db kernel (accumulating
+  over row tiles; db adds only on the first in-col tile so column tiling
+  never double-counts it). Tiles are multiples of the 128-lane MXU width;
+  ragged edges are zero-padded in the wrapper and sliced off after (exact:
+  padded rows/cols contribute zeros).
 
 - ``linear_relu_fwd(x, w, b) -> (y, mask)``: y = relu(x @ w.T + b), mask the
   pre-activation sign bitmask the backward needs (reference semantics:
   layers.py:68-71 caches the same bitmask).
-- ``linear_relu_bwd(g, mask, x, w) -> (dx, dw, db)``: all three gradients in
-  one kernel from one VMEM residency of g/mask/x/w.
+- ``linear_relu_bwd(g, mask, x, w) -> (dx, dw, db)``: all three gradients
+  from one VMEM residency of g/mask/x/w per block.
 
 Enable with SHALLOWSPEED_PALLAS=1 (or ``ops.set_pallas(True)``); off-TPU the
 kernels run in interpreter mode, so the same tests cover CPU CI and real
@@ -35,6 +50,32 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# VMEM is ~16 MiB/core; a single-block kernel must hold every operand at
+# once, so leave generous headroom for double-buffering and the compiler.
+SINGLE_BLOCK_BUDGET_BYTES = 8 * 1024 * 1024
+TILE = 512  # grid tile edge (multiple of the 128-lane MXU width)
+
+
+def _fwd_bytes(mb, din, dout):
+    """f32 VMEM footprint of a single-block forward: x, w, b, y, mask."""
+    return 4 * (mb * din + dout * din + dout + 2 * mb * dout)
+
+
+def _bwd_bytes(mb, din, dout):
+    """f32 VMEM footprint of a single-block backward: g, mask, x, w, dx, dw, db."""
+    return 4 * (3 * mb * dout + mb * din + 2 * dout * din + dout)
+
+
+def _pad_to(a, axis, mult):
+    n = a.shape[axis]
+    rem = (-n) % mult
+    if rem == 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, rem)
+    return jnp.pad(a, widths)
+
+
 def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, mask_ref):
     z = (
         jnp.dot(x_ref[:], w_ref[:].T, preferred_element_type=jnp.float32)
@@ -44,11 +85,10 @@ def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, mask_ref):
     y_ref[:] = jnp.maximum(z, 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=())
-def linear_relu_fwd(x, w, b):
-    mb, din = x.shape
+def _linear_relu_fwd_single(x, w, b2):
+    mb, _ = x.shape
     dout = w.shape[0]
-    y, mask = pl.pallas_call(
+    return pl.pallas_call(
         _fwd_kernel,
         out_shape=(
             jax.ShapeDtypeStruct((mb, dout), jnp.float32),
@@ -64,8 +104,72 @@ def linear_relu_fwd(x, w, b):
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ),
         interpret=_interpret(),
-    )(x, w, jnp.reshape(b, (1, -1)))
-    return y, mask
+    )(x, w, b2)
+
+
+def _fwd_tiled_kernel(x_ref, w_ref, b_ref, y_ref, mask_ref):
+    # grid = (row tiles i, out-col tiles j, contraction tiles c); c is
+    # INNERMOST: the revisited y block accumulates partial products, and the
+    # bias/relu/mask epilogue runs once on the final contraction step
+    c = pl.program_id(2)
+    nc = pl.num_programs(2)
+    partial = jnp.dot(x_ref[:], w_ref[:].T, preferred_element_type=jnp.float32)
+
+    @pl.when(c == 0)
+    def _init():
+        y_ref[:] = partial
+
+    @pl.when(c != 0)
+    def _acc():
+        y_ref[:] += partial
+
+    @pl.when(c == nc - 1)
+    def _epilogue():
+        z = y_ref[:] + b_ref[:]
+        mask_ref[:] = (z > 0.0).astype(jnp.float32)
+        y_ref[:] = jnp.maximum(z, 0.0)
+
+
+def linear_relu_fwd_tiled(x, w, b2, tile=TILE):
+    """Grid-tiled forward: every dim tiled (rows x out-cols x contraction),
+    so per-block VMEM is ~4 tile^2 floats regardless of shape. Ragged edges
+    zero-padded here, sliced off after (exact: pads contribute zeros)."""
+    mb, din = x.shape
+    dout = w.shape[0]
+    xp = _pad_to(_pad_to(x, 0, tile), 1, tile)
+    wp = _pad_to(_pad_to(w, 0, tile), 1, tile)
+    bp = _pad_to(b2, 1, tile)
+    mbp, dinp = xp.shape
+    doutp = wp.shape[0]
+    y, mask = pl.pallas_call(
+        _fwd_tiled_kernel,
+        grid=(mbp // tile, doutp // tile, dinp // tile),
+        out_shape=(
+            jax.ShapeDtypeStruct((mbp, doutp), jnp.float32),
+            jax.ShapeDtypeStruct((mbp, doutp), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec((tile, tile), lambda i, j, c: (i, c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, tile), lambda i, j, c: (j, c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), lambda i, j, c: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((tile, tile), lambda i, j, c: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, tile), lambda i, j, c: (i, j), memory_space=pltpu.VMEM),
+        ),
+        interpret=_interpret(),
+    )(xp, wp, bp)
+    return y[:mb, :dout], mask[:mb, :dout]
+
+
+@functools.partial(jax.jit, static_argnames=())
+def linear_relu_fwd(x, w, b):
+    mb, din = x.shape
+    dout = w.shape[0]
+    b2 = jnp.reshape(b, (1, -1))
+    if _fwd_bytes(mb, din, dout) <= SINGLE_BLOCK_BUDGET_BYTES:
+        return _linear_relu_fwd_single(x, w, b2)
+    return linear_relu_fwd_tiled(x, w, b2, tile=TILE)
 
 
 def _bwd_kernel(g_ref, mask_ref, x_ref, w_ref, dx_ref, dw_ref, db_ref):
@@ -75,11 +179,10 @@ def _bwd_kernel(g_ref, mask_ref, x_ref, w_ref, dx_ref, dw_ref, db_ref):
     db_ref[:] = jnp.sum(ge, axis=0, keepdims=True)
 
 
-@functools.partial(jax.jit, static_argnames=())
-def linear_relu_bwd(g, mask, x, w):
+def _linear_relu_bwd_single(g, mask, x, w):
     mb, dout = g.shape
     din = x.shape[1]
-    dx, dw, db = pl.pallas_call(
+    return pl.pallas_call(
         _bwd_kernel,
         out_shape=(
             jax.ShapeDtypeStruct((mb, din), jnp.float32),
@@ -90,4 +193,105 @@ def linear_relu_bwd(g, mask, x, w):
         out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
         interpret=_interpret(),
     )(g, mask, x, w)
-    return dx, dw, db
+
+
+def _bwd_dx_kernel(g_ref, mask_ref, w_ref, dx_ref):
+    # grid = (row tiles i, in-col tiles j, out-col/contraction tiles c);
+    # c INNERMOST accumulates into the revisited dx block
+    c = pl.program_id(2)
+    ge = g_ref[:] * mask_ref[:]
+    partial = jnp.dot(ge, w_ref[:], preferred_element_type=jnp.float32)
+
+    @pl.when(c == 0)
+    def _init():
+        dx_ref[:] = partial
+
+    @pl.when(c != 0)
+    def _acc():
+        dx_ref[:] += partial
+
+
+def _bwd_dw_kernel(g_ref, mask_ref, x_ref, dw_ref, db_ref):
+    # grid = (out-col tiles j, in-col tiles k, row tiles i); i is INNERMOST so
+    # the revisited dw block accumulates partial products over row tiles
+    k = pl.program_id(1)
+    i = pl.program_id(2)
+    ge = g_ref[:] * mask_ref[:]
+    contrib = jnp.dot(ge.T, x_ref[:], preferred_element_type=jnp.float32)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[:] = contrib
+
+    @pl.when(i != 0)
+    def _acc():
+        dw_ref[:] += contrib
+
+    # db is independent of the in-col tiling: accumulate on k == 0 only
+    dbc = jnp.sum(ge, axis=0, keepdims=True)
+
+    @pl.when((k == 0) & (i == 0))
+    def _db_init():
+        db_ref[:] = dbc
+
+    @pl.when((k == 0) & (i != 0))
+    def _db_acc():
+        db_ref[:] += dbc
+
+
+def linear_relu_bwd_tiled(g, mask, x, w, tile=TILE):
+    """Grid-tiled backward, two kernels, every dim tiled (per-block VMEM is
+    ~4 tile^2 floats regardless of shape): dx on a (row x in-col x out-col)
+    grid accumulating over the innermost out-col/contraction tiles; dw/db on
+    a (out-col x in-col x row) grid accumulating over the innermost row
+    tiles."""
+    mb, dout = g.shape
+    din = x.shape[1]
+    gp = _pad_to(_pad_to(g, 0, tile), 1, tile)
+    mp = _pad_to(_pad_to(mask, 0, tile), 1, tile)
+    xp = _pad_to(_pad_to(x, 0, tile), 1, tile)
+    wp = _pad_to(_pad_to(w, 0, tile), 1, tile)
+    mbp, doutp = gp.shape
+    dinp = xp.shape[1]
+    dx = pl.pallas_call(
+        _bwd_dx_kernel,
+        grid=(mbp // tile, dinp // tile, doutp // tile),
+        out_shape=jax.ShapeDtypeStruct((mbp, dinp), jnp.float32),
+        in_specs=[
+            pl.BlockSpec((tile, tile), lambda i, j, c: (i, c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, tile), lambda i, j, c: (i, c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, tile), lambda i, j, c: (c, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile, tile), lambda i, j, c: (i, j), memory_space=pltpu.VMEM
+        ),
+        interpret=_interpret(),
+    )(gp, mp, wp)
+    dw, db = pl.pallas_call(
+        _bwd_dw_kernel,
+        grid=(doutp // tile, dinp // tile, mbp // tile),
+        out_shape=(
+            jax.ShapeDtypeStruct((doutp, dinp), jnp.float32),
+            jax.ShapeDtypeStruct((1, doutp), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec((tile, tile), lambda j, k, i: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, tile), lambda j, k, i: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, tile), lambda j, k, i: (i, k), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((tile, tile), lambda j, k, i: (j, k), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), lambda j, k, i: (0, j), memory_space=pltpu.VMEM),
+        ),
+        interpret=_interpret(),
+    )(gp, mp, xp)
+    return dx[:mb, :din], dw[:dout, :din], db[:, :dout]
+
+
+@functools.partial(jax.jit, static_argnames=())
+def linear_relu_bwd(g, mask, x, w):
+    mb, dout = g.shape
+    din = x.shape[1]
+    if _bwd_bytes(mb, din, dout) <= SINGLE_BLOCK_BUDGET_BYTES:
+        return _linear_relu_bwd_single(g, mask, x, w)
+    return linear_relu_bwd_tiled(g, mask, x, w, tile=TILE)
