@@ -1,0 +1,188 @@
+"""One-shot TPU measurement capture: everything BASELINE.md needs, one claim.
+
+The axon TPU tunnel is single-client and historically fragile, so when it IS
+healthy we capture every number in one process/one device claim:
+
+  1. NumPy reference baseline (host CPU — the denominator, bench.py protocol);
+  2. headline: fused fp32 sequential epoch throughput, scan-unroll sweep;
+  3. the single-chip tuning matrix (fusion x precision x pallas backend) —
+     the pallas cells compile for real on the chip (non-interpret mode);
+  4. 20-epoch flagship convergence on the prepared dataset, with per-epoch
+     validation accuracy (end-to-end wall time, final accuracy, model hash);
+  5. a jax.profiler trace of one post-compile epoch (artifacts/tpu_trace/).
+
+Writes TPU_CAPTURE_r02.json at the repo root and prints a summary table.
+Run:  python scripts/tpu_capture.py [--quick]
+A wedged tunnel is detected by bench.py's subprocess probe and aborts the
+capture with exit 3 (nothing is written).
+"""
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+import bench  # the probe + the NumPy baseline + the headline protocol
+
+
+def headline_sweep(unrolls, n_epochs):
+    import jax
+    import jax.numpy as jnp
+
+    from shallowspeed_tpu import model as Mo
+    from shallowspeed_tpu import trainer
+    from shallowspeed_tpu.api import (
+        FLAGSHIP_BATCH as B,
+        FLAGSHIP_LR as LR,
+        FLAGSHIP_MUBATCHES as M,
+        FLAGSHIP_SIZES as SIZES,
+    )
+    from shallowspeed_tpu.optimizer import SGD
+
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    nb = bench.N_SAMPLES // B
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.rand(nb, M, B // M, SIZES[0]).astype(np.float32))
+    Y = jnp.asarray(
+        np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, M, B // M))]
+    )
+    out = {}
+    for unroll in unrolls:
+        params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+        epoch = trainer.make_train_epoch(
+            spec, SGD(LR), fuse_mubatches=True, unroll=unroll
+        )
+        params, st, _ = epoch(params, (), X, Y)
+        jax.block_until_ready(params)
+        t0 = time.perf_counter()
+        for _ in range(n_epochs):
+            params, st, _ = epoch(params, st, X, Y)
+        jax.block_until_ready(params)
+        sps = n_epochs * nb * B / (time.perf_counter() - t0)
+        out[f"unroll={unroll}"] = round(sps, 1)
+        print(f"  headline fused fp32 unroll={unroll}: {sps:,.0f} samples/s", flush=True)
+    return out
+
+
+def convergence_run(data_dir, epochs):
+    from shallowspeed_tpu.api import TrainingSession
+
+    run = TrainingSession(data_dir=data_dir)
+    accs, losses = [], []
+    train_time = 0.0
+    for _ in range(epochs):
+        t0 = time.perf_counter()
+        losses.append(run.train_epoch())
+        train_time += time.perf_counter() - t0  # eval excluded from the clock
+        accs.append(round(run.accuracy(), 4))
+    n = run.batches_per_epoch * run.B * epochs
+    result = {
+        "epochs": epochs,
+        "train_wall_s": round(train_time, 3),
+        "train_samples_per_sec": round(n / train_time, 1),
+        "per_epoch_val_accuracy": accs,
+        "final_val_accuracy": accs[-1],
+        "first_loss": round(losses[0], 4),
+        "final_loss": round(losses[-1], 4),
+        "model_hash": run.model_hash(),
+    }
+    print(f"  convergence: {result}", flush=True)
+    return result
+
+
+def profile_one_epoch(data_dir, trace_dir):
+    import jax
+
+    from shallowspeed_tpu.api import TrainingSession
+
+    run = TrainingSession(data_dir=data_dir)
+    run.train_epoch()  # compile
+    with jax.profiler.trace(str(trace_dir)):
+        run.train_epoch()
+    files = [str(p.relative_to(trace_dir)) for p in Path(trace_dir).rglob("*") if p.is_file()]
+    print(f"  trace: {len(files)} files in {trace_dir}", flush=True)
+    return {"dir": str(trace_dir), "n_files": len(files)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default="/tmp/ssd_data")
+    ap.add_argument("--quick", action="store_true", help="fewer reps/epochs")
+    ap.add_argument("--out", default=str(ROOT / "TPU_CAPTURE_r02.json"))
+    args = ap.parse_args()
+
+    tag = bench._ensure_responsive_backend()
+    if tag:
+        print(f"tunnel not healthy ({tag}); aborting capture", file=sys.stderr)
+        sys.exit(3)
+
+    import jax
+
+    dev = jax.devices()[0]
+    info = {
+        "platform": dev.platform,
+        "device": str(dev),
+        "captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+    }
+    print(f"device: {info['device']} ({info['platform']})", flush=True)
+
+    if not Path(args.data_dir).is_dir():
+        import subprocess
+
+        subprocess.run(
+            [sys.executable, str(ROOT / "prepare_data.py"), "--save-dir", args.data_dir],
+            check=True,
+        )
+
+    print("1) NumPy baseline (host CPU)...", flush=True)
+    baseline = bench.numpy_baseline_sps(n_batches=10 if args.quick else 40)
+    print(f"  numpy: {baseline:,.0f} samples/s", flush=True)
+
+    print("2) headline sweep (fused fp32 sequential epoch)...", flush=True)
+    sweep = headline_sweep((1, 2, 4, 8), 2 if args.quick else 5)
+    best = max(sweep.values())
+
+    print("3) tuning matrix...", flush=True)
+    sys.path.insert(0, str(ROOT / "scripts"))
+    from bench_tpu_matrix import measure
+
+    matrix = {}
+    for fused in (False, True):
+        for prec in ("highest", "default"):
+            for pallas in (False, True):
+                key = (
+                    ("fused" if fused else "scanned")
+                    + "+" + prec + "+" + ("pallas" if pallas else "xla")
+                )
+                sps = measure(fused, prec, pallas, 29 if args.quick else 116, 2)
+                matrix[key] = round(sps, 1)
+                print(f"  {key}: {sps:,.0f} samples/s", flush=True)
+
+    print("4) convergence (real dataset, per-epoch eval)...", flush=True)
+    conv = convergence_run(args.data_dir, 5 if args.quick else 20)
+
+    print("5) profiler trace...", flush=True)
+    trace = profile_one_epoch(args.data_dir, ROOT / "artifacts" / "tpu_trace")
+
+    result = {
+        "info": info,
+        "numpy_baseline_sps": round(baseline, 1),
+        "headline_sweep": sweep,
+        "headline_best_sps": best,
+        "vs_baseline": round(best / baseline, 2),
+        "matrix": matrix,
+        "convergence": conv,
+        "trace": trace,
+    }
+    Path(args.out).write_text(json.dumps(result, indent=2) + "\n")
+    print(json.dumps({"headline_best_sps": best, "vs_baseline": result["vs_baseline"]}))
+
+
+if __name__ == "__main__":
+    main()
