@@ -96,50 +96,70 @@ def relay_width(spec: ModelSpec) -> int:
     return max((s.in_dim for s in spec.stages[1:]), default=1)
 
 
-def stack_params(params_list, spec: ModelSpec):
+def interleave_order(n_stages: int, n_devices: int):
+    """Device-major stacked-row order for interleaved layouts: stacked row
+    ``r = device * V + chunk`` holds model stage ``chunk * P + device``, so a
+    plain P('pp') shard of the stage axis gives device ``d`` exactly its V
+    virtual chunks, contiguously."""
+    assert n_stages % n_devices == 0
+    V = n_stages // n_devices
+    return [(r % V) * n_devices + (r // V) for r in range(n_stages)]
+
+
+def stack_params(params_list, spec: ModelSpec, order=None):
     """Per-stage ragged params -> per-slot zero-padded stacks + flags.
 
     Returns (stacked, flags):
       stacked = {"W": tuple_l of (S, out_l, in_l), "b": tuple_l of (S, out_l)}
       flags   = {"active": (S,L), "relu": (S,L), "head_mask": (S, out_last)}
     All numpy; device-put with ``put_stacked`` (P('pp') on the stage axis).
+    ``order[r]`` names the model stage stored at stacked row r (identity by
+    default; ``interleave_order`` for virtual-stage layouts).
     """
     dims = slot_shapes(spec)
     S = spec.n_stages
     L = len(dims)
+    order = list(range(S)) if order is None else list(order)
+    assert sorted(order) == list(range(S)), "order must permute 0..S-1"
     Ws = [np.zeros((S, o, i), np.float32) for o, i in dims]
     bs = [np.zeros((S, o), np.float32) for o, _ in dims]
     active = np.zeros((S, L), np.bool_)
     relu = np.zeros((S, L), np.bool_)
     head_mask = np.zeros((S, dims[-1][0]), np.bool_)
-    for s, (sspec, sparams) in enumerate(zip(spec.stages, params_list)):
+    for r, s in enumerate(order):
+        sspec, sparams = spec.stages[s], params_list[s]
         for l, layer in enumerate(sparams):
             out_d, in_d = layer["W"].shape
-            Ws[l][s, :out_d, :in_d] = np.asarray(layer["W"])
-            bs[l][s, :out_d] = np.asarray(layer["b"]).reshape(-1)
-            active[s, l] = True
-            relu[s, l] = sspec.relu_flags[l]
+            Ws[l][r, :out_d, :in_d] = np.asarray(layer["W"])
+            bs[l][r, :out_d] = np.asarray(layer["b"]).reshape(-1)
+            active[r, l] = True
+            relu[r, l] = sspec.relu_flags[l]
         if sspec.has_head:
-            head_mask[s, : sspec.out_dim] = True
+            head_mask[r, : sspec.out_dim] = True
     return (
         {"W": tuple(Ws), "b": tuple(bs)},
         {"active": active, "relu": relu, "head_mask": head_mask},
     )
 
 
-def unstack_params(stacked, spec: ModelSpec):
-    """Extract the logical ragged per-stage params back out (host numpy)."""
+def unstack_params(stacked, spec: ModelSpec, order=None):
+    """Extract the logical ragged per-stage params back out (host numpy),
+    inverting the stacking ``order`` so the result is in model-stage order."""
     Ws = [np.asarray(jax.device_get(w)) for w in stacked["W"]]
     bs = [np.asarray(jax.device_get(b)) for b in stacked["b"]]
+    S = spec.n_stages
+    order = list(range(S)) if order is None else list(order)
+    row_of = {s: r for r, s in enumerate(order)}
     out = []
     for s, sspec in enumerate(spec.stages):
+        r = row_of[s]
         layers = []
         for l in range(sspec.n_linears):
             in_d, out_d = sspec.local_sizes[l], sspec.local_sizes[l + 1]
             layers.append(
                 {
-                    "W": Ws[l][s, :out_d, :in_d].copy(),
-                    "b": bs[l][s, :out_d].reshape(1, -1).copy(),
+                    "W": Ws[l][r, :out_d, :in_d].copy(),
+                    "b": bs[l][r, :out_d].reshape(1, -1).copy(),
                 }
             )
         out.append(layers)
@@ -156,9 +176,9 @@ def put_stacked(stacked, flags, mesh: Mesh):
     )
 
 
-def init_stacked(spec: ModelSpec, mesh: Mesh):
+def init_stacked(spec: ModelSpec, mesh: Mesh, order=None):
     """Deterministic init, stacked + device_put with pp sharding."""
-    stacked, flags = stack_params(init_model(spec), spec)
+    stacked, flags = stack_params(init_model(spec), spec, order=order)
     return put_stacked(stacked, flags, mesh)
 
 
@@ -247,7 +267,10 @@ def make_pipeline_step(
     training = prog.is_training
     if training and opt is None:
         raise ValueError("training program needs an optimizer")
-    assert prog.num_stages == S_ == mesh.shape["pp"], "program/mesh stage mismatch"
+    P_ = mesh.shape["pp"]  # devices on the pp axis
+    V = prog.num_chunks  # virtual stages per device
+    assert prog.num_stages == P_, "program/mesh device-count mismatch"
+    assert S_ == P_ * V, "model stages must equal devices x virtual chunks"
 
     # tick tables as device constants, scanned over their leading (T) axis
     tabs = jax.tree.map(
@@ -263,21 +286,33 @@ def make_pipeline_step(
             sb=prog.send_bwd,
             sw=prog.stash_write,
             sr=prog.stash_read,
+            ck=prog.chunk,
+            li=prog.load_in,
+            ih=prog.is_head,
         ),
     )
-    fwd_perm = [(s, s + 1) for s in range(S_ - 1)]
-    bwd_perm = [(s, s - 1) for s in range(1, S_)]
+    # ring shifts: with virtual chunks the device-(P-1) -> device-0 wrap IS a
+    # stage boundary (chunk c on the last device feeds chunk c+1 on the
+    # first); without chunks nothing ever sends on the wrap link and its zero
+    # payload lands in the receiver's trash slot
+    fwd_perm = [(d, (d + 1) % P_) for d in range(P_)]
+    bwd_perm = [(d, (d - 1) % P_) for d in range(P_)]
 
     def per_device(stacked, flags, opt_state, x, y):
-        # local views: stage axis is sharded to size 1 on pp
-        Ws = [w[0] for w in stacked["W"]]  # per slot (out_l, in_l)
-        bs = [b[0] for b in stacked["b"]]
-        active = flags["active"][0]  # (L,)
-        relu = flags["relu"][0]
-        head_mask = flags["head_mask"][0]  # (D_out,)
+        # local views: stage axis is sharded to V rows per device on pp
+        # (device-major interleaved order, so row v IS virtual chunk v)
+        WsV = stacked["W"]  # per slot (V, out_l, in_l)
+        bsV = stacked["b"]
+        activeV = flags["active"]  # (V, L)
+        reluV = flags["relu"]
+        head_maskV = flags["head_mask"]  # (V, D_out)
         stage = lax.axis_index("pp")
-        is_first = stage == 0
-        is_last = stage == S_ - 1
+
+        def pick(a, v):
+            """Select the active virtual chunk's row (static for V == 1)."""
+            if V == 1:
+                return a[0]
+            return lax.dynamic_index_in_dim(a, v, 0, keepdims=False)
 
         x = x.reshape(M, mb_sz, D_in)  # local dp shard, padded to D_in
         y = y.reshape(M, mb_sz, D_out) if y is not None else None
@@ -297,8 +332,8 @@ def make_pipeline_step(
                     jnp.zeros((Ks + 1, mb_sz, o), jnp.bool_) for o, _ in dims
                 ),
                 z=jnp.zeros((Ks + 1, mb_sz, D_out), jnp.float32),
-                gW=tuple(jnp.zeros((o, i), jnp.float32) for o, i in dims),
-                gb=tuple(jnp.zeros((o,), jnp.float32) for o, _ in dims),
+                gW=tuple(jnp.zeros((V, o, i), jnp.float32) for o, i in dims),
+                gb=tuple(jnp.zeros((V, o), jnp.float32) for o, _ in dims),
                 loss=jnp.zeros((), jnp.float32),
             )
         else:
@@ -310,16 +345,25 @@ def make_pipeline_step(
             opv = row["op"][stage]
             mb_i = row["mb"][stage]  # M = trash
             mb_r = jnp.minimum(mb_i, M - 1)  # clamped read index
+            v = row["ck"][stage]  # active virtual chunk (0 when V == 1)
+            load_in = row["li"][stage] == 1  # compute is the global stage 0 fwd
+            is_head = row["ih"][stage] == 1  # compute is the global last stage
+
+            def chunk_params():
+                Ws = [pick(w, v) for w in WsV]
+                bs = [pick(b, v) for b in bsV]
+                return Ws, bs, pick(activeV, v), pick(reluV, v), pick(head_maskV, v)
 
             def noop(c):
                 return c, zero_fwd, zero_bwd
 
             def forward(c):
-                # non-first stages receive a W_rel-wide relay; pad it up to
+                Ws, bs, active, relu, head_mask = chunk_params()
+                # non-input stages receive a W_rel-wide relay; pad it up to
                 # D_in so both branches of the where agree (exact: relayed
                 # activations are zero beyond their true boundary width)
                 x_in = jnp.where(
-                    is_first, x[mb_r], _fit(c["fwd_mail"][row["rf"][stage]], D_in)
+                    load_in, x[mb_r], _fit(c["fwd_mail"][row["rf"][stage]], D_in)
                 )
                 out, xs_l, masks_l = _stage_fwd(
                     Ws, bs, active, relu, dims, x_in, precision
@@ -329,20 +373,21 @@ def make_pipeline_step(
                 if training:
                     sw = row["sw"][stage]  # lowering-assigned stash slot
                     c["xs"] = tuple(
-                        buf.at[sw].set(v) for buf, v in zip(c["xs"], xs_l)
+                        buf.at[sw].set(val) for buf, val in zip(c["xs"], xs_l)
                     )
                     c["masks"] = tuple(
-                        buf.at[sw].set(v) for buf, v in zip(c["masks"], masks_l)
+                        buf.at[sw].set(val) for buf, val in zip(c["masks"], masks_l)
                     )
                     c["z"] = c["z"].at[sw].set(out)
                     mb_loss = ops.mse_loss(p, y[mb_r], B_global)
-                    c["loss"] = c["loss"] + jnp.where(is_last, mb_loss, 0.0)
+                    c["loss"] = c["loss"] + jnp.where(is_head, mb_loss, 0.0)
                 else:
-                    c["preds"] = c["preds"].at[mb_i].set(jnp.where(is_last, p, 0.0))
+                    c["preds"] = c["preds"].at[mb_i].set(jnp.where(is_head, p, 0.0))
                 payload = jnp.where(row["sf"][stage] == 1, _fit(out, W_rel), 0.0)
                 return c, payload, zero_bwd
 
             def backward(c):
+                Ws, bs, active, relu, head_mask = chunk_params()
                 # lowering guarantees every training backward has a real
                 # stash slot in [0, Ks) (replay-asserted), so no clamp needed
                 sr = row["sr"][stage]
@@ -353,7 +398,7 @@ def make_pipeline_step(
                 # to the wider so the where agrees (padding is exact zeros)
                 Wb = max(D_out, W_rel)
                 g_in = jnp.where(
-                    is_last, _fit(g0, Wb), _fit(c["bwd_mail"][row["rb"][stage]], Wb)
+                    is_head, _fit(g0, Wb), _fit(c["bwd_mail"][row["rb"][stage]], Wb)
                 )
                 xs_r = tuple(buf[sr] for buf in c["xs"])
                 masks_r = tuple(buf[sr] for buf in c["masks"])
@@ -361,8 +406,12 @@ def make_pipeline_step(
                     Ws, active, relu, dims, xs_r, masks_r, g_in, precision
                 )
                 c = dict(c)
-                c["gW"] = tuple(a + d for a, d in zip(c["gW"], gW_d))
-                c["gb"] = tuple(a + d for a, d in zip(c["gb"], gb_d))
+                if V == 1:
+                    c["gW"] = tuple(a.at[0].add(d) for a, d in zip(c["gW"], gW_d))
+                    c["gb"] = tuple(a.at[0].add(d) for a, d in zip(c["gb"], gb_d))
+                else:
+                    c["gW"] = tuple(a.at[v].add(d) for a, d in zip(c["gW"], gW_d))
+                    c["gb"] = tuple(a.at[v].add(d) for a, d in zip(c["gb"], gb_d))
                 payload = jnp.where(row["sb"][stage] == 1, _fit(dx, W_rel), 0.0)
                 return c, zero_fwd, payload
 
@@ -384,21 +433,20 @@ def make_pipeline_step(
 
         if not training:
             preds = carry["preds"][:M].reshape(M * mb_sz, D_out)
-            # only the last stage holds predictions; broadcast them over pp
-            return lax.psum(jnp.where(is_last, preds, 0.0), "pp")
+            # only head-stage ticks ever wrote predictions (zeros elsewhere);
+            # broadcast them over pp
+            return lax.psum(preds, "pp")
 
         # the BackwardGradAllReduce anchor: one SUM-psum of the whole gradient
         # pytree over dp per batch (reference pipe.py:302-327)
         gW = lax.psum(carry["gW"], "dp")
         gb = lax.psum(carry["gb"], "dp")
-        loss = lax.psum(jnp.where(is_last, carry["loss"], 0.0), "dp")
-        loss = lax.pmax(loss, "pp")  # replicate scalar across stages
+        # loss was only accumulated on head-stage ticks (zero elsewhere)
+        loss = lax.psum(carry["loss"], "dp")
+        loss = lax.pmax(loss, "pp")  # replicate scalar across devices
 
         local = {"W": stacked["W"], "b": stacked["b"]}
-        grads = {
-            "W": tuple(g[None] for g in gW),
-            "b": tuple(g[None] for g in gb),
-        }
+        grads = {"W": gW, "b": gb}  # (V, ...) leaves, mirroring the shards
         new_local, opt_state = opt.apply(local, grads, opt_state)
         return new_local, opt_state, loss
 

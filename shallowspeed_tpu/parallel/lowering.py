@@ -41,23 +41,32 @@ OP_NOOP, OP_FWD, OP_BWD = 0, 1, 2
 
 @dataclasses.dataclass(frozen=True)
 class WorkItem:
-    """One compute event parsed from a stage's instruction stream."""
+    """One compute event parsed from a device's instruction stream."""
 
     kind: int  # OP_FWD | OP_BWD
     mubatch_id: int
-    needs_fwd_msg: bool = False  # consumes activations from stage-1
-    needs_bwd_msg: bool = False  # consumes output-grad from stage+1
-    sends_fwd: bool = False  # emits activations to stage+1
-    sends_bwd: bool = False  # emits input-grad to stage-1
+    chunk: int = 0  # virtual-stage chunk on this device (0 unless interleaved)
+    needs_fwd_msg: bool = False  # consumes activations from the prior stage
+    needs_bwd_msg: bool = False  # consumes output-grad from the next stage
+    sends_fwd: bool = False  # emits activations to the next stage
+    sends_bwd: bool = False  # emits input-grad to the prior stage
     allreduce: bool = False  # this backward anchors the DP all-reduce
 
 
 @dataclasses.dataclass(frozen=True)
 class TickProgram:
-    """Static SPMD program: everything the executor's scan body indexes."""
+    """Static SPMD program: everything the executor's scan body indexes.
+
+    Tables are indexed [tick, device]. Without interleaving a device IS a
+    stage (num_chunks == 1, ``chunk`` all zeros); with interleaving each
+    device runs ``num_chunks`` virtual stages and ``chunk`` names the one
+    active at each tick. ``load_in``/``is_head`` mark the ticks whose compute
+    belongs to the global first/last model stage (replacing the
+    device-position tests that stop working once stage identity varies per
+    tick)."""
 
     num_ticks: int
-    num_stages: int
+    num_stages: int  # number of DEVICES on the pp axis
     num_micro_batches: int
     n_fwd_slots: int  # mailbox depths (trash slot = index n_slots)
     n_bwd_slots: int
@@ -73,20 +82,35 @@ class TickProgram:
     send_bwd: np.ndarray  # (T, S) int32 0/1: emit bwd payload this tick
     stash_write: np.ndarray  # (T, S) int32: stash slot a forward fills (trash if none)
     stash_read: np.ndarray  # (T, S) int32: stash slot a backward consumes (trash)
+    num_chunks: int = 1  # virtual stages per device (V)
+    chunk: np.ndarray = None  # (T, S) int32: active virtual chunk (0 on noops)
+    load_in: np.ndarray = None  # (T, S) int32 0/1: compute is global stage 0 fwd
+    is_head: np.ndarray = None  # (T, S) int32 0/1: compute is the global last stage
 
 
 class ScheduleLoweringError(ValueError):
     pass
 
 
-def parse_stage_stream(commands, stage_id, num_stages, training=True):
-    """Flatten one stage's instruction stream into WorkItems + validate.
+def parse_stage_stream(commands, stage_id, num_stages, training=True, num_chunks=1):
+    """Flatten one device's instruction stream into WorkItems + validate.
 
     Recv/Load instructions bind to the NEXT compute; Send instructions bind
     to the PREVIOUS compute — the same dataflow the reference Worker's buffer
     semantics imply (pipe.py:355-406: recv fills the buffer the next
     forward/backward reads; send ships the buffer the last compute wrote).
+
+    Endpoint rules are in terms of the GLOBAL model stage ``chunk * P +
+    device``: only stage 0 loads inputs / cannot receive activations or send
+    input-grads; only stage S-1 loads targets / cannot receive output-grads
+    or send activations. With num_chunks == 1 these reduce to the
+    device-position rules.
     """
+    last_stage_g = num_chunks * num_stages - 1
+
+    def stage_g(chunk):
+        return chunk * num_stages + stage_id
+
     items = []
     pend_fwd_msg = pend_bwd_msg = False
     seen_zero = seen_opt = False
@@ -100,13 +124,13 @@ def parse_stage_stream(commands, stage_id, num_stages, training=True):
                 raise ScheduleLoweringError("duplicate OptimizerStep")
             seen_opt = True
         elif isinstance(cmd, S.RecvActivations):
-            if stage_id == 0:
+            if num_chunks == 1 and stage_id == 0:
                 raise ScheduleLoweringError("stage 0 cannot RecvActivations")
             if pend_fwd_msg:
                 raise ScheduleLoweringError("two RecvActivations before a Forward")
             pend_fwd_msg = True
         elif isinstance(cmd, S.RecvOutputGrad):
-            if stage_id == num_stages - 1:
+            if num_chunks == 1 and stage_id == num_stages - 1:
                 raise ScheduleLoweringError("last stage cannot RecvOutputGrad")
             if pend_bwd_msg:
                 raise ScheduleLoweringError("two RecvOutputGrads before a Backward")
@@ -122,8 +146,13 @@ def parse_stage_stream(commands, stage_id, num_stages, training=True):
                 raise ScheduleLoweringError("compute after OptimizerStep")
             if pend_bwd_msg:
                 raise ScheduleLoweringError("RecvOutputGrad not consumed by a Backward")
+            if pend_fwd_msg and stage_g(cmd.chunk_id) == 0:
+                raise ScheduleLoweringError("global stage 0 cannot RecvActivations")
             items.append(
-                WorkItem(OP_FWD, cmd.mubatch_id, needs_fwd_msg=pend_fwd_msg)
+                WorkItem(
+                    OP_FWD, cmd.mubatch_id, chunk=cmd.chunk_id,
+                    needs_fwd_msg=pend_fwd_msg,
+                )
             )
             pend_fwd_msg = False
         elif isinstance(cmd, (S.BackwardGradAcc, S.BackwardGradAllReduce)):
@@ -131,30 +160,33 @@ def parse_stage_stream(commands, stage_id, num_stages, training=True):
                 raise ScheduleLoweringError("compute after OptimizerStep")
             if pend_fwd_msg:
                 raise ScheduleLoweringError("RecvActivations not consumed by a Forward")
+            if pend_bwd_msg and stage_g(cmd.chunk_id) == last_stage_g:
+                raise ScheduleLoweringError("global last stage cannot RecvOutputGrad")
             items.append(
                 WorkItem(
                     OP_BWD,
                     cmd.mubatch_id,
+                    chunk=cmd.chunk_id,
                     needs_bwd_msg=pend_bwd_msg,
                     allreduce=isinstance(cmd, S.BackwardGradAllReduce),
                 )
             )
             pend_bwd_msg = False
         elif isinstance(cmd, S.SendActivations):
-            if stage_id == num_stages - 1:
-                raise ScheduleLoweringError("last stage cannot SendActivations")
             if not items or items[-1].kind != OP_FWD or items[-1].sends_fwd:
                 raise ScheduleLoweringError(
                     "SendActivations must directly follow its Forward"
                 )
+            if stage_g(items[-1].chunk) == last_stage_g:
+                raise ScheduleLoweringError("global last stage cannot SendActivations")
             items[-1] = dataclasses.replace(items[-1], sends_fwd=True)
         elif isinstance(cmd, S.SendInputGrad):
-            if stage_id == 0:
-                raise ScheduleLoweringError("stage 0 cannot SendInputGrad")
             if not items or items[-1].kind != OP_BWD or items[-1].sends_bwd:
                 raise ScheduleLoweringError(
                     "SendInputGrad must directly follow its Backward"
                 )
+            if stage_g(items[-1].chunk) == 0:
+                raise ScheduleLoweringError("global stage 0 cannot SendInputGrad")
             items[-1] = dataclasses.replace(items[-1], sends_bwd=True)
         else:
             raise ScheduleLoweringError(f"unknown instruction {cmd!r}")
@@ -162,41 +194,45 @@ def parse_stage_stream(commands, stage_id, num_stages, training=True):
         raise ScheduleLoweringError("dangling Recv with no consuming compute")
     if training and not (seen_zero and seen_opt):
         raise ScheduleLoweringError("training stream must bracket with ZeroGrad/OptimizerStep")
+    for it in items:
+        if not 0 <= it.chunk < num_chunks:
+            raise ScheduleLoweringError(f"chunk {it.chunk} out of range [0,{num_chunks})")
     return items
 
 
 class _Mailbox:
-    """Receiver-side slot allocator for one direction at one stage."""
+    """Receiver-side slot allocator for one direction at one device."""
 
     def __init__(self):
         self.free_from = []  # per slot: earliest tick this slot may take an arrival
-        self.msgs = []  # FIFO of (sent_tick, slot, mubatch_id)
+        self.msgs = []  # FIFO of (sent_tick, slot, key)
 
-    def deliver(self, tick, mubatch_id):
+    def deliver(self, tick, key):
         for i, f in enumerate(self.free_from):
             if f <= tick:
                 self.free_from[i] = np.inf  # occupied
-                self.msgs.append((tick, i, mubatch_id))
+                self.msgs.append((tick, i, key))
                 return i
         self.free_from.append(np.inf)
-        self.msgs.append((tick, len(self.free_from) - 1, mubatch_id))
+        self.msgs.append((tick, len(self.free_from) - 1, key))
         return len(self.free_from) - 1
 
-    def _find(self, tick, mubatch_id):
-        for i, (sent, _, mb) in enumerate(self.msgs):
-            if sent < tick and mb == mubatch_id:
+    def _find(self, tick, key):
+        for i, (sent, _, k) in enumerate(self.msgs):
+            if sent < tick and k == key:
                 return i
         return None
 
-    def consumable(self, tick, mubatch_id):
-        """A delivered message for exactly this microbatch is available.
-        Binding consumption by mubatch_id (not FIFO position) both supports
-        out-of-order consumers and turns sender/receiver order mismatches
-        into visible deadlocks instead of silently mispairing activations."""
-        return self._find(tick, mubatch_id) is not None
+    def consumable(self, tick, key):
+        """A delivered message for exactly this (chunk, microbatch) is
+        available. Binding consumption by key (not FIFO position) both
+        supports out-of-order consumers and turns sender/receiver order
+        mismatches into visible deadlocks instead of silently mispairing
+        activations."""
+        return self._find(tick, key) is not None
 
-    def consume(self, tick, mubatch_id):
-        i = self._find(tick, mubatch_id)
+    def consume(self, tick, key):
+        i = self._find(tick, key)
         assert i is not None
         _, slot, _ = self.msgs.pop(i)
         self.free_from[slot] = tick  # reusable for arrivals this very tick
@@ -207,14 +243,30 @@ class _Mailbox:
         return len(self.free_from)
 
 
-def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
-    """Compile a Schedule class into a TickProgram for (M, S)."""
+def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None, virtual=1):
+    """Compile a Schedule class into a TickProgram.
+
+    ``num_stages`` is the number of pp DEVICES; ``virtual`` (V) is the number
+    of virtual stages per device for interleaved schedules (the model has
+    ``num_stages * virtual`` stages, stage ``s`` on device ``s % num_stages``
+    as chunk ``s // num_stages``). V=1 is the ordinary one-stage-per-device
+    case."""
+    if issubclass(schedule_cls, S.InterleavedSchedule):
+        kw = {"num_chunks": virtual}  # V=1 degenerates to one chunk per device
+    elif virtual != 1:
+        raise ScheduleLoweringError(
+            f"virtual={virtual} requires an interleaved schedule; "
+            f"{schedule_cls.__name__} places one stage per device"
+        )
+    else:
+        kw = {}
     streams = [
         S.flat_commands(
             schedule_cls(
                 num_micro_batches=num_micro_batches,
                 num_stages=num_stages,
                 stage_id=s,
+                **kw,
             )
         )
         for s in range(num_stages)
@@ -222,19 +274,22 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
     if training is None:
         training = any(isinstance(c, S.OptimizerStep) for c in streams[0])
     stage_items = [
-        parse_stage_stream(streams[s], s, num_stages, training)
+        parse_stage_stream(streams[s], s, num_stages, training, num_chunks=virtual)
         for s in range(num_stages)
     ]
 
-    # validate per-stage microbatch coverage
+    # validate per-device (chunk, microbatch) coverage
+    want = sorted(
+        (c, mb) for c in range(virtual) for mb in range(num_micro_batches)
+    )
     for s, items in enumerate(stage_items):
-        fwd = sorted(i.mubatch_id for i in items if i.kind == OP_FWD)
-        if fwd != list(range(num_micro_batches)):
-            raise ScheduleLoweringError(f"stage {s}: forwards {fwd} != 0..M-1")
+        fwd = sorted((i.chunk, i.mubatch_id) for i in items if i.kind == OP_FWD)
+        if fwd != want:
+            raise ScheduleLoweringError(f"stage {s}: forwards {fwd} != chunks x 0..M-1")
         if training:
-            bwd = sorted(i.mubatch_id for i in items if i.kind == OP_BWD)
-            if bwd != list(range(num_micro_batches)):
-                raise ScheduleLoweringError(f"stage {s}: backwards {bwd} != 0..M-1")
+            bwd = sorted((i.chunk, i.mubatch_id) for i in items if i.kind == OP_BWD)
+            if bwd != want:
+                raise ScheduleLoweringError(f"stage {s}: backwards {bwd} != chunks x 0..M-1")
             ars = [i for i in items if i.allreduce]
             bwds = [i for i in items if i.kind == OP_BWD]
             if len(ars) != 1 or bwds[-1] is not ars[0]:
@@ -243,46 +298,57 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
                 )
 
     # --- greedy tick simulation -------------------------------------------
-    ptr = [0] * num_stages
-    fwd_mail = [_Mailbox() for _ in range(num_stages)]  # from s-1
-    bwd_mail = [_Mailbox() for _ in range(num_stages)]  # from s+1
+    # one compute per DEVICE per tick; messages keyed (chunk, microbatch).
+    # Forward sends from device d chunk c go to the global next stage, which
+    # is ALWAYS device (d+1) % P: chunk c for d < P-1, chunk c+1 on the ring
+    # wrap d = P-1 -> 0. Backward mirrors it. That ring structure is why the
+    # executor can use one uniform ppermute shift per direction.
+    P = num_stages
+    last_stage_g = virtual * P - 1
+    ptr = [0] * P
+    fwd_mail = [_Mailbox() for _ in range(P)]  # from the prior stage
+    bwd_mail = [_Mailbox() for _ in range(P)]  # from the next stage
     # activation-stash allocation (training only): a forward claims a slot
     # for its residuals; the matching backward frees it. Slot pressure is
     # therefore the schedule's REAL activation memory — GPipe peaks at M,
     # PipeDream-Flush at min(M, depth - stage): 1F1B's memory advantage
     # becomes physical buffer sizes, not just an instruction-stream property.
-    stash_free_from = [[] for _ in range(num_stages)]  # per stage, per slot
-    stash_of = [dict() for _ in range(num_stages)]  # mubatch -> slot
-    rows = []  # per tick: list of per-stage dicts
+    stash_free_from = [[] for _ in range(P)]  # per device, per slot
+    stash_of = [dict() for _ in range(P)]  # (chunk, mubatch) -> slot
+    rows = []  # per tick: list of per-device dicts
     t = 0
-    limit = 4 * num_micro_batches * num_stages + 8 * num_stages + 16
-    while any(ptr[s] < len(stage_items[s]) for s in range(num_stages)):
+    limit = 4 * virtual * num_micro_batches * P + 8 * virtual * P + 16
+    while any(ptr[s] < len(stage_items[s]) for s in range(P)):
         if t > limit:
             raise ScheduleLoweringError("schedule failed to converge (livelock?)")
         row = [
             dict(
                 op=OP_NOOP, mb=num_micro_batches, rf=-1, rb=-1, sf=0, sb=0,
-                inf=-1, inb=-1, sw=-1, sr=-1,
+                inf=-1, inb=-1, sw=-1, sr=-1, ck=0, li=0, ih=0,
             )
-            for _ in range(num_stages)
+            for _ in range(P)
         ]
-        arrivals = []  # (direction, to_stage)
+        arrivals = []  # (direction, to_device, key)
         progressed = False
-        for s in range(num_stages):
+        for s in range(P):
             if ptr[s] >= len(stage_items[s]):
                 continue
             item = stage_items[s][ptr[s]]
-            if item.needs_fwd_msg and not fwd_mail[s].consumable(t, item.mubatch_id):
+            key = (item.chunk, item.mubatch_id)
+            if item.needs_fwd_msg and not fwd_mail[s].consumable(t, key):
                 continue
-            if item.needs_bwd_msg and not bwd_mail[s].consumable(t, item.mubatch_id):
+            if item.needs_bwd_msg and not bwd_mail[s].consumable(t, key):
                 continue
             # execute item at tick t
+            stage_g = item.chunk * P + s
             r = row[s]
-            r["op"], r["mb"] = item.kind, item.mubatch_id
+            r["op"], r["mb"], r["ck"] = item.kind, item.mubatch_id, item.chunk
+            r["li"] = int(stage_g == 0 and item.kind == OP_FWD)
+            r["ih"] = int(stage_g == last_stage_g)
             if item.needs_fwd_msg:
-                r["rf"] = fwd_mail[s].consume(t, item.mubatch_id)
+                r["rf"] = fwd_mail[s].consume(t, key)
             if item.needs_bwd_msg:
-                r["rb"] = bwd_mail[s].consume(t, item.mubatch_id)
+                r["rb"] = bwd_mail[s].consume(t, key)
             if training and item.kind == OP_FWD:
                 free = stash_free_from[s]
                 for slot, f in enumerate(free):
@@ -292,26 +358,30 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
                     free.append(0)
                     slot = len(free) - 1
                 free[slot] = np.inf  # occupied until the matching backward
-                stash_of[s][item.mubatch_id] = slot
+                stash_of[s][key] = slot
                 r["sw"] = slot
             elif training and item.kind == OP_BWD:
-                slot = stash_of[s].pop(item.mubatch_id)
+                slot = stash_of[s].pop(key)
                 stash_free_from[s][slot] = t + 1  # reusable next tick
                 r["sr"] = slot
             if item.sends_fwd:
                 r["sf"] = 1
-                arrivals.append(("fwd", s + 1, item.mubatch_id))
+                dst = (s + 1) % P
+                dst_chunk = item.chunk + (1 if s == P - 1 else 0)
+                arrivals.append(("fwd", dst, (dst_chunk, item.mubatch_id)))
             if item.sends_bwd:
                 r["sb"] = 1
-                arrivals.append(("bwd", s - 1, item.mubatch_id))
+                dst = (s - 1) % P
+                dst_chunk = item.chunk - (1 if s == 0 else 0)
+                arrivals.append(("bwd", dst, (dst_chunk, item.mubatch_id)))
             ptr[s] += 1
             progressed = True
         if not progressed:
-            state = [(s, ptr[s], len(stage_items[s])) for s in range(num_stages)]
+            state = [(s, ptr[s], len(stage_items[s])) for s in range(P)]
             raise ScheduleLoweringError(f"deadlock at tick {t}: {state}")
-        for direction, dst, mb_id in arrivals:
+        for direction, dst, key in arrivals:
             mail = fwd_mail[dst] if direction == "fwd" else bwd_mail[dst]
-            slot = mail.deliver(t, mb_id)
+            slot = mail.deliver(t, key)
             row[dst]["inf" if direction == "fwd" else "inb"] = slot
         rows.append(row)
         t += 1
@@ -337,6 +407,11 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
                 out[ti, s] = trash if v == -1 else v
         return out
 
+    def raw(key):
+        return np.array(
+            [[r[s][key] for s in range(num_stages)] for r in rows], np.int32
+        )
+
     return TickProgram(
         num_ticks=T,
         num_stages=num_stages,
@@ -345,14 +420,18 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None):
         n_bwd_slots=K_b,
         n_stash_slots=K_s,
         is_training=training,
-        op=np.array([[r[s]["op"] for s in range(num_stages)] for r in rows], np.int32),
-        mb=np.array([[r[s]["mb"] for s in range(num_stages)] for r in rows], np.int32),
+        op=raw("op"),
+        mb=raw("mb"),
         read_fwd_slot=table("rf", K_f),
         read_bwd_slot=table("rb", K_b),
         in_fwd_slot=table("inf", K_f),
         in_bwd_slot=table("inb", K_b),
-        send_fwd=np.array([[r[s]["sf"] for s in range(num_stages)] for r in rows], np.int32),
-        send_bwd=np.array([[r[s]["sb"] for s in range(num_stages)] for r in rows], np.int32),
+        send_fwd=raw("sf"),
+        send_bwd=raw("sb"),
         stash_write=table("sw", K_s),
         stash_read=table("sr", K_s),
+        num_chunks=virtual,
+        chunk=raw("ck"),
+        load_in=raw("li"),
+        is_head=raw("ih"),
     )

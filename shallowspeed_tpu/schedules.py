@@ -70,6 +70,7 @@ class SendInputGrad(BufferInstruction):
 class ComputeInstruction(Instruction):
     buffer_id: int = 0
     mubatch_id: int = 0
+    chunk_id: int = 0  # virtual-stage chunk on this device (interleaved only)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -258,10 +259,128 @@ class InferenceSchedule(Schedule):
             yield self._fwd_step_send(mb)
 
 
+# ---------------------------------------------------------------------------
+# Interleaved (virtual-stage) schedules — beyond the reference.
+# ---------------------------------------------------------------------------
+
+
+class InterleavedSchedule(Schedule):
+    """Megatron-style interleaved pipeline: S = P x V model stages on P
+    devices, stage ``s`` on device ``s mod P`` as virtual chunk ``s // P``.
+    The reference has nothing like this (its Worker owns exactly one stage,
+    pipe.py:330-353); on TPU it is a natural fit because EVERY stage-to-stage
+    link — including the device-(P-1) -> device-0 wraps between chunks —
+    becomes the same ring ``ppermute`` shift over the ``pp`` axis.
+
+    This class emits per-DEVICE streams (stage_id is the device id), with
+    ``chunk_id`` on each compute naming the virtual stage. Schedule shape is
+    1F1B over (chunk, microbatch) pairs in Megatron's order — microbatches
+    grouped P at a time, each group pushed through every chunk before the
+    next group starts — which shrinks the pipeline-fill bubble by ~V versus
+    giving each device one fat stage. Requires M % P == 0 (same restriction
+    as Megatron's interleaved mode).
+
+    Subclasses set ``num_chunks`` via the constructor (V=1 degenerates to
+    PipeDream-Flush over P stages).
+    """
+
+    def __init__(self, num_micro_batches, num_stages, stage_id, num_chunks=2):
+        super().__init__(num_micro_batches, num_stages, stage_id)
+        if num_micro_batches % num_stages != 0:
+            raise ValueError(
+                f"interleaved schedule needs M % P == 0 "
+                f"(got M={num_micro_batches}, P={num_stages})"
+            )
+        assert num_chunks >= 1
+        self.num_chunks = num_chunks
+
+    # (chunk, microbatch) of the k-th forward in device execution order
+    def _fwd_k(self, k):
+        P = self.num_stages
+        return (k // P) % self.num_chunks, (k // (P * self.num_chunks)) * P + k % P
+
+    # backwards run chunks in reverse
+    def _bwd_k(self, k):
+        P = self.num_stages
+        c = self.num_chunks - 1 - (k // P) % self.num_chunks
+        return c, (k // (P * self.num_chunks)) * P + k % P
+
+    def _is_input_end(self, chunk):
+        return self.is_first_stage and chunk == 0
+
+    def _is_head_end(self, chunk):
+        return self.is_last_stage and chunk == self.num_chunks - 1
+
+    def _ifwd(self, k):
+        c, mb = self._fwd_k(k)
+        cmds = []
+        if self._is_input_end(c):
+            cmds.append(LoadMuBatchInput(mubatch_id=mb))
+        else:
+            cmds.append(RecvActivations())
+        cmds.append(Forward(mubatch_id=mb, chunk_id=c))
+        if not self._is_head_end(c):
+            cmds.append(SendActivations())
+        return cmds
+
+    def _ibwd(self, k, total):
+        c, mb = self._bwd_k(k)
+        cmds = []
+        if self._is_head_end(c):
+            cmds.append(LoadMuBatchTarget(mubatch_id=mb))
+        else:
+            cmds.append(RecvOutputGrad())
+        cls = BackwardGradAllReduce if k == total - 1 else BackwardGradAcc
+        cmds.append(cls(mubatch_id=mb, chunk_id=c))
+        if not self._is_input_end(c):
+            cmds.append(SendInputGrad())
+        return cmds
+
+    def steps(self):
+        P, V, M = self.num_stages, self.num_chunks, self.num_micro_batches
+        total = M * V
+        # Megatron warmup: enough forwards to fill the pipeline ahead of the
+        # first backward, shrunk by rank and grown by (V-1) microbatch groups
+        warmup = min((P - self.stage_id - 1) * 2 + (V - 1) * P, total)
+        yield [ZeroGrad()]
+        for k in range(warmup):
+            yield self._ifwd(k)
+        fwd_k, bwd_k = warmup, 0
+        while fwd_k < total:
+            yield self._ifwd(fwd_k)
+            yield self._ibwd(bwd_k, total)
+            fwd_k += 1
+            bwd_k += 1
+        while bwd_k < total:
+            yield self._ibwd(bwd_k, total)
+            bwd_k += 1
+        yield [OptimizerStep()]
+
+
+class InterleavedInferenceSchedule(InterleavedSchedule):
+    """Forward-only relay over virtual chunks (interleaved accuracy path).
+    No M % P restriction — there is no 1F1B steady state to group for, so
+    microbatches simply stream through the chunks in stage order."""
+
+    def __init__(self, num_micro_batches, num_stages, stage_id, num_chunks=2):
+        Schedule.__init__(self, num_micro_batches, num_stages, stage_id)
+        assert num_chunks >= 1
+        self.num_chunks = num_chunks
+
+    def _fwd_k(self, k):
+        M = self.num_micro_batches
+        return k // M, k % M
+
+    def steps(self):
+        for k in range(self.num_micro_batches * self.num_chunks):
+            yield self._ifwd(k)
+
+
 SCHEDULES = {
     "naive": NaiveParallelSchedule,
     "gpipe": GPipeSchedule,
     "pipedream": PipeDreamFlushSchedule,
+    "interleaved": InterleavedSchedule,
 }
 
 
