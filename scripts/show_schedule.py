@@ -27,27 +27,31 @@ from shallowspeed_tpu.parallel.lowering import (  # noqa: E402
 ALL = {**S.SCHEDULES, "inference": S.InferenceSchedule}
 
 
-def render(name, M, stages):
-    prog = lower_schedule(ALL[name], M, stages)
-    width = max(2, len(str(M - 1)) + 1)
+def render(name, M, stages, virtual=1):
+    prog = lower_schedule(ALL[name], M, stages, virtual=virtual)
+    # interleaved cells carry the virtual chunk as a suffix: F2'1 = forward
+    # of microbatch 2, chunk 1
+    width = max(2, len(str(M - 1)) + 1) + (2 if virtual > 1 else 0)
     busy = 0
     lines = []
     for s in range(stages):
         cells = []
         for t in range(prog.num_ticks):
             op, mb = int(prog.op[t, s]), int(prog.mb[t, s])
+            ck = f"'{int(prog.chunk[t, s])}" if virtual > 1 else ""
             if op == OP_FWD:
-                cells.append(f"F{mb}".ljust(width))
+                cells.append(f"F{mb}{ck}".ljust(width))
                 busy += 1
             elif op == OP_BWD:
-                cells.append(f"B{mb}".ljust(width))
+                cells.append(f"B{mb}{ck}".ljust(width))
                 busy += 1
             else:
                 cells.append(".".ljust(width))
         lines.append(f"stage {s} │ " + " ".join(cells))
     util = busy / (prog.num_ticks * stages)
+    vtag = f" V={virtual}" if virtual > 1 else ""
     header = (
-        f"{name}  M={M} S={stages}: {prog.num_ticks} ticks, "
+        f"{name}  M={M} S={stages}{vtag}: {prog.num_ticks} ticks, "
         f"utilization {util * 100:.0f}% (bubbles {100 - util * 100:.0f}%)"
     )
     print(header)
@@ -65,6 +69,10 @@ def main():
     ap.add_argument("--mubatches", "-m", type=int, default=4)
     ap.add_argument("--stages", "-s", type=int, default=4)
     ap.add_argument(
+        "--virtual", "-v", type=int, default=1,
+        help="virtual stages per device (interleaved schedule only)",
+    )
+    ap.add_argument(
         "--all",
         action="store_true",
         help="render every schedule, including the forward-only inference relay",
@@ -77,7 +85,19 @@ def main():
     else:
         names = sorted(S.SCHEDULES)
     for name in names:
-        render(name, args.mubatches, args.stages)
+        v = args.virtual if name == "interleaved" else 1
+        if name == "interleaved" and args.mubatches % args.stages != 0:
+            if args.schedule == "interleaved":
+                raise SystemExit(
+                    f"interleaved needs M % S == 0 (got M={args.mubatches}, "
+                    f"S={args.stages})"
+                )
+            print(
+                f"interleaved  (skipped: needs M % S == 0, got "
+                f"M={args.mubatches}, S={args.stages})\n"
+            )
+            continue
+        render(name, args.mubatches, args.stages, virtual=v)
 
 
 if __name__ == "__main__":

@@ -63,6 +63,7 @@ class TrainingSession:
         fuse_mubatches=False,
         optimizer="sgd",
         momentum=0.9,
+        virtual_stages=1,
     ):
         if global_batch_size % dp != 0:
             raise ValueError("global batch size must be divisible by dp")
@@ -109,15 +110,28 @@ class TrainingSession:
         self._Y = jnp.asarray(Yb.reshape(nb, self.B, Yb.shape[-1]))
         self.batches_per_epoch = nb
 
-        self.spec = Mo.make_model_spec(sizes, pp, self.B)
+        if virtual_stages < 1:
+            raise ValueError("virtual_stages must be >= 1")
+        if virtual_stages > 1 and schedule != "interleaved":
+            raise ValueError(
+                "virtual_stages > 1 requires schedule='interleaved' (the flat "
+                "schedules place exactly one stage per device)"
+            )
+        self.V = virtual_stages
+        n_model_stages = pp * virtual_stages
+        self.spec = Mo.make_model_spec(sizes, n_model_stages, self.B)
+        # device-major stage placement for virtual chunks (identity otherwise)
+        self._order = (
+            E.interleave_order(n_model_stages, pp) if virtual_stages > 1 else None
+        )
         opt = make_optimizer(optimizer, lr, momentum)
         self._opt_config = {"name": optimizer, "lr": lr, "momentum": momentum}
-        self._sequential = dp == 1 and pp == 1
+        self._sequential = dp == 1 and pp == 1 and virtual_stages == 1
 
         host_opt_state = None  # logical (per-stage ragged) saved state, if any
         if resume is not None:
             host_params, loaded_spec, meta, host_opt_state = load_checkpoint(
-                resume, pp, self.B, with_opt_state=True
+                resume, n_model_stages, self.B, with_opt_state=True
             )
             if tuple(loaded_spec.sizes) != tuple(self.spec.sizes):
                 raise ValueError(
@@ -166,9 +180,12 @@ class TrainingSession:
             self._X = self._Y = None  # the microbatched views are the only users
         else:
             self.mesh = make_mesh(dp, pp, devices)
-            prog = lower_schedule(S.SCHEDULES[schedule], mubatches, pp)
+            prog = lower_schedule(
+                S.SCHEDULES[schedule], mubatches, pp, virtual=self.V
+            )
             self._stacked, self._flags = E.put_stacked(
-                *E.stack_params(host_params, self.spec), self.mesh
+                *E.stack_params(host_params, self.spec, order=self._order),
+                self.mesh,
             )
             self._opt_state = opt.init(self._stacked)
             if host_opt_state is not None and self._opt_state != ():
@@ -176,7 +193,8 @@ class TrainingSession:
                 # mirrors (zero padding is consistent: padded grads are
                 # exactly zero, so padded velocity stays zero)
                 self._opt_state, _ = E.put_stacked(
-                    *E.stack_params(host_opt_state, self.spec), self.mesh
+                    *E.stack_params(host_opt_state, self.spec, order=self._order),
+                    self.mesh,
                 )
             self._epoch_fn = E.make_pipeline_epoch(
                 self.mesh, self.spec, prog, local_batch // mubatches, opt,
@@ -222,7 +240,15 @@ class TrainingSession:
             eval_rows = -(-n_val // self.dp) * self.dp
             self._vx_padded = jnp.pad(self._vx, ((0, eval_rows - n_val), (0, 0)))
             self._vy_labels = jnp.argmax(self._vy, 1)
-            eval_prog = lower_schedule(S.InferenceSchedule, 1, self.pp, training=False)
+            if self.V > 1:
+                eval_prog = lower_schedule(
+                    S.InterleavedInferenceSchedule, 1, self.pp,
+                    training=False, virtual=self.V,
+                )
+            else:
+                eval_prog = lower_schedule(
+                    S.InferenceSchedule, 1, self.pp, training=False
+                )
             self._eval_step = E.make_pipeline_step(
                 self.mesh, self.spec, eval_prog, eval_rows // self.dp,
                 precision=self.precision,
@@ -246,7 +272,7 @@ class TrainingSession:
         """Logical per-stage params (host numpy), layout-independent order."""
         if self._sequential:
             return jax.device_get(self._params)
-        return E.unstack_params(self._stacked, self.spec)
+        return E.unstack_params(self._stacked, self.spec, order=self._order)
 
     def model_hash(self) -> str:
         return utils.model_hash(self.params())
@@ -262,7 +288,7 @@ class TrainingSession:
             return None
         if self._sequential:
             return jax.device_get(self._opt_state)
-        return E.unstack_params(self._opt_state, self.spec)
+        return E.unstack_params(self._opt_state, self.spec, order=self._order)
 
     def save(self, path):
         save_checkpoint(

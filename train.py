@@ -37,9 +37,18 @@ def main():
     ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
     ap.add_argument(
         "--schedule",
-        choices=["naive", "gpipe", "pipedream"],
+        choices=["naive", "gpipe", "pipedream", "interleaved"],
         default="naive",
-        help="pipeline schedule (ignored unless --pp > 1)",
+        help="pipeline schedule (ignored unless --pp > 1); 'interleaved' is "
+        "Megatron-style virtual-stage 1F1B (use with --virtual-stages)",
+    )
+    ap.add_argument(
+        "--virtual-stages",
+        type=int,
+        default=1,
+        help="virtual stages per device for --schedule interleaved: the model "
+        "is cut into pp x V stages, stage s on device s %% pp — the "
+        "pipeline-fill bubble shrinks ~V-fold (beyond the reference)",
     )
     ap.add_argument("--epochs", type=int, default=20)
     ap.add_argument("--global-batch-size", type=int, default=128)
@@ -101,11 +110,14 @@ def main():
         fuse_mubatches=args.fuse_mubatches,
         optimizer=args.optimizer,
         momentum=args.momentum,
+        virtual_stages=args.virtual_stages,
     )
-    if args.dp == 1 and args.pp == 1:
+    if args.dp == 1 and args.pp == 1 and args.virtual_stages == 1:
         layout = "sequential"
-    elif args.pp == 1:
+    elif args.pp == 1 and args.virtual_stages == 1:
         layout = "data-parallel"
+    elif args.virtual_stages > 1:
+        layout = f"interleaved pipeline, V={args.virtual_stages}"
     else:
         layout = f"{args.schedule} pipeline"
     print(
