@@ -141,8 +141,14 @@ def jax_sps(n_epochs=5):
     spec = Mo.make_model_spec(SIZES, 1, B)
     params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
     # fuse_mubatches: identical training (sum-gradient ledger), one full-batch
-    # forward/backward per step — the TPU-shaped way to run the sequential path
-    epoch = trainer.make_train_epoch(spec, SGD(LR), fuse_mubatches=True)
+    # forward/backward per step — the TPU-shaped way to run the sequential
+    # path. unroll: batch-scan unroll factor (bit-identical numerics); the
+    # default can be overridden with the value scripts/tpu_capture.py measures
+    # best on the chip.
+    unroll = int(os.environ.get("SHALLOWSPEED_BENCH_UNROLL", "1"))
+    epoch = trainer.make_train_epoch(
+        spec, SGD(LR), fuse_mubatches=True, unroll=unroll
+    )
 
     nb = N_SAMPLES // B
     rng = np.random.RandomState(0)
