@@ -64,6 +64,7 @@ class TrainingSession:
         optimizer="sgd",
         momentum=0.9,
         virtual_stages=1,
+        zero1=False,
     ):
         if global_batch_size % dp != 0:
             raise ValueError("global batch size must be divisible by dp")
@@ -88,6 +89,21 @@ class TrainingSession:
                 "pipeline executor microbatches are semantic (they ARE the "
                 "pipeline's unit of work)"
             )
+        if virtual_stages < 1:
+            raise ValueError("virtual_stages must be >= 1")
+        if virtual_stages > 1 and schedule != "interleaved":
+            raise ValueError(
+                "virtual_stages > 1 requires schedule='interleaved' (the flat "
+                "schedules place exactly one stage per device)"
+            )
+        self.V = virtual_stages
+        self._sequential = dp == 1 and pp == 1 and virtual_stages == 1
+        self._zero1 = bool(zero1)
+        if self._zero1 and self._sequential:
+            raise ValueError(
+                "zero1 shards the optimizer update over the dp mesh axis; "
+                "the sequential path has no mesh — use dp/pp > 1"
+            )
         self.epoch = 0
 
         data_dir = data_dir or default_data_dir()
@@ -110,14 +126,6 @@ class TrainingSession:
         self._Y = jnp.asarray(Yb.reshape(nb, self.B, Yb.shape[-1]))
         self.batches_per_epoch = nb
 
-        if virtual_stages < 1:
-            raise ValueError("virtual_stages must be >= 1")
-        if virtual_stages > 1 and schedule != "interleaved":
-            raise ValueError(
-                "virtual_stages > 1 requires schedule='interleaved' (the flat "
-                "schedules place exactly one stage per device)"
-            )
-        self.V = virtual_stages
         n_model_stages = pp * virtual_stages
         self.spec = Mo.make_model_spec(sizes, n_model_stages, self.B)
         # device-major stage placement for virtual chunks (identity otherwise)
@@ -126,7 +134,6 @@ class TrainingSession:
         )
         opt = make_optimizer(optimizer, lr, momentum)
         self._opt_config = {"name": optimizer, "lr": lr, "momentum": momentum}
-        self._sequential = dp == 1 and pp == 1 and virtual_stages == 1
 
         host_opt_state = None  # logical (per-stage ragged) saved state, if any
         if resume is not None:
@@ -187,18 +194,25 @@ class TrainingSession:
                 *E.stack_params(host_params, self.spec, order=self._order),
                 self.mesh,
             )
-            self._opt_state = opt.init(self._stacked)
-            if host_opt_state is not None and self._opt_state != ():
-                # stack + place the logical state exactly like the params it
-                # mirrors (zero padding is consistent: padded grads are
-                # exactly zero, so padded velocity stays zero)
-                self._opt_state, _ = E.put_stacked(
-                    *E.stack_params(host_opt_state, self.spec, order=self._order),
-                    self.mesh,
+            if self._zero1:
+                self._opt_state = E.zero1_state_from_logical(
+                    host_opt_state, opt, self.spec, self.mesh, order=self._order
                 )
+            else:
+                self._opt_state = opt.init(self._stacked)
+                if host_opt_state is not None and self._opt_state != ():
+                    # stack + place the logical state exactly like the params
+                    # it mirrors (zero padding is consistent: padded grads
+                    # are exactly zero, so padded velocity stays zero)
+                    self._opt_state, _ = E.put_stacked(
+                        *E.stack_params(
+                            host_opt_state, self.spec, order=self._order
+                        ),
+                        self.mesh,
+                    )
             self._epoch_fn = E.make_pipeline_epoch(
                 self.mesh, self.spec, prog, local_batch // mubatches, opt,
-                precision=self.precision,
+                precision=self.precision, zero1=self._zero1,
             )
             self._eval_step = None  # built lazily, sized to the val split
 
@@ -284,10 +298,14 @@ class TrainingSession:
     def opt_state_logical(self):
         """Stateful-optimizer state as per-stage ragged host numpy mirroring
         ``params()``, or None for stateless optimizers."""
-        if self._opt_state == ():
+        if isinstance(self._opt_state, tuple) and self._opt_state == ():
             return None
         if self._sequential:
             return jax.device_get(self._opt_state)
+        if self._zero1:
+            return E.zero1_state_to_logical(
+                self._opt_state, self.spec, self.mesh, order=self._order
+            )
         return E.unstack_params(self._opt_state, self.spec, order=self._order)
 
     def save(self, path):

@@ -51,6 +51,16 @@ class MomentumSGD:
         return new, velocity
 
 
+def is_stateless(opt) -> bool:
+    """True iff the optimizer's state is the empty tuple (the stateless
+    sentinel this package uses, e.g. SGD). The single source of truth for
+    every call site that branches on optimizer statefulness."""
+    import numpy as np
+
+    probe = opt.init(np.zeros((1,), np.float32))
+    return isinstance(probe, tuple) and probe == ()
+
+
 def make_optimizer(name: str, lr: float, momentum: float = 0.9):
     """Optimizer registry for the CLI/API surface (reference hardwires SGD,
     train.py:107)."""

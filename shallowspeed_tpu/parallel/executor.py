@@ -183,6 +183,132 @@ def init_stacked(spec: ModelSpec, mesh: Mesh, order=None):
 
 
 # ---------------------------------------------------------------------------
+# ZeRO-1 optimizer-state sharding over dp
+# ---------------------------------------------------------------------------
+#
+# With plain DP every replica holds the full optimizer state and repeats the
+# identical update. ZeRO-1 (Rajbhandari et al. 2019) shards both over the dp
+# axis: the gradient all-reduce becomes a reduce-scatter (each replica gets
+# the summed gradient for 1/dp of the parameters), the update runs on that
+# shard only, and an all-gather rebuilds the full parameters. Chunking
+# commutes with elementwise optimizer math; this implementation supports
+# optimizers whose state is () or a single zeros-initialized array mirroring
+# the params (SGD, momentum — everything shipped here; a multi-leaf state
+# like Adam's (m, v) would need a per-leaf flat layout and is rejected with
+# a clear error). On TPU both collectives ride ICI; the psum the plain
+# path uses IS reduce-scatter + all-gather internally, so the comm volume is
+# the same while state memory and update FLOPs drop by dp. (The reference has
+# no optimizer sharding at all — its DP engine is pipe.py:302-327.)
+#
+# Flat layout per pp-device: every W slot (V, o, i) then every b slot (V, o),
+# concatenated flat and zero-padded to a dp multiple. Helpers below pack and
+# unpack host-side state for layout-independent checkpoints.
+
+
+def zero1_flat_len(spec: ModelSpec, mesh: Mesh):
+    """(flat_len, chunk_size): per-pp-device flattened param count and the
+    padded per-dp-replica chunk size."""
+    dims = slot_shapes(spec)
+    P_, dp = mesh.shape["pp"], mesh.shape["dp"]
+    V = spec.n_stages // P_
+    flat = sum(V * o * i for o, i in dims) + sum(V * o for o, _ in dims)
+    return flat, -(-flat // dp)
+
+
+def _zero1_flatten_rows(stacked_np, spec, mesh):
+    """Host-side: stacked {W,b} (numpy, stage axis S) -> (pp, flat_len)."""
+    P_ = mesh.shape["pp"]
+    V = spec.n_stages // P_
+    rows = []
+    for d in range(P_):
+        sl = slice(d * V, (d + 1) * V)
+        parts = [np.asarray(w[sl]).reshape(-1) for w in stacked_np["W"]]
+        parts += [np.asarray(b[sl]).reshape(-1) for b in stacked_np["b"]]
+        rows.append(np.concatenate(parts))
+    return np.stack(rows)
+
+
+def _zero1_unflatten_rows(arr, spec, mesh):
+    """Host-side inverse of _zero1_flatten_rows: (pp, >=flat_len) -> stacked."""
+    dims = slot_shapes(spec)
+    P_ = mesh.shape["pp"]
+    V = spec.n_stages // P_
+    Ws = [np.zeros((spec.n_stages, o, i), np.float32) for o, i in dims]
+    bs = [np.zeros((spec.n_stages, o), np.float32) for o, _ in dims]
+    for d in range(P_):
+        off = 0
+        for l, (o, i) in enumerate(dims):
+            n = V * o * i
+            Ws[l][d * V : (d + 1) * V] = arr[d, off : off + n].reshape(V, o, i)
+            off += n
+        for l, (o, _) in enumerate(dims):
+            n = V * o
+            bs[l][d * V : (d + 1) * V] = arr[d, off : off + n].reshape(V, o)
+            off += n
+    return {"W": tuple(Ws), "b": tuple(bs)}
+
+
+def _zero1_check_state_shape(opt, csz):
+    """zero1's flat state layout requires opt.init(chunk) to be a single
+    zeros array mirroring the chunk; reject anything else loudly rather than
+    training from a silently-wrong state."""
+    probe = opt.init(np.zeros((csz,), np.float32))
+    if not (
+        hasattr(probe, "shape")
+        and tuple(probe.shape) == (csz,)
+        and not np.any(np.asarray(probe))
+    ):
+        raise ValueError(
+            "zero1 supports optimizers whose state is a single "
+            "zeros-initialized array per param chunk (SGD, momentum); "
+            f"{type(opt).__name__}.init returned {type(probe).__name__} "
+            "— a multi-leaf or non-zero-init state needs a per-leaf flat "
+            "layout that is not implemented"
+        )
+
+
+def zero1_init_state(opt, spec: ModelSpec, mesh: Mesh):
+    """Device-put initial ZeRO-1 optimizer state: a (pp, dp*chunk) array
+    sharded P('pp','dp') — each device holds its own (1, chunk) shard — or
+    () for stateless optimizers."""
+    from shallowspeed_tpu.optimizer import is_stateless
+
+    flat, csz = zero1_flat_len(spec, mesh)
+    if is_stateless(opt):
+        return ()
+    _zero1_check_state_shape(opt, csz)
+    dp = mesh.shape["dp"]
+    sh = NamedSharding(mesh, P("pp", "dp"))
+    return jax.device_put(
+        np.zeros((mesh.shape["pp"], dp * csz), np.float32), sh
+    )
+
+
+def zero1_state_to_logical(state, spec: ModelSpec, mesh: Mesh, order=None):
+    """ZeRO-1 state array -> per-stage ragged list mirroring params (for
+    layout-independent checkpoints); None for stateless state."""
+    if isinstance(state, tuple) and state == ():
+        return None
+    flat, _ = zero1_flat_len(spec, mesh)
+    arr = np.asarray(jax.device_get(state))[:, :flat]
+    stacked = _zero1_unflatten_rows(arr, spec, mesh)
+    return unstack_params(stacked, spec, order=order)
+
+
+def zero1_state_from_logical(logical, opt, spec: ModelSpec, mesh: Mesh, order=None):
+    """Inverse: per-stage ragged state list -> device-put (pp, dp*chunk)."""
+    if logical is None:
+        return zero1_init_state(opt, spec, mesh)
+    flat, csz = zero1_flat_len(spec, mesh)
+    stacked, _ = stack_params(logical, spec, order=order)
+    rows = _zero1_flatten_rows(stacked, spec, mesh)
+    dp = mesh.shape["dp"]
+    padded = np.zeros((mesh.shape["pp"], dp * csz), np.float32)
+    padded[:, :flat] = rows
+    return jax.device_put(padded, NamedSharding(mesh, P("pp", "dp")))
+
+
+# ---------------------------------------------------------------------------
 # The tick-program step builder
 # ---------------------------------------------------------------------------
 
@@ -236,6 +362,7 @@ def make_pipeline_step(
     precision=ops.DEFAULT_PRECISION,
     jit=True,
     tick_unroll=1,
+    zero1=False,
 ):
     """Build the jitted SPMD step executing one TickProgram over the mesh.
 
@@ -246,6 +373,13 @@ def make_pipeline_step(
       stateful optimizers (momentum et al.) behave identically on every
       layout; loss is the global-batch MSE (computed on the fly at the head
       stage — an observability bonus the reference never offers).
+
+    ``zero1``: shard the optimizer update over dp — reduce_scatter the
+    gradients, update 1/dp of the (flattened) params per replica with 1/dp
+    of the optimizer state, all_gather the result (see the ZeRO-1 section
+    above; opt_state must come from ``zero1_init_state``). Exact for
+    elementwise optimizers; bit-identical math to the plain path up to
+    collective reassociation.
 
     Inference:
         step(stacked, flags, x) -> preds (global_eval_batch, out_width) P('dp')
@@ -271,6 +405,16 @@ def make_pipeline_step(
     V = prog.num_chunks  # virtual stages per device
     assert prog.num_stages == P_, "program/mesh device-count mismatch"
     assert S_ == P_ * V, "model stages must equal devices x virtual chunks"
+    dp_n = mesh.shape["dp"]
+    if zero1:
+        if not training:
+            raise ValueError("zero1 applies to training programs only")
+        from shallowspeed_tpu.optimizer import is_stateless
+
+        z1_flat, z1_csz = zero1_flat_len(spec, mesh)
+        z1_stateful = not is_stateless(opt)
+        if z1_stateful:
+            _zero1_check_state_shape(opt, z1_csz)
 
     # tick tables as device constants, scanned over their leading (T) axis
     tabs = jax.tree.map(
@@ -437,14 +581,50 @@ def make_pipeline_step(
             # broadcast them over pp
             return lax.psum(preds, "pp")
 
-        # the BackwardGradAllReduce anchor: one SUM-psum of the whole gradient
-        # pytree over dp per batch (reference pipe.py:302-327)
-        gW = lax.psum(carry["gW"], "dp")
-        gb = lax.psum(carry["gb"], "dp")
         # loss was only accumulated on head-stage ticks (zero elsewhere)
         loss = lax.psum(carry["loss"], "dp")
         loss = lax.pmax(loss, "pp")  # replicate scalar across devices
 
+        if zero1:
+            # ZeRO-1: reduce_scatter the flattened gradient over dp, update
+            # this replica's param chunk with its state shard, all_gather
+            flat, csz = z1_flat, z1_csz
+            pad = csz * dp_n - flat
+            gvec = jnp.concatenate(
+                [g.reshape(-1) for g in carry["gW"]]
+                + [g.reshape(-1) for g in carry["gb"]]
+            )
+            gsh = lax.psum_scatter(
+                jnp.pad(gvec, (0, pad)), "dp", scatter_dimension=0, tiled=True
+            )
+            pvec = jnp.concatenate(
+                [w.reshape(-1) for w in stacked["W"]]
+                + [b.reshape(-1) for b in stacked["b"]]
+            )
+            pvec = jnp.pad(pvec, (0, pad))
+            i0 = lax.axis_index("dp") * csz
+            pch = lax.dynamic_slice(pvec, (i0,), (csz,))
+            if z1_stateful:
+                new_ch, st = opt.apply(pch, gsh, opt_state[0])
+                opt_state = st[None]
+            else:
+                new_ch, _ = opt.apply(pch, gsh, ())
+            new_vec = lax.all_gather(new_ch, "dp", axis=0, tiled=True)[:flat]
+            outW, outb, off = [], [], 0
+            for o, i in dims:
+                n = V * o * i
+                outW.append(new_vec[off : off + n].reshape(V, o, i))
+                off += n
+            for o, _ in dims:
+                n = V * o
+                outb.append(new_vec[off : off + n].reshape(V, o))
+                off += n
+            return {"W": tuple(outW), "b": tuple(outb)}, opt_state, loss
+
+        # the BackwardGradAllReduce anchor: one SUM-psum of the whole gradient
+        # pytree over dp per batch (reference pipe.py:302-327)
+        gW = lax.psum(carry["gW"], "dp")
+        gb = lax.psum(carry["gb"], "dp")
         local = {"W": stacked["W"], "b": stacked["b"]}
         grads = {"W": gW, "b": gb}  # (V, ...) leaves, mirroring the shards
         new_local, opt_state = opt.apply(local, grads, opt_state)
@@ -456,20 +636,30 @@ def make_pipeline_step(
     stacked_specs = {"W": (pp,) * L, "b": (pp,) * L}
 
     if training:
-        # optimizer-state specs mirror the state's pytree: stage-axis sharded
-        # like the params it tracks (SGD's state is the empty tuple)
-        stacked_struct = {
-            "W": tuple(jax.ShapeDtypeStruct((S_, o, i), jnp.float32) for o, i in dims),
-            "b": tuple(jax.ShapeDtypeStruct((S_, o), jnp.float32) for o, _ in dims),
-        }
-        state_struct = jax.eval_shape(opt.init, stacked_struct)
-        # stage-stacked state leaves (leading axis S, like the params they
-        # track) shard over pp; anything else (scalar step counts etc.) is
-        # replicated
-        state_specs = jax.tree.map(
-            lambda leaf: pp if leaf.ndim > 0 and leaf.shape[0] == S_ else P(),
-            state_struct,
-        )
+        if zero1:
+            # ZeRO-1 state is one (pp, dp*chunk) array: row per pp device,
+            # column-chunk per dp replica (or () for stateless optimizers)
+            state_specs = P("pp", "dp") if z1_stateful else ()
+        else:
+            # optimizer-state specs mirror the state's pytree: stage-axis
+            # sharded like the params it tracks (SGD's state is the empty
+            # tuple)
+            stacked_struct = {
+                "W": tuple(
+                    jax.ShapeDtypeStruct((S_, o, i), jnp.float32) for o, i in dims
+                ),
+                "b": tuple(
+                    jax.ShapeDtypeStruct((S_, o), jnp.float32) for o, _ in dims
+                ),
+            }
+            state_struct = jax.eval_shape(opt.init, stacked_struct)
+            # stage-stacked state leaves (leading axis S, like the params
+            # they track) shard over pp; anything else (scalar step counts
+            # etc.) is replicated
+            state_specs = jax.tree.map(
+                lambda leaf: pp if leaf.ndim > 0 and leaf.shape[0] == S_ else P(),
+                state_struct,
+            )
 
         smapped = shard_map(
             per_device,
@@ -509,16 +699,17 @@ def make_pipeline_epoch(
     precision=ops.DEFAULT_PRECISION,
     unroll=1,
     tick_unroll=1,
+    zero1=False,
 ):
     """Scan the pipeline train step over all batches of an epoch: one XLA
     program per epoch. X: (num_batches, global_batch, in_dim), batch axis
     sharded over dp. ``epoch(stacked, flags, opt_state, X, Y) -> (stacked,
     opt_state, mean_loss)``. ``unroll``/``tick_unroll``: lax.scan unroll
     factors for the batch loop / the per-tick loop (throughput knobs,
-    identical numerics)."""
+    identical numerics); ``zero1`` shards the optimizer update over dp."""
     step = make_pipeline_step(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
-        tick_unroll=tick_unroll,
+        tick_unroll=tick_unroll, zero1=zero1,
     )
 
     @partial(jax.jit, donate_argnums=(0, 2))

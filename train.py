@@ -62,6 +62,13 @@ def main():
         "saved in checkpoints and restored on --resume, any layout)",
     )
     ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument(
+        "--zero1",
+        action="store_true",
+        help="ZeRO-1: shard the optimizer state + update over the dp axis "
+        "(reduce_scatter grads, per-replica chunk update, all_gather params; "
+        "mesh layouts only — beyond the reference)",
+    )
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--no-eval", action="store_true", help="skip per-epoch accuracy")
     ap.add_argument(
@@ -111,6 +118,7 @@ def main():
         optimizer=args.optimizer,
         momentum=args.momentum,
         virtual_stages=args.virtual_stages,
+        zero1=args.zero1,
     )
     if args.dp == 1 and args.pp == 1 and args.virtual_stages == 1:
         layout = "sequential"
