@@ -70,7 +70,7 @@ def bench_sequential(nb, reps):
     return reps * nb * B / (time.perf_counter() - t0)
 
 
-def bench_pipeline(dp, pp, sched_name, nb, reps):
+def bench_pipeline(dp, pp, sched_name, nb, reps, virtual=1):
     import jax
     import jax.numpy as jnp
 
@@ -81,9 +81,10 @@ def bench_pipeline(dp, pp, sched_name, nb, reps):
     from shallowspeed_tpu.parallel import lower_schedule, make_mesh
 
     mesh = make_mesh(dp, pp)
-    spec = Mo.make_model_spec(SIZES, pp, B)
-    prog = lower_schedule(S.SCHEDULES[sched_name], M, pp)
-    stacked, flags = E.init_stacked(spec, mesh)
+    spec = Mo.make_model_spec(SIZES, pp * virtual, B)
+    order = E.interleave_order(pp * virtual, pp) if virtual > 1 else None
+    prog = lower_schedule(S.SCHEDULES[sched_name], M, pp, virtual=virtual)
+    stacked, flags = E.init_stacked(spec, mesh, order=order)
     epoch = E.make_pipeline_epoch(mesh, spec, prog, B // dp // M, SGD(LR))
     X, Y = _data(nb, np.random.RandomState(0))
     Xj, Yj = jnp.asarray(X), jnp.asarray(Y)
@@ -97,14 +98,15 @@ def bench_pipeline(dp, pp, sched_name, nb, reps):
 
 
 CONFIGS = [
-    # the five BASELINE.md configs...
-    ("seq", 1, 1, None),
-    ("dp4", 4, 1, "gpipe"),
-    ("pp4-naive", 1, 4, "naive"),
-    ("pp4-gpipe", 1, 4, "gpipe"),
-    ("dp2pp4-gpipe", 2, 4, "gpipe"),
-    # ...plus the 1F1B schedule the reference never implemented
-    ("pp4-pipedream", 1, 4, "pipedream"),
+    # the five BASELINE.md configs...  (name, dp, pp, schedule, virtual)
+    ("seq", 1, 1, None, 1),
+    ("dp4", 4, 1, "gpipe", 1),
+    ("pp4-naive", 1, 4, "naive", 1),
+    ("pp4-gpipe", 1, 4, "gpipe", 1),
+    ("dp2pp4-gpipe", 2, 4, "gpipe", 1),
+    # ...plus the schedules the reference never implemented
+    ("pp4-pipedream", 1, 4, "pipedream", 1),
+    ("pp4v2-interleaved", 1, 4, "interleaved", 2),
 ]
 
 
@@ -118,7 +120,7 @@ def main():
 
     n_dev = len(jax.devices())
     results = {}
-    for name, dp, pp, sched in CONFIGS:
+    for name, dp, pp, sched, virtual in CONFIGS:
         need = dp * pp
         if need > n_dev:
             print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
@@ -126,7 +128,7 @@ def main():
         if name == "seq":
             sps = bench_sequential(args.batches, args.reps)
         else:
-            sps = bench_pipeline(dp, pp, sched, args.batches, args.reps)
+            sps = bench_pipeline(dp, pp, sched, args.batches, args.reps, virtual)
         results[name] = sps
         eff = (
             sps / (need * results["seq"])
