@@ -26,6 +26,11 @@ bench-scaling:
 bench-matrix:
 	python scripts/bench_tpu_matrix.py
 
+# one-shot full TPU measurement (baseline, unroll sweep, matrix,
+# convergence, profiler trace) — run when the chip is healthy
+tpu-capture:
+	python scripts/tpu_capture.py
+
 schedules:
 	$(CPU_MESH) python scripts/show_schedule.py --all
 
