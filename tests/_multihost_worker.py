@@ -12,10 +12,12 @@ they exercise the full multihost surface:
      all-reduce path);
   4. one REAL pipeline-executor training step (DP=2 x PP=2, GPipe) over the
      process-spanning mesh, with ``dp`` laid across the process boundary the
-     way it would be laid across hosts on a pod.
+     way it would be laid across hosts on a pod;
+  5. the same with interleaved virtual stages (P=2 x V=2): ring relays stay
+     on-process while the dp reduce crosses the boundary.
 
-Prints one JSON line {"pid", "psum_ok", "loss"} on success; any assertion
-failure exits non-zero and fails the parent test.
+Prints one JSON line {"pid", "psum_ok", "loss", "loss_i"} on success; any
+assertion failure exits non-zero and fails the parent test.
 """
 
 import json
@@ -101,7 +103,26 @@ def main():
 
     step = E.make_pipeline_step(mesh, spec, prog, half // M, SGD(0.05))
     _, _, loss = step(stacked, fl, (), xg, yg)
-    print(json.dumps({"pid": pid, "psum_ok": True, "loss": float(loss)}))
+
+    # --- interleaved virtual stages under the distributed runtime ---------
+    # P=2 x V=2 = 4 model stages on each process's pp pair (ring relays incl.
+    # the chunk wrap stay on-process) while the dp gradient reduce crosses
+    # the process boundary — the recommended pod layout, in miniature.
+    SIZES_I = (12, 11, 10, 9, 9, 8, 8, 8)  # len % (P*V=4) == 0, head owns a Linear
+    spec_i = Mo.make_model_spec(SIZES_I, 4, B)
+    order = E.interleave_order(4, 2)
+    prog_i = lower_schedule(S.InterleavedSchedule, M, 2, virtual=2)
+    st_i, fl_i = E.stack_params(Mo.init_model(spec_i), spec_i, order=order)
+    st_i = jax.tree.map(lambda x: put_global(x, P("pp")), st_i)
+    fl_i = jax.tree.map(lambda x: put_global(x, P("pp")), fl_i)
+    step_i = E.make_pipeline_step(mesh, spec_i, prog_i, half // M, SGD(0.05))
+    _, _, loss_i = step_i(st_i, fl_i, (), xg, yg)
+
+    print(
+        json.dumps(
+            {"pid": pid, "psum_ok": True, "loss": float(loss), "loss_i": float(loss_i)}
+        )
+    )
 
 
 if __name__ == "__main__":

@@ -82,6 +82,24 @@ def test_mesh_cli_dp2_pp2(tiny_data):
     assert re.search(r"final model hash: [0-9a-f]{40}", out)
 
 
+def test_mesh_cli_interleaved_zero1_momentum(tiny_data):
+    """The round-2 flag surface in one run: interleaved virtual stages,
+    ZeRO-1 sharded momentum."""
+    out = _run(
+        [
+            "--dp", "2", "--pp", "2", "--schedule", "interleaved",
+            "--virtual-stages", "2", "--zero1", "--optimizer", "momentum",
+            "--epochs", "1", "--global-batch-size", "32", "--mubatches", "2",
+            "--no-eval",
+        ],
+        tiny_data,
+        extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    )
+    assert "(interleaved pipeline, V=2)" in out
+    assert "DP replicas in sync" in out
+    assert re.search(r"final model hash: [0-9a-f]{40}", out)
+
+
 def test_cli_checkpoint_resume_round_trip(tiny_data, tmp_path):
     ck = tmp_path / "ck.npz"
     _run(

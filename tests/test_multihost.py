@@ -37,9 +37,10 @@ def test_shard_batch_for_process_places_on_mesh():
 
 def test_two_process_distributed_training_step():
     """Spawn 2 cooperating processes that form a 4-device global runtime and
-    run a cross-process psum + one pipeline training step (see
-    _multihost_worker.py). Verifies multihost.initialize, process-local batch
-    feeding, and that both processes agree on the (replicated) loss."""
+    run a cross-process psum + pipeline training steps (flat GPipe and
+    interleaved virtual stages — see _multihost_worker.py). Verifies
+    multihost.initialize, process-local batch feeding, and that both
+    processes agree on the (replicated) losses."""
     worker = Path(__file__).parent / "_multihost_worker.py"
     env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
 
@@ -87,6 +88,7 @@ def test_two_process_distributed_training_step():
             break
     assert outs is not None, f"workers failed 3x:\n{errs[-1][-3000:]}"
     assert all(o["psum_ok"] for o in outs)
-    losses = sorted((o["pid"], o["loss"]) for o in outs)
-    assert losses[0][1] == pytest.approx(losses[1][1], rel=1e-6)
-    assert np.isfinite(losses[0][1]) and losses[0][1] > 0
+    for key in ("loss", "loss_i"):
+        losses = sorted((o["pid"], o[key]) for o in outs)
+        assert losses[0][1] == pytest.approx(losses[1][1], rel=1e-6)
+        assert np.isfinite(losses[0][1]) and losses[0][1] > 0
