@@ -21,13 +21,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
 
 from shallowspeed_tpu import model as Mo
 from shallowspeed_tpu import schedules as S
 from shallowspeed_tpu import trainer, utils
 from shallowspeed_tpu.checkpoint import load_checkpoint, save_checkpoint
 from shallowspeed_tpu.data import Dataset, default_data_dir
-from shallowspeed_tpu.optimizer import make_optimizer
+from shallowspeed_tpu.optimizer import (
+    is_stateless,
+    join_state,
+    make_optimizer,
+    split_state,
+)
 from shallowspeed_tpu.parallel import executor as E
 from shallowspeed_tpu.parallel import lower_schedule, make_mesh
 
@@ -132,7 +138,7 @@ class TrainingSession:
         self._order = (
             E.interleave_order(n_model_stages, pp) if virtual_stages > 1 else None
         )
-        opt = make_optimizer(optimizer, lr, momentum)
+        opt = self._opt = make_optimizer(optimizer, lr, momentum)
         self._opt_config = {"name": optimizer, "lr": lr, "momentum": momentum}
 
         host_opt_state = None  # logical (per-stage ragged) saved state, if any
@@ -174,9 +180,20 @@ class TrainingSession:
 
         if self._sequential:
             self._params = jax.tree.map(jnp.asarray, host_params)
-            self._opt_state = opt.init(self._params)
-            if host_opt_state is not None and self._opt_state != ():
-                self._opt_state = jax.tree.map(jnp.asarray, host_opt_state)
+            if host_opt_state is not None and not is_stateless(opt):
+                self._opt_state = join_state(
+                    opt,
+                    {
+                        k: jax.tree.map(jnp.asarray, v)
+                        for k, v in host_opt_state["parts"].items()
+                    },
+                    {
+                        k: jnp.asarray(v, jnp.float32)
+                        for k, v in host_opt_state["scalars"].items()
+                    },
+                )
+            else:
+                self._opt_state = opt.init(self._params)
             self._epoch_fn = trainer.make_train_epoch(
                 self.spec, opt, precision=self.precision,
                 fuse_mubatches=fuse_mubatches,
@@ -198,18 +215,27 @@ class TrainingSession:
                 self._opt_state = E.zero1_state_from_logical(
                     host_opt_state, opt, self.spec, self.mesh, order=self._order
                 )
+            elif host_opt_state is not None and not is_stateless(opt):
+                # stack + place each state part exactly like the params it
+                # mirrors (zero padding is consistent: padded grads are
+                # exactly zero, so padded state stays zero); scalars replicate
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                self._opt_state = join_state(
+                    opt,
+                    {
+                        k: E.put_pp(
+                            E.stack_params(v, self.spec, order=self._order)[0],
+                            self.mesh,
+                        )
+                        for k, v in host_opt_state["parts"].items()
+                    },
+                    {
+                        k: jax.device_put(np.float32(v), rep)
+                        for k, v in host_opt_state["scalars"].items()
+                    },
+                )
             else:
                 self._opt_state = opt.init(self._stacked)
-                if host_opt_state is not None and self._opt_state != ():
-                    # stack + place the logical state exactly like the params
-                    # it mirrors (zero padding is consistent: padded grads
-                    # are exactly zero, so padded velocity stays zero)
-                    self._opt_state, _ = E.put_stacked(
-                        *E.stack_params(
-                            host_opt_state, self.spec, order=self._order
-                        ),
-                        self.mesh,
-                    )
             self._epoch_fn = E.make_pipeline_epoch(
                 self.mesh, self.spec, prog, local_batch // mubatches, opt,
                 precision=self.precision, zero1=self._zero1,
@@ -296,17 +322,26 @@ class TrainingSession:
             utils.assert_dp_replicas_in_sync(self._stacked)
 
     def opt_state_logical(self):
-        """Stateful-optimizer state as per-stage ragged host numpy mirroring
-        ``params()``, or None for stateless optimizers."""
-        if isinstance(self._opt_state, tuple) and self._opt_state == ():
+        """Stateful-optimizer state in layout-independent logical form:
+        ``{"parts": {key: ragged_list mirroring params()}, "scalars":
+        {key: float}}`` per the optimizer's state_layout(); None for
+        stateless optimizers."""
+        if is_stateless(self._opt):
             return None
-        if self._sequential:
-            return jax.device_get(self._opt_state)
         if self._zero1:
             return E.zero1_state_to_logical(
-                self._opt_state, self.spec, self.mesh, order=self._order
+                self._opt_state, self._opt, self.spec, self.mesh, order=self._order
             )
-        return E.unstack_params(self._opt_state, self.spec, order=self._order)
+        parts, scalars = split_state(self._opt, self._opt_state)
+        if self._sequential:
+            parts = {k: jax.device_get(v) for k, v in parts.items()}
+        else:
+            parts = {
+                k: E.unstack_params(v, self.spec, order=self._order)
+                for k, v in parts.items()
+            }
+        scalars = {k: float(jax.device_get(v)) for k, v in scalars.items()}
+        return {"parts": parts, "scalars": scalars}
 
     def save(self, path):
         save_checkpoint(
@@ -315,5 +350,5 @@ class TrainingSession:
             self.spec,
             self.epoch - 1,
             extra={"optimizer": self._opt_config},
-            opt_state_list=self.opt_state_logical(),
+            opt_state=self.opt_state_logical(),
         )

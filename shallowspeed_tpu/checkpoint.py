@@ -42,15 +42,25 @@ def _flatten_logical(params_list):
     return out
 
 
+def _opt_prefix(key):
+    """Array-name prefix for an optimizer-state part. The unnamed part
+    (momentum's whole-state mirror) keeps the original ``ow{i}``/``ob{i}``
+    names, so round-1 checkpoints load unchanged; named parts (Adam's m/v)
+    get ``o_{key}_w{i}``."""
+    return ("ow", "ob") if key == "" else (f"o_{key}_w", f"o_{key}_b")
+
+
 def save_checkpoint(
-    path, params_list, spec: ModelSpec, epoch: int, extra=None, opt_state_list=None
+    path, params_list, spec: ModelSpec, epoch: int, extra=None, opt_state=None
 ):
     """Atomically write params (+ metadata) to ``path`` (.npz).
 
-    ``opt_state_list``: optional per-stage ragged pytree with the SAME
-    structure as ``params_list`` (stateful optimizers' state mirrors the
-    params, e.g. momentum velocity) — stored in the same logical layer order,
-    so it is exactly as layout-independent as the weights.
+    ``opt_state``: optional logical optimizer state, as
+    ``{"parts": {key: ragged_list}, "scalars": {key: float}}`` where each
+    ragged_list has the SAME structure as ``params_list`` (state parts
+    mirror the params — momentum velocity, Adam moments) — stored in the
+    same logical layer order, so it is exactly as layout-independent as the
+    weights; scalars (Adam's step count) go into the metadata blob.
     """
     path = Path(path)
     flat = _flatten_logical(params_list)
@@ -58,32 +68,39 @@ def save_checkpoint(
         raise ValueError(
             f"param count {len(flat)} does not match spec sizes {spec.sizes}"
         )
+    parts = (opt_state or {}).get("parts", {})
+    scalars = (opt_state or {}).get("scalars", {})
     meta = {
         "format_version": FORMAT_VERSION,
         "sizes": list(spec.sizes),
         "global_batch_size": spec.global_batch_size,
         "epoch": int(epoch),
-        "has_opt_state": opt_state_list is not None,
+        "has_opt_state": "" in parts,  # legacy momentum flag (round-1 readers)
+        "opt_parts": sorted(parts),
+        "opt_scalars": {k: float(v) for k, v in scalars.items()},
         "extra": extra or {},
     }
     arrays = {"meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)}
     for i, (w, b) in enumerate(flat):
         arrays[f"w{i}"] = w
         arrays[f"b{i}"] = b
-    if opt_state_list is not None:
-        flat_opt = _flatten_logical(opt_state_list)
+    for key, ragged in parts.items():
+        pw, pb = _opt_prefix(key)
+        flat_opt = _flatten_logical(ragged)
         if len(flat_opt) != len(flat):
             raise ValueError(
-                f"optimizer-state layer count {len(flat_opt)} != param count {len(flat)}"
+                f"optimizer-state part {key!r} layer count {len(flat_opt)} != "
+                f"param count {len(flat)}"
             )
         for i, (ow, ob) in enumerate(flat_opt):
             if ow.shape != flat[i][0].shape or ob.shape != flat[i][1].shape:
                 raise ValueError(
-                    f"optimizer-state layer {i} shape {ow.shape}/{ob.shape} does "
-                    f"not mirror the params {flat[i][0].shape}/{flat[i][1].shape}"
+                    f"optimizer-state part {key!r} layer {i} shape "
+                    f"{ow.shape}/{ob.shape} does not mirror the params "
+                    f"{flat[i][0].shape}/{flat[i][1].shape}"
                 )
-            arrays[f"ow{i}"] = ow
-            arrays[f"ob{i}"] = ob
+            arrays[f"{pw}{i}"] = ow
+            arrays[f"{pb}{i}"] = ob
     path.parent.mkdir(parents=True, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".npz.tmp")
     try:
@@ -120,8 +137,9 @@ def load_checkpoint(path, n_stages: int, global_batch_size=None, with_opt_state=
     Returns (params_list, spec, meta): params_list is per-stage ragged host
     numpy ready for ``jax.tree.map(jnp.asarray, ...)`` (sequential) or
     ``executor.stack_params`` (pipeline). With ``with_opt_state=True``,
-    returns (params_list, spec, meta, opt_state_list) where opt_state_list
-    mirrors params_list, or None when the checkpoint stored none.
+    returns (params_list, spec, meta, opt_state) where opt_state is
+    ``{"parts": {key: ragged_list}, "scalars": {key: float}}`` (each part
+    mirrors params_list), or None when the checkpoint stored none.
     """
     with np.load(Path(path)) as z:
         meta = json.loads(bytes(z["meta"]).decode())
@@ -129,9 +147,15 @@ def load_checkpoint(path, n_stages: int, global_batch_size=None, with_opt_state=
             raise ValueError(f"unsupported checkpoint version: {meta}")
         n_layers = len(meta["sizes"]) - 1
         flat = [(z[f"w{i}"], z[f"b{i}"]) for i in range(n_layers)]
-        flat_opt = None
-        if meta.get("has_opt_state"):
-            flat_opt = [(z[f"ow{i}"], z[f"ob{i}"]) for i in range(n_layers)]
+        # opt_parts supersedes has_opt_state; round-1 files have only the
+        # latter (and only the unnamed part)
+        part_keys = meta.get("opt_parts")
+        if part_keys is None:
+            part_keys = [""] if meta.get("has_opt_state") else []
+        flat_parts = {}
+        for key in part_keys:
+            pw, pb = _opt_prefix(key)
+            flat_parts[key] = [(z[f"{pw}{i}"], z[f"{pb}{i}"]) for i in range(n_layers)]
     if global_batch_size is None:
         global_batch_size = meta["global_batch_size"]
     spec = make_model_spec(meta["sizes"], n_stages, global_batch_size)
@@ -146,5 +170,10 @@ def load_checkpoint(path, n_stages: int, global_batch_size=None, with_opt_state=
                 )
     if not with_opt_state:
         return params_list, spec, meta
-    opt_state_list = None if flat_opt is None else _partition(flat_opt, spec)
-    return params_list, spec, meta, opt_state_list
+    opt_state = None
+    if flat_parts or meta.get("opt_scalars"):
+        opt_state = {
+            "parts": {k: _partition(v, spec) for k, v in flat_parts.items()},
+            "scalars": dict(meta.get("opt_scalars", {})),
+        }
+    return params_list, spec, meta, opt_state

@@ -166,14 +166,17 @@ def unstack_params(stacked, spec: ModelSpec, order=None):
     return out
 
 
-def put_stacked(stacked, flags, mesh: Mesh):
-    """device_put stacked params + flags with P('pp') sharding on the stage
-    axis — the one place the stacked-param sharding is defined."""
+def put_pp(tree, mesh: Mesh):
+    """device_put a stage-stacked pytree with P('pp') sharding on the stage
+    axis — the ONE place the stacked placement is defined (params, flags and
+    stacked optimizer-state parts all go through here)."""
     pp = NamedSharding(mesh, P("pp"))
-    return (
-        jax.tree.map(lambda x: jax.device_put(x, pp), stacked),
-        jax.tree.map(lambda x: jax.device_put(x, pp), flags),
-    )
+    return jax.tree.map(lambda x: jax.device_put(x, pp), tree)
+
+
+def put_stacked(stacked, flags, mesh: Mesh):
+    """device_put stacked params + flags (see ``put_pp``)."""
+    return put_pp(stacked, mesh), put_pp(flags, mesh)
 
 
 def init_stacked(spec: ModelSpec, mesh: Mesh, order=None):
@@ -191,11 +194,10 @@ def init_stacked(spec: ModelSpec, mesh: Mesh, order=None):
 # axis: the gradient all-reduce becomes a reduce-scatter (each replica gets
 # the summed gradient for 1/dp of the parameters), the update runs on that
 # shard only, and an all-gather rebuilds the full parameters. Chunking
-# commutes with elementwise optimizer math; this implementation supports
-# optimizers whose state is () or a single zeros-initialized array mirroring
-# the params (SGD, momentum — everything shipped here; a multi-leaf state
-# like Adam's (m, v) would need a per-leaf flat layout and is rejected with
-# a clear error). On TPU both collectives ride ICI; the psum the plain
+# commutes with elementwise optimizer math; the state_layout() protocol
+# (optimizer.py) drives the flat layout — each 'params' state part (momentum
+# velocity, Adam's m and v) becomes its own (pp, dp*chunk) array, 'scalar'
+# parts (Adam's step count) replicate. On TPU both collectives ride ICI; the
 # path uses IS reduce-scatter + all-gather internally, so the comm volume is
 # the same while state memory and update FLOPs drop by dp. (The reference has
 # no optimizer sharding at all — its DP engine is pipe.py:302-327.)
@@ -248,64 +250,102 @@ def _zero1_unflatten_rows(arr, spec, mesh):
     return {"W": tuple(Ws), "b": tuple(bs)}
 
 
-def _zero1_check_state_shape(opt, csz):
-    """zero1's flat state layout requires opt.init(chunk) to be a single
-    zeros array mirroring the chunk; reject anything else loudly rather than
-    training from a silently-wrong state."""
+def _zero1_check_state(opt, csz):
+    """zero1's flat layout requires each 'params' state part to come out of
+    ``opt.init(chunk)`` as one chunk-shaped zeros array; reject anything the
+    state_layout protocol doesn't describe, loudly."""
+    from shallowspeed_tpu.optimizer import split_state
+
     probe = opt.init(np.zeros((csz,), np.float32))
-    if not (
-        hasattr(probe, "shape")
-        and tuple(probe.shape) == (csz,)
-        and not np.any(np.asarray(probe))
-    ):
-        raise ValueError(
-            "zero1 supports optimizers whose state is a single "
-            "zeros-initialized array per param chunk (SGD, momentum); "
-            f"{type(opt).__name__}.init returned {type(probe).__name__} "
-            "— a multi-leaf or non-zero-init state needs a per-leaf flat "
-            "layout that is not implemented"
-        )
+    parts, scalars = split_state(opt, probe)
+    for key, leaf in parts.items():
+        if not (
+            hasattr(leaf, "shape")
+            and tuple(leaf.shape) == (csz,)
+            and not np.any(np.asarray(leaf))
+        ):
+            raise ValueError(
+                f"zero1: state part {key!r} of {type(opt).__name__} is not a "
+                "zeros-initialized chunk mirror — its state_layout() does "
+                "not match its init()"
+            )
+    for key, leaf in scalars.items():
+        if np.ndim(leaf) != 0:
+            raise ValueError(
+                f"zero1: state part {key!r} of {type(opt).__name__} is "
+                "declared 'scalar' but is not 0-d"
+            )
+    return parts, scalars
 
 
 def zero1_init_state(opt, spec: ModelSpec, mesh: Mesh):
-    """Device-put initial ZeRO-1 optimizer state: a (pp, dp*chunk) array
-    sharded P('pp','dp') — each device holds its own (1, chunk) shard — or
-    () for stateless optimizers."""
+    """Device-put initial ZeRO-1 optimizer state: a dict with one
+    (pp, dp*chunk) array per 'params' state part — sharded P('pp','dp'), so
+    each device holds its own (1, chunk) shard — plus replicated 0-d arrays
+    for 'scalar' parts; () for stateless optimizers."""
     from shallowspeed_tpu.optimizer import is_stateless
 
     flat, csz = zero1_flat_len(spec, mesh)
     if is_stateless(opt):
         return ()
-    _zero1_check_state_shape(opt, csz)
+    parts, scalars = _zero1_check_state(opt, csz)
     dp = mesh.shape["dp"]
-    sh = NamedSharding(mesh, P("pp", "dp"))
-    return jax.device_put(
-        np.zeros((mesh.shape["pp"], dp * csz), np.float32), sh
+    part_sh = NamedSharding(mesh, P("pp", "dp"))
+    rep_sh = NamedSharding(mesh, P())
+    state = {
+        key: jax.device_put(np.zeros((mesh.shape["pp"], dp * csz), np.float32), part_sh)
+        for key in parts
+    }
+    state.update(
+        {
+            key: jax.device_put(np.asarray(leaf, np.float32), rep_sh)
+            for key, leaf in scalars.items()
+        }
     )
+    return state
 
 
-def zero1_state_to_logical(state, spec: ModelSpec, mesh: Mesh, order=None):
-    """ZeRO-1 state array -> per-stage ragged list mirroring params (for
-    layout-independent checkpoints); None for stateless state."""
+def zero1_state_to_logical(state, opt, spec: ModelSpec, mesh: Mesh, order=None):
+    """ZeRO-1 state dict -> {"parts": {key: ragged_list}, "scalars":
+    {key: float}} mirroring params (for layout-independent checkpoints);
+    None for stateless state."""
     if isinstance(state, tuple) and state == ():
         return None
+    layout = opt.state_layout()
     flat, _ = zero1_flat_len(spec, mesh)
-    arr = np.asarray(jax.device_get(state))[:, :flat]
-    stacked = _zero1_unflatten_rows(arr, spec, mesh)
-    return unstack_params(stacked, spec, order=order)
+    parts, scalars = {}, {}
+    for key, kind in layout.items():
+        if kind == "params":
+            arr = np.asarray(jax.device_get(state[key]))[:, :flat]
+            stacked = _zero1_unflatten_rows(arr, spec, mesh)
+            parts[key] = unstack_params(stacked, spec, order=order)
+        else:
+            scalars[key] = float(jax.device_get(state[key]))
+    return {"parts": parts, "scalars": scalars}
 
 
 def zero1_state_from_logical(logical, opt, spec: ModelSpec, mesh: Mesh, order=None):
-    """Inverse: per-stage ragged state list -> device-put (pp, dp*chunk)."""
+    """Inverse: logical {"parts", "scalars"} dict -> device-put state."""
     if logical is None:
         return zero1_init_state(opt, spec, mesh)
     flat, csz = zero1_flat_len(spec, mesh)
-    stacked, _ = stack_params(logical, spec, order=order)
-    rows = _zero1_flatten_rows(stacked, spec, mesh)
     dp = mesh.shape["dp"]
-    padded = np.zeros((mesh.shape["pp"], dp * csz), np.float32)
-    padded[:, :flat] = rows
-    return jax.device_put(padded, NamedSharding(mesh, P("pp", "dp")))
+    layout = opt.state_layout()
+    part_sh = NamedSharding(mesh, P("pp", "dp"))
+    rep_sh = NamedSharding(mesh, P())
+    state = {}
+    for key, kind in layout.items():
+        if kind == "params":
+            stacked, _ = stack_params(logical["parts"][key], spec, order=order)
+            rows = _zero1_flatten_rows(stacked, spec, mesh)
+            padded = np.zeros((mesh.shape["pp"], dp * csz), np.float32)
+            padded[:, :flat] = rows
+            state[key] = jax.device_put(padded, part_sh)
+        else:
+            state[key] = jax.device_put(
+                np.asarray(logical["scalars"][key], np.float32), rep_sh
+            )
+    return state
 
 
 # ---------------------------------------------------------------------------
@@ -414,7 +454,8 @@ def make_pipeline_step(
         z1_flat, z1_csz = zero1_flat_len(spec, mesh)
         z1_stateful = not is_stateless(opt)
         if z1_stateful:
-            _zero1_check_state_shape(opt, z1_csz)
+            _zero1_check_state(opt, z1_csz)
+            z1_layout = opt.state_layout()
 
     # tick tables as device constants, scanned over their leading (T) axis
     tabs = jax.tree.map(
@@ -605,8 +646,19 @@ def make_pipeline_step(
             i0 = lax.axis_index("dp") * csz
             pch = lax.dynamic_slice(pvec, (i0,), (csz,))
             if z1_stateful:
-                new_ch, st = opt.apply(pch, gsh, opt_state[0])
-                opt_state = st[None]
+                from shallowspeed_tpu.optimizer import join_state, split_state
+
+                # per-device views: 'params' parts are (1, csz) blocks,
+                # scalars are replicated 0-d
+                chunk_state = join_state(
+                    opt,
+                    {k: opt_state[k][0] for k, kd in z1_layout.items() if kd == "params"},
+                    {k: opt_state[k] for k, kd in z1_layout.items() if kd == "scalar"},
+                )
+                new_ch, new_state = opt.apply(pch, gsh, chunk_state)
+                nparts, nscalars = split_state(opt, new_state)
+                opt_state = {k: v[None] for k, v in nparts.items()}
+                opt_state.update(nscalars)
             else:
                 new_ch, _ = opt.apply(pch, gsh, ())
             new_vec = lax.all_gather(new_ch, "dp", axis=0, tiled=True)[:flat]
@@ -637,9 +689,17 @@ def make_pipeline_step(
 
     if training:
         if zero1:
-            # ZeRO-1 state is one (pp, dp*chunk) array: row per pp device,
-            # column-chunk per dp replica (or () for stateless optimizers)
-            state_specs = P("pp", "dp") if z1_stateful else ()
+            # ZeRO-1 state: one (pp, dp*chunk) array per 'params' part (row
+            # per pp device, column-chunk per dp replica) + replicated
+            # scalars; () for stateless optimizers
+            state_specs = (
+                {
+                    k: (P("pp", "dp") if kd == "params" else P())
+                    for k, kd in z1_layout.items()
+                }
+                if z1_stateful
+                else ()
+            )
         else:
             # optimizer-state specs mirror the state's pytree: stage-axis
             # sharded like the params it tracks (SGD's state is the empty

@@ -15,7 +15,7 @@ import pytest
 from shallowspeed_tpu import model as Mo
 from shallowspeed_tpu import schedules as S
 from shallowspeed_tpu.api import TrainingSession
-from shallowspeed_tpu.optimizer import SGD, MomentumSGD
+from shallowspeed_tpu.optimizer import SGD, Adam, MomentumSGD
 from shallowspeed_tpu.parallel import executor as E
 from shallowspeed_tpu.parallel import lower_schedule, make_mesh
 
@@ -46,36 +46,51 @@ def _run(opt, dp, pp, zero1, virtual=1):
     return flat, st, float(loss), (spec, mesh, order)
 
 
-@pytest.mark.parametrize("opt", [SGD(LR), MomentumSGD(LR, 0.9)])
+@pytest.mark.parametrize("opt", [SGD(LR), MomentumSGD(LR, 0.9), Adam(LR)])
 @pytest.mark.parametrize("dp,pp,virtual", [(2, 4, 1), (4, 2, 1), (2, 2, 2)])
-def test_zero1_bit_identical_to_plain(opt, dp, pp, virtual):
+def test_zero1_matches_plain(opt, dp, pp, virtual):
+    """SGD/momentum updates (mul/add chains) compile identically chunked or
+    stacked -> bitwise equality. Adam's sqrt/divide chain fuses differently
+    per shape, so its chunked update may differ by ~1 ulp — mathematically
+    the same chunking-commutes argument, checked at float-rounding tolerance."""
     plain, _, loss_p, _ = _run(opt, dp, pp, zero1=False, virtual=virtual)
     sharded, _, loss_z, _ = _run(opt, dp, pp, zero1=True, virtual=virtual)
-    assert loss_p == loss_z
-    for a, b in zip(plain, sharded):
-        np.testing.assert_array_equal(a["W"], b["W"])
-        np.testing.assert_array_equal(a["b"], b["b"])
+    if isinstance(opt, Adam):
+        assert loss_p == pytest.approx(loss_z, rel=1e-6)
+        for a, b in zip(plain, sharded):
+            np.testing.assert_allclose(a["W"], b["W"], rtol=1e-6, atol=1e-7)
+            np.testing.assert_allclose(a["b"], b["b"], rtol=1e-6, atol=1e-7)
+    else:
+        assert loss_p == loss_z
+        for a, b in zip(plain, sharded):
+            np.testing.assert_array_equal(a["W"], b["W"])
+            np.testing.assert_array_equal(a["b"], b["b"])
 
 
 def test_zero1_state_is_actually_sharded():
     opt = MomentumSGD(LR, 0.9)
     _, st, _, (spec, mesh, _) = _run(opt, 4, 2, zero1=True)
     flat, csz = E.zero1_flat_len(spec, mesh)
-    assert st.shape == (2, 4 * csz)
+    vel = st[""]  # momentum's single 'params' state part
+    assert vel.shape == (2, 4 * csz)
     # each device holds exactly one (1, csz) block of the state
-    assert all(s.data.shape == (1, csz) for s in st.addressable_shards)
+    assert all(s.data.shape == (1, csz) for s in vel.addressable_shards)
     # velocity is live after training
-    assert float(jnp.abs(st).sum()) > 0
+    assert float(jnp.abs(vel).sum()) > 0
 
 
-def test_zero1_state_round_trip():
-    opt = MomentumSGD(LR, 0.9)
+@pytest.mark.parametrize("opt", [MomentumSGD(LR, 0.9), Adam(LR)])
+def test_zero1_state_round_trip(opt):
     _, st, _, (spec, mesh, order) = _run(opt, 2, 4, zero1=True)
-    logical = E.zero1_state_to_logical(st, spec, mesh, order=order)
+    logical = E.zero1_state_to_logical(st, opt, spec, mesh, order=order)
     assert logical is not None
     back = E.zero1_state_from_logical(logical, opt, spec, mesh, order=order)
-    np.testing.assert_array_equal(
-        np.asarray(jax.device_get(st)), np.asarray(jax.device_get(back))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b))
+        ),
+        st,
+        back,
     )
 
 

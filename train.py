@@ -56,10 +56,13 @@ def main():
     ap.add_argument("--lr", type=float, default=0.006)
     ap.add_argument(
         "--optimizer",
-        choices=["sgd", "momentum"],
+        choices=["sgd", "momentum", "adam"],
         default="sgd",
-        help="sgd = reference parity; momentum = heavy-ball SGD (state is "
-        "saved in checkpoints and restored on --resume, any layout)",
+        help="sgd = reference parity; momentum / adam = stateful optimizers "
+        "(state is saved in checkpoints and restored on --resume, any "
+        "layout). NOTE: adam's normalized step is ~lr per element — use a "
+        "much smaller lr than sgd's (e.g. 2e-4 reaches 99.9%% in 2 epochs "
+        "where sgd's 6e-3 needs 20)",
     )
     ap.add_argument("--momentum", type=float, default=0.9)
     ap.add_argument(
