@@ -120,6 +120,7 @@ class TrainingSession:
         # eval-free runs (train.py --no-eval, benchmarks) pay neither the host
         # load nor the device transfer
         self._vx = self._vy = None
+        self._predict_cache = {}  # mesh predict() programs, keyed by row count
 
         nb = self._train_ds.get_num_batches()
         if nb == 0:
@@ -280,19 +281,43 @@ class TrainingSession:
             eval_rows = -(-n_val // self.dp) * self.dp
             self._vx_padded = jnp.pad(self._vx, ((0, eval_rows - n_val), (0, 0)))
             self._vy_labels = jnp.argmax(self._vy, 1)
+            self._eval_step = self._inference_step(eval_rows)
+
+    def predict(self, x):
+        """Softmax class probabilities for a (n, in_dim) batch on ANY layout
+        (host numpy in, host numpy out). On mesh layouts rows are padded to a
+        dp multiple and fed through a cached whole-batch inference program
+        (one program per distinct padded row count)."""
+        x = np.asarray(x, np.float32)
+        n = x.shape[0]
+        out_dim = self.spec.out_dim
+        if self._sequential:
+            if self._predict is None:  # pragma: no cover - always built
+                raise RuntimeError("sequential session has no predict fn")
+            return np.asarray(self._predict(self._params, jnp.asarray(x)))[:, :out_dim]
+        rows = -(-n // self.dp) * self.dp
+        step = self._inference_step(rows)
+        xb = jnp.asarray(np.pad(x, ((0, rows - n), (0, 0))))
+        return np.asarray(step(self._stacked, self._flags, xb))[:n, :out_dim]
+
+    def _inference_step(self, rows):
+        """Cached whole-batch inference program for a padded row count
+        (mesh layouts; shared by predict() and the validation path)."""
+        step = self._predict_cache.get(rows)
+        if step is None:
             if self.V > 1:
-                eval_prog = lower_schedule(
+                prog = lower_schedule(
                     S.InterleavedInferenceSchedule, 1, self.pp,
                     training=False, virtual=self.V,
                 )
             else:
-                eval_prog = lower_schedule(
-                    S.InferenceSchedule, 1, self.pp, training=False
-                )
-            self._eval_step = E.make_pipeline_step(
-                self.mesh, self.spec, eval_prog, eval_rows // self.dp,
+                prog = lower_schedule(S.InferenceSchedule, 1, self.pp, training=False)
+            step = E.make_pipeline_step(
+                self.mesh, self.spec, prog, rows // self.dp,
                 precision=self.precision,
             )
+            self._predict_cache[rows] = step
+        return step
 
     def accuracy(self) -> float:
         """Argmax accuracy over the full validation split."""

@@ -121,13 +121,10 @@ class Adam:
 
 
 def is_stateless(opt) -> bool:
-    """True iff the optimizer's state is the empty tuple (the stateless
-    sentinel this package uses, e.g. SGD). The single source of truth for
-    every call site that branches on optimizer statefulness."""
-    import numpy as np
-
-    probe = opt.init(np.zeros((1,), np.float32))
-    return isinstance(probe, tuple) and probe == ()
+    """True iff the optimizer carries no state (e.g. SGD). Answered by the
+    state_layout() protocol — the single source of truth every call site
+    branches on."""
+    return not opt.state_layout()
 
 
 def make_optimizer(name: str, lr: float, momentum: float = 0.9):

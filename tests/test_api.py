@@ -61,6 +61,25 @@ def test_accuracy_runs_all_layouts(data_dir):
         assert 0.0 <= acc <= 1.0
 
 
+def test_predict_agrees_across_layouts(data_dir):
+    """Public predict(): same probabilities on every layout, ragged batch."""
+    x = np.random.RandomState(7).randn(13, SIZES[0]).astype(np.float32)
+    runs = [
+        _session(data_dir, **kw)
+        for kw in (
+            dict(),
+            dict(dp=2, pp=2, schedule="gpipe"),
+            dict(pp=2, schedule="interleaved", virtual_stages=2),
+        )
+    ]
+    preds = [r.predict(x) for r in runs]
+    for p in preds:
+        assert p.shape == (13, SIZES[-1])
+        np.testing.assert_allclose(p.sum(1), 1.0, rtol=1e-4)
+    for p in preds[1:]:
+        np.testing.assert_allclose(p, preds[0], rtol=2e-4, atol=2e-5)
+
+
 def test_save_resume_round_trip(data_dir, tmp_path):
     run = _session(data_dir)
     run.train_epoch()
