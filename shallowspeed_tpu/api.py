@@ -135,6 +135,18 @@ class TrainingSession:
 
         n_model_stages = pp * virtual_stages
         self.spec = Mo.make_model_spec(sizes, n_model_stages, self.B)
+        if self.spec.stages[-1].n_linears == 0:
+            import warnings
+
+            warnings.warn(
+                f"the last of {n_model_stages} pipeline stages owns no Linear "
+                "under this partitioning, so the reference's 'no relu on the "
+                "final Linear' rule never fires and the trained MODEL differs "
+                "from shallower partitionings (faithful reference quirk, "
+                "layers.py:253-257) — expect worse accuracy; prefer a size "
+                "list that gives every stage a Linear",
+                stacklevel=2,
+            )
         # device-major stage placement for virtual chunks (identity otherwise)
         self._order = (
             E.interleave_order(n_model_stages, pp) if virtual_stages > 1 else None
