@@ -71,6 +71,8 @@ class TrainingSession:
         momentum=0.9,
         virtual_stages=1,
         zero1=False,
+        scan_unroll=1,
+        tick_unroll=1,
     ):
         if global_batch_size % dp != 0:
             raise ValueError("global batch size must be divisible by dp")
@@ -102,8 +104,15 @@ class TrainingSession:
                 "virtual_stages > 1 requires schedule='interleaved' (the flat "
                 "schedules place exactly one stage per device)"
             )
+        if scan_unroll < 1 or tick_unroll < 1:
+            raise ValueError("scan_unroll/tick_unroll must be >= 1")
         self.V = virtual_stages
         self._sequential = dp == 1 and pp == 1 and virtual_stages == 1
+        if tick_unroll > 1 and self._sequential:
+            raise ValueError(
+                "tick_unroll unrolls the pipeline tick loop; the sequential "
+                "path has no ticks — use scan_unroll"
+            )
         self._zero1 = bool(zero1)
         if self._zero1 and self._sequential:
             raise ValueError(
@@ -135,18 +144,6 @@ class TrainingSession:
 
         n_model_stages = pp * virtual_stages
         self.spec = Mo.make_model_spec(sizes, n_model_stages, self.B)
-        if self.spec.stages[-1].n_linears == 0:
-            import warnings
-
-            warnings.warn(
-                f"the last of {n_model_stages} pipeline stages owns no Linear "
-                "under this partitioning, so the reference's 'no relu on the "
-                "final Linear' rule never fires and the trained MODEL differs "
-                "from shallower partitionings (faithful reference quirk, "
-                "layers.py:253-257) — expect worse accuracy; prefer a size "
-                "list that gives every stage a Linear",
-                stacklevel=2,
-            )
         # device-major stage placement for virtual chunks (identity otherwise)
         self._order = (
             E.interleave_order(n_model_stages, pp) if virtual_stages > 1 else None
@@ -209,7 +206,7 @@ class TrainingSession:
                 self._opt_state = opt.init(self._params)
             self._epoch_fn = trainer.make_train_epoch(
                 self.spec, opt, precision=self.precision,
-                fuse_mubatches=fuse_mubatches,
+                fuse_mubatches=fuse_mubatches, unroll=scan_unroll,
             )
             self._predict = trainer.make_predict(self.spec, precision=self.precision)
             self._Xe = self._X.reshape(nb, self.M, self.B // self.M, -1)
@@ -252,6 +249,7 @@ class TrainingSession:
             self._epoch_fn = E.make_pipeline_epoch(
                 self.mesh, self.spec, prog, local_batch // mubatches, opt,
                 precision=self.precision, zero1=self._zero1,
+                unroll=scan_unroll, tick_unroll=tick_unroll,
             )
             self._eval_step = None  # built lazily, sized to the val split
 

@@ -98,6 +98,18 @@ def partition_sizes(sizes: Sequence[int], n_stages: int):
 
 def make_model_spec(sizes, n_stages, global_batch_size) -> ModelSpec:
     locals_ = partition_sizes(sizes, n_stages)
+    if len(locals_[-1]) == 1:
+        import warnings
+
+        warnings.warn(
+            f"the last of {n_stages} pipeline stages owns no Linear under "
+            "this partitioning, so the 'no relu on the final Linear' rule "
+            "never fires and the trained MODEL differs from shallower "
+            "partitionings (faithful reference quirk, layers.py:253-257) — "
+            "expect worse accuracy; prefer a size list that gives every "
+            "stage a Linear",
+            stacklevel=2,
+        )
     stages = []
     for i, loc in enumerate(locals_):
         is_last = i == n_stages - 1

@@ -96,6 +96,20 @@ def main():
         "larger matmuls for the MXU",
     )
     ap.add_argument(
+        "--scan-unroll",
+        type=int,
+        default=1,
+        help="lax.scan unroll factor for the per-batch epoch loop "
+        "(throughput knob, bit-identical numerics)",
+    )
+    ap.add_argument(
+        "--tick-unroll",
+        type=int,
+        default=1,
+        help="lax.scan unroll factor for the pipeline tick loop (mesh "
+        "layouts; throughput knob, bit-identical numerics)",
+    )
+    ap.add_argument(
         "--precision",
         choices=["highest", "default"],
         default="highest",
@@ -123,6 +137,8 @@ def main():
         momentum=args.momentum,
         virtual_stages=args.virtual_stages,
         zero1=args.zero1,
+        scan_unroll=args.scan_unroll,
+        tick_unroll=args.tick_unroll,
     )
     if args.dp == 1 and args.pp == 1 and args.virtual_stages == 1:
         layout = "sequential"
