@@ -91,7 +91,7 @@ class TrainingSession:
                 f"schedule must be one of {sorted(S.SCHEDULES)}, got {schedule!r}"
             )
         self.precision = _PRECISIONS[precision]
-        if fuse_mubatches and not (dp == 1 and pp == 1):
+        if fuse_mubatches and not (dp == 1 and pp == 1 and virtual_stages == 1):
             raise ValueError(
                 "fuse_mubatches applies to the sequential path only; in the "
                 "pipeline executor microbatches are semantic (they ARE the "

@@ -122,6 +122,89 @@ def test_interleaved_inference_matches_sequential_predict():
     np.testing.assert_allclose(preds[:, : SIZES16[-1]], pred1, rtol=2e-4, atol=1e-5)
 
 
+def replay_chunked(p):
+    """Symbolic dataflow replay of an interleaved TickProgram (the chunk-aware
+    analogue of tests/test_lowering.py::replay): payloads are
+    ("act"|"grad", receiver_chunk, mubatch, sender_global_stage) routed over
+    the ring; asserts every consume pairs with exactly the right producer,
+    mailboxes never collide, stashes pair by (chunk, mubatch), and the
+    load_in/is_head tables mark exactly the global end stages."""
+    P, V = p.num_stages, p.num_chunks
+    Kf, Kb, Ks = p.n_fwd_slots, p.n_bwd_slots, p.n_stash_slots
+    fwd_mail = [[None] * Kf for _ in range(P)]
+    bwd_mail = [[None] * Kb for _ in range(P)]
+    stash = [[None] * Ks for _ in range(P)]
+    S_g = P * V
+    for t in range(p.num_ticks):
+        outgoing = []
+        for s in range(P):
+            op, mb = int(p.op[t, s]), int(p.mb[t, s])
+            c = int(p.chunk[t, s])
+            stage_g = c * P + s
+            li, ih = int(p.load_in[t, s]), int(p.is_head[t, s])
+            if op != 0:
+                assert li == int(stage_g == 0 and op == 1), (t, s)
+                assert ih == int(stage_g == S_g - 1), (t, s)
+            consumed = None
+            rf, rb = int(p.read_fwd_slot[t, s]), int(p.read_bwd_slot[t, s])
+            if rf != Kf:
+                consumed = fwd_mail[s][rf]
+                assert consumed is not None, f"empty fwd slot t={t} s={s}"
+                fwd_mail[s][rf] = None
+            if rb != Kb:
+                assert consumed is None
+                consumed = bwd_mail[s][rb]
+                assert consumed is not None, f"empty bwd slot t={t} s={s}"
+                bwd_mail[s][rb] = None
+            sw, sr = int(p.stash_write[t, s]), int(p.stash_read[t, s])
+            if sw != Ks:
+                assert stash[s][sw] is None, f"stash overwrite t={t} s={s}"
+                stash[s][sw] = (c, mb)
+            if sr != Ks:
+                assert stash[s][sr] == (c, mb), (t, s, stash[s][sr], (c, mb))
+                stash[s][sr] = None
+            if op == 1:  # forward
+                if stage_g == 0:
+                    assert consumed is None
+                else:
+                    assert consumed == ("act", c, mb, stage_g - 1), (t, s, consumed)
+            elif op == 2:  # backward
+                if stage_g == S_g - 1:
+                    assert consumed is None
+                else:
+                    assert consumed == ("grad", c, mb, stage_g + 1), (t, s, consumed)
+            if p.send_fwd[t, s]:
+                dst = (s + 1) % P
+                rc = c + (1 if s == P - 1 else 0)
+                outgoing.append((dst, "fwd", ("act", rc, mb, stage_g)))
+            if p.send_bwd[t, s]:
+                dst = (s - 1) % P
+                rc = c - (1 if s == 0 else 0)
+                outgoing.append((dst, "bwd", ("grad", rc, mb, stage_g)))
+        for dst, direction, payload in outgoing:
+            mail = fwd_mail if direction == "fwd" else bwd_mail
+            slot_tab = p.in_fwd_slot if direction == "fwd" else p.in_bwd_slot
+            slot = int(slot_tab[t, dst])
+            assert slot != (Kf if direction == "fwd" else Kb), (t, dst)
+            assert mail[dst][slot] is None, f"mailbox collision t={t} dst={dst}"
+            mail[dst][slot] = payload
+    for s in range(P):
+        assert all(x is None for x in fwd_mail[s] + bwd_mail[s]), "leftover msgs"
+        assert all(x is None for x in stash[s]), "leaked stash"
+
+
+@pytest.mark.parametrize("M,P,V", [(4, 4, 2), (4, 2, 4), (8, 4, 2), (2, 2, 2), (4, 1, 4), (3, 3, 2)])
+def test_interleaved_dataflow_replay(M, P, V):
+    replay_chunked(lower_schedule(S.InterleavedSchedule, M, P, virtual=V))
+
+
+@pytest.mark.parametrize("M,P,V", [(1, 4, 2), (4, 4, 2), (2, 3, 3)])
+def test_interleaved_inference_dataflow_replay(M, P, V):
+    replay_chunked(
+        lower_schedule(S.InterleavedInferenceSchedule, M, P, training=False, virtual=V)
+    )
+
+
 class TestLoweredShape:
     def test_bubble_shrinks_with_v(self):
         """Interleaving buys the V-fold warmup shrink: at equal per-device
