@@ -1,9 +1,13 @@
 """shallowspeed_tpu — a TPU-native distributed-training framework.
 
 A brand-new JAX/XLA re-design of the capabilities of siboehm/ShallowSpeed
-(reference mounted at /root/reference): deep-MLP SGD training on MNIST under
-sequential, data-parallel (DP), pipeline-parallel (PP, naive / GPipe /
-PipeDream-Flush schedules) and composed DP x PP layouts.
+(reference mounted at /root/reference): deep-MLP training on MNIST under
+sequential, data-parallel (DP), pipeline-parallel (PP — naive / GPipe /
+PipeDream-Flush / interleaved virtual-stage schedules) and composed DP x PP
+layouts, with SGD / momentum / Adam optimizers, optional ZeRO-1
+optimizer-state sharding, and layout-independent checkpoints (optimizer
+state included). The one-object entry point is
+``shallowspeed_tpu.api.TrainingSession``.
 
 Architecture (TPU-first, not a port):
 
@@ -21,7 +25,13 @@ Architecture (TPU-first, not a port):
                  Send/Recv and jax.lax.psum replaces Iallreduce.
 - ``data``       the MNIST-784 parquet/npy data layer with strided DP sharding
                  and microbatch slicing (reference dataset.py semantics).
-- ``optimizer``  SGD over pytrees, applied on-device inside the jitted step.
+- ``optimizer``  SGD / momentum / Adam over pytrees, applied on-device inside
+                 the jitted step; the ``state_layout`` protocol carries any
+                 optimizer state through checkpoints, stacked pp sharding and
+                 ZeRO-1 chunking.
+- ``checkpoint`` layout-independent .npz save/resume (params + opt state).
+- ``api``        ``TrainingSession`` — data + model + layout + optimizer +
+                 eval as one object (the CLI in train.py is a thin wrapper).
 """
 
 from shallowspeed_tpu import (
