@@ -15,7 +15,7 @@ import pytest
 from shallowspeed_tpu import model as Mo
 from shallowspeed_tpu import schedules as S
 from shallowspeed_tpu import trainer
-from shallowspeed_tpu.optimizer import SGD
+from shallowspeed_tpu.optimizer import SGD, Adam, MomentumSGD
 from shallowspeed_tpu.parallel import executor as E
 from shallowspeed_tpu.parallel import lower_schedule, make_mesh
 
@@ -95,3 +95,82 @@ def test_random_layout_matches_sequential(seed):
         err_msg=f"eval case: sizes={sizes} dp={dp} pp={pp} M={M}",
     )
     assert (preds[:, sizes[-1] :] == 0).all()
+
+
+OPTS = [SGD(0.01), MomentumSGD(0.005, 0.9), Adam(0.003)]
+
+
+def _random_case_r2(seed):
+    """Round-2 feature fuzz: optimizer x zero1 x virtual stages, drawn from
+    INDEPENDENT seed bits so every pairing (incl. zero1 + interleaved, and
+    zero1 over a 4-way dp axis) occurs across the 12 seeds."""
+    rng = np.random.RandomState(1000 + seed)
+    V = [1, 2][seed % 2]
+    zero1 = bool((seed // 2) % 2)
+    dp, pp = [(2, 2), (1, 4), (4, 2)][(seed // 4) % 3]
+    n_stages = pp * V
+    # every stage gets >= 2 sizes (>= 1 Linear) -> exact-parity regime;
+    # n_sizes is a multiple of n_stages by construction
+    n_sizes = n_stages * int(rng.randint(2, 4))
+    widths = sorted(rng.randint(8, 48, size=n_sizes - 1).tolist(), reverse=True)
+    sizes = tuple(widths) + (int(rng.randint(4, min(8, min(widths)) + 1)),)
+    M = int(pp * rng.choice([1, 2]))  # interleaved needs M % pp == 0
+    B = int(dp * M * rng.choice([4, 8]))
+    opt = OPTS[seed % 3]
+    sched = S.InterleavedSchedule if V > 1 else SCHEDS[seed % 3]
+    return sizes, dp, pp, V, M, B, opt, zero1, sched
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_r2_feature_combo_matches_sequential(seed):
+    """Random (optimizer, zero1, virtual-stage) combinations must still equal
+    sequential training with the same optimizer — the round-2 features
+    compose, not just work in isolation."""
+    sizes, dp, pp, V, M, B, opt, zero1, sched = _random_case_r2(seed)
+    spec_pp = Mo.make_model_spec(sizes, pp * V, B)
+    assert spec_pp.stages[-1].n_linears > 0  # generator guarantees parity regime
+
+    rng = np.random.RandomState(2000 + seed)
+    X = rng.randn(2, B, sizes[0]).astype(np.float32)
+    Y = np.eye(sizes[-1], dtype=np.float32)[rng.randint(0, sizes[-1], (2, B))]
+
+    spec1 = Mo.make_model_spec(sizes, 1, B)
+    params = jax.tree.map(jnp.asarray, Mo.init_model(spec1))
+    step1 = trainer.make_train_step(spec1, opt)
+    st = opt.init(params)
+    for i in range(2):
+        params, st = step1(
+            params,
+            st,
+            jnp.asarray(X[i].reshape(M, B // M, -1)),
+            jnp.asarray(Y[i].reshape(M, B // M, -1)),
+        )
+    want = [l for stage in params for l in stage]
+
+    mesh = make_mesh(dp, pp)
+    order = E.interleave_order(pp * V, pp) if V > 1 else None
+    prog = lower_schedule(sched, M, pp, virtual=V)
+    stacked, flags = E.init_stacked(spec_pp, mesh, order=order)
+    ost = E.zero1_init_state(opt, spec_pp, mesh) if zero1 else opt.init(stacked)
+    step = E.make_pipeline_step(mesh, spec_pp, prog, B // dp // M, opt, zero1=zero1)
+    for i in range(2):
+        stacked, ost, _ = step(stacked, flags, ost, jnp.asarray(X[i]), jnp.asarray(Y[i]))
+    got = [l for s in E.unstack_params(stacked, spec_pp, order=order) for l in s]
+    assert len(want) == len(got)
+
+    label = (
+        f"sizes={sizes} dp={dp} pp={pp} V={V} M={M} B={B} "
+        f"{type(opt).__name__} zero1={zero1} {sched.__name__}"
+    )
+    # Adam's early update direction is ~g/|g| per element: near-zero second
+    # moments amplify ulp-level cross-layout reassociation of g, so its
+    # tolerance is an order looser than the mul/add optimizers'
+    rtol, atol = (5e-3, 5e-5) if isinstance(opt, Adam) else (5e-4, 5e-6)
+    for a, b in zip(want, got):
+        np.testing.assert_allclose(
+            np.asarray(a["W"]), b["W"], rtol=rtol, atol=atol, err_msg=label
+        )
+        np.testing.assert_allclose(
+            np.asarray(a["b"]).reshape(-1), b["b"].reshape(-1),
+            rtol=rtol, atol=atol, err_msg=label,
+        )
