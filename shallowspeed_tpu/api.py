@@ -73,6 +73,8 @@ class TrainingSession:
         zero1=False,
         scan_unroll=1,
         tick_unroll=1,
+        weight_decay=0.0,
+        clip_norm=None,
     ):
         if global_batch_size % dp != 0:
             raise ValueError("global batch size must be divisible by dp")
@@ -148,8 +150,15 @@ class TrainingSession:
         self._order = (
             E.interleave_order(n_model_stages, pp) if virtual_stages > 1 else None
         )
-        opt = self._opt = make_optimizer(optimizer, lr, momentum)
-        self._opt_config = {"name": optimizer, "lr": lr, "momentum": momentum}
+        if clip_norm is not None and clip_norm <= 0:
+            raise ValueError("clip_norm must be positive (or None to disable)")
+        opt = self._opt = make_optimizer(optimizer, lr, momentum, weight_decay)
+        self._opt_config = {
+            "name": optimizer,
+            "lr": lr,
+            "momentum": momentum,
+            "weight_decay": weight_decay,
+        }
 
         host_opt_state = None  # logical (per-stage ragged) saved state, if any
         if resume is not None:
@@ -183,6 +192,13 @@ class TrainingSession:
                         f"momentum={momentum} would reinterpret it — pass the "
                         f"saved coefficient"
                     )
+                saved_wd = saved_opt.get("weight_decay", 0.0)
+                if saved_wd != weight_decay:
+                    raise ValueError(
+                        f"checkpoint was trained with weight_decay={saved_wd}; "
+                        f"resuming with weight_decay={weight_decay} would "
+                        f"silently change the trajectory — pass the saved value"
+                    )
             self.spec = loaded_spec
             self.epoch = meta["epoch"] + 1
         else:
@@ -207,6 +223,7 @@ class TrainingSession:
             self._epoch_fn = trainer.make_train_epoch(
                 self.spec, opt, precision=self.precision,
                 fuse_mubatches=fuse_mubatches, unroll=scan_unroll,
+                clip_norm=clip_norm,
             )
             self._predict = trainer.make_predict(self.spec, precision=self.precision)
             self._Xe = self._X.reshape(nb, self.M, self.B // self.M, -1)
@@ -250,6 +267,7 @@ class TrainingSession:
                 self.mesh, self.spec, prog, local_batch // mubatches, opt,
                 precision=self.precision, zero1=self._zero1,
                 unroll=scan_unroll, tick_unroll=tick_unroll,
+                clip_norm=clip_norm,
             )
             self._eval_step = None  # built lazily, sized to the val split
 

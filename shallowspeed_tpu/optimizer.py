@@ -30,9 +30,15 @@ import jax
 class SGD:
     """Stateless SGD. ``apply`` returns new params; grads are SUMS over the
     global batch (the loss is pre-scaled by the global batch size), so no
-    averaging happens here — same ledger as the reference."""
+    averaging happens here — same ledger as the reference.
+
+    ``weight_decay``: decoupled (applied directly to params, not through the
+    gradient), so it stays elementwise — exact under padding and ZeRO-1
+    chunking like the update itself. Default 0 = reference parity.
+    """
 
     lr: float
+    weight_decay: float = 0.0
 
     def init(self, params):
         return ()  # no optimizer state
@@ -40,8 +46,11 @@ class SGD:
     def state_layout(self):
         return {}
 
+    def _decay(self, p):
+        return p * _decay_factor(self.lr, self.weight_decay) if self.weight_decay else p
+
     def apply(self, params, grads, state=()):
-        new = jax.tree.map(lambda p, g: p - self.lr * g, params, grads)
+        new = jax.tree.map(lambda p, g: self._decay(p) - self.lr * g, params, grads)
         return new, state
 
 
@@ -57,6 +66,7 @@ class MomentumSGD:
 
     lr: float
     momentum: float = 0.9
+    weight_decay: float = 0.0
 
     def init(self, params):
         import jax.numpy as jnp
@@ -66,9 +76,12 @@ class MomentumSGD:
     def state_layout(self):
         return {"": "params"}
 
+    def _decay(self, p):
+        return p * _decay_factor(self.lr, self.weight_decay) if self.weight_decay else p
+
     def apply(self, params, grads, state):
         velocity = jax.tree.map(lambda v, g: self.momentum * v + g, state, grads)
-        new = jax.tree.map(lambda p, v: p - self.lr * v, params, velocity)
+        new = jax.tree.map(lambda p, v: self._decay(p) - self.lr * v, params, velocity)
         return new, velocity
 
 
@@ -87,6 +100,7 @@ class Adam:
     b1: float = 0.9
     b2: float = 0.999
     eps: float = 1e-8
+    weight_decay: float = 0.0  # decoupled (AdamW); 0 = plain Adam
 
     def init(self, params):
         import jax.numpy as jnp
@@ -111,8 +125,10 @@ class Adam:
         )
         c1 = 1.0 - self.b1**t
         c2 = 1.0 - self.b2**t
+        wd = _decay_factor(self.lr, self.weight_decay) if self.weight_decay else 1.0
         new = jax.tree.map(
-            lambda p, m_, v_: p - self.lr * (m_ / c1) / (jnp.sqrt(v_ / c2) + self.eps),
+            lambda p, m_, v_: p * wd
+            - self.lr * (m_ / c1) / (jnp.sqrt(v_ / c2) + self.eps),
             params,
             m,
             v,
@@ -127,18 +143,62 @@ def is_stateless(opt) -> bool:
     return not opt.state_layout()
 
 
-def make_optimizer(name: str, lr: float, momentum: float = 0.9):
+def make_optimizer(name: str, lr: float, momentum: float = 0.9, weight_decay: float = 0.0):
     """Optimizer registry for the CLI/API surface (reference hardwires SGD,
-    train.py:107)."""
+    train.py:107). ``weight_decay`` is decoupled and UNIFORM over every
+    param element including biases — uniformity is what keeps the update
+    exact under ZeRO-1's flat chunking."""
+    if weight_decay:
+        _decay_factor(lr, weight_decay)  # validate eagerly, not at trace time
     if name == "sgd":
-        return SGD(lr)
+        return SGD(lr, weight_decay=weight_decay)
     if name == "momentum":
-        return MomentumSGD(lr, momentum)
+        return MomentumSGD(lr, momentum, weight_decay=weight_decay)
     if name == "adam":
-        return Adam(lr)
+        return Adam(lr, weight_decay=weight_decay)
     raise ValueError(
         f"optimizer must be one of ['adam', 'momentum', 'sgd'], got {name!r}"
     )
+
+
+def clip_scale(grads_sq_sum, clip_norm):
+    """Global-norm clip factor: min(1, clip/||g||) from the SUM OF SQUARES of
+    the full gradient (callers supply the cross-device total where grads are
+    sharded). One definition shared by every execution path."""
+    import jax.numpy as jnp
+
+    norm = jnp.sqrt(grads_sq_sum)
+    return jnp.minimum(1.0, clip_norm / jnp.maximum(norm, 1e-12))
+
+
+def clip_tree(grads, clip_norm, cross_device_sum=None):
+    """Scale a gradient pytree by the global-norm clip factor. The local
+    sum-of-squares is optionally reduced by ``cross_device_sum`` (a callable,
+    e.g. a psum over the axes the gradient is sharded across) before the
+    factor is computed — the ONE implementation behind the sequential,
+    pipeline and ZeRO-1 paths (which differ only in that reduction)."""
+    import jax
+    import jax.numpy as jnp
+
+    sq = sum(jnp.sum(g * g) for g in jax.tree.leaves(grads))
+    if cross_device_sum is not None:
+        sq = cross_device_sum(sq)
+    s = clip_scale(sq, clip_norm)
+    return jax.tree.map(lambda g: g * s, grads)
+
+
+def _decay_factor(lr, weight_decay):
+    """Decoupled weight decay multiplier (1 - lr*wd); validated once here —
+    the single definition all optimizers share."""
+    if weight_decay < 0:
+        raise ValueError(f"weight_decay must be >= 0, got {weight_decay}")
+    f = 1.0 - lr * weight_decay
+    if f <= 0:
+        raise ValueError(
+            f"lr * weight_decay = {lr * weight_decay} >= 1 would flip the "
+            "decay factor's sign"
+        )
+    return f
 
 
 def split_state(opt, state):

@@ -403,6 +403,7 @@ def make_pipeline_step(
     jit=True,
     tick_unroll=1,
     zero1=False,
+    clip_norm=None,
 ):
     """Build the jitted SPMD step executing one TickProgram over the mesh.
 
@@ -420,6 +421,12 @@ def make_pipeline_step(
     above; opt_state must come from ``zero1_init_state``). Exact for
     elementwise optimizers; bit-identical math to the plain path up to
     collective reassociation.
+
+    ``clip_norm``: optional global-norm gradient clipping before the update.
+    The norm is GLOBAL over every parameter of the model: the local squared
+    sum is psum'd over ``pp`` (and, under zero1, over ``dp`` where the
+    summed gradient lives chunked) — padded entries are exactly zero, so the
+    stacked norm equals the logical norm.
 
     Inference:
         step(stacked, flags, x) -> preds (global_eval_batch, out_width) P('dp')
@@ -638,6 +645,13 @@ def make_pipeline_step(
             gsh = lax.psum_scatter(
                 jnp.pad(gvec, (0, pad)), "dp", scatter_dimension=0, tiled=True
             )
+            if clip_norm is not None:
+                from shallowspeed_tpu.optimizer import clip_tree
+
+                # chunks partition the full summed gradient across (dp, pp)
+                gsh = clip_tree(
+                    gsh, clip_norm, lambda sq: lax.psum(sq, ("dp", "pp"))
+                )
             pvec = jnp.concatenate(
                 [w.reshape(-1) for w in stacked["W"]]
                 + [b.reshape(-1) for b in stacked["b"]]
@@ -677,8 +691,14 @@ def make_pipeline_step(
         # pytree over dp per batch (reference pipe.py:302-327)
         gW = lax.psum(carry["gW"], "dp")
         gb = lax.psum(carry["gb"], "dp")
-        local = {"W": stacked["W"], "b": stacked["b"]}
         grads = {"W": gW, "b": gb}  # (V, ...) leaves, mirroring the shards
+        if clip_norm is not None:
+            from shallowspeed_tpu.optimizer import clip_tree
+
+            # each pp device holds its stages' full (dp-summed) gradient;
+            # the global norm needs the cross-stage total
+            grads = clip_tree(grads, clip_norm, lambda sq: lax.psum(sq, "pp"))
+        local = {"W": stacked["W"], "b": stacked["b"]}
         new_local, opt_state = opt.apply(local, grads, opt_state)
         return new_local, opt_state, loss
 
@@ -760,16 +780,18 @@ def make_pipeline_epoch(
     unroll=1,
     tick_unroll=1,
     zero1=False,
+    clip_norm=None,
 ):
     """Scan the pipeline train step over all batches of an epoch: one XLA
     program per epoch. X: (num_batches, global_batch, in_dim), batch axis
     sharded over dp. ``epoch(stacked, flags, opt_state, X, Y) -> (stacked,
     opt_state, mean_loss)``. ``unroll``/``tick_unroll``: lax.scan unroll
     factors for the batch loop / the per-tick loop (throughput knobs,
-    identical numerics); ``zero1`` shards the optimizer update over dp."""
+    identical numerics); ``zero1`` shards the optimizer update over dp;
+    ``clip_norm`` clips the global gradient norm before each update."""
     step = make_pipeline_step(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
-        tick_unroll=tick_unroll, zero1=zero1,
+        tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
     )
 
     @partial(jax.jit, donate_argnums=(0, 2))

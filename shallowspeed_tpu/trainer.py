@@ -26,9 +26,11 @@ from shallowspeed_tpu import ops
 from shallowspeed_tpu.model import ModelSpec, model_backward, model_forward
 
 
-def _make_batch_step(spec: ModelSpec, opt, precision, fuse_mubatches=False):
+def _make_batch_step(spec: ModelSpec, opt, precision, fuse_mubatches=False, clip_norm=None):
     """The shared per-batch body: microbatch gradient accumulation + optimizer
     apply. Used by both the per-batch step and the epoch scan.
+    ``clip_norm``: optional global-norm gradient clipping (over ALL params)
+    applied to the accumulated batch gradient before the optimizer.
 
     ``fuse_mubatches=True`` computes the whole batch in ONE forward/backward
     instead of scanning microbatches. This is the same training computation:
@@ -42,6 +44,13 @@ def _make_batch_step(spec: ModelSpec, opt, precision, fuse_mubatches=False):
     mechanism parity with the reference and for the pipeline executor, where
     microbatches are semantic.
     """
+
+    def clipped(grads):
+        if clip_norm is None:
+            return grads
+        from shallowspeed_tpu.optimizer import clip_tree
+
+        return clip_tree(grads, clip_norm)
 
     def batch_step(params, opt_state, xb, yb):
         """Returns (params, opt_state, batch_loss) — the loss is the global-
@@ -57,7 +66,7 @@ def _make_batch_step(spec: ModelSpec, opt, precision, fuse_mubatches=False):
                 params, spec, res, y, precision=precision, head_group_rows=rows
             )
             loss = ops.mse_loss(out, y, spec.global_batch_size)
-            params, opt_state = opt.apply(params, grads, opt_state)
+            params, opt_state = opt.apply(params, clipped(grads), opt_state)
             return params, opt_state, loss
 
         def accumulate(carry, mxy):
@@ -72,20 +81,24 @@ def _make_batch_step(spec: ModelSpec, opt, precision, fuse_mubatches=False):
         (grads, loss), _ = lax.scan(
             accumulate, (zeros, jnp.zeros(())), (xb, yb)
         )
-        params, opt_state = opt.apply(params, grads, opt_state)
+        params, opt_state = opt.apply(params, clipped(grads), opt_state)
         return params, opt_state, loss
 
     return batch_step
 
 
 def make_train_step(
-    spec: ModelSpec, opt, precision=ops.DEFAULT_PRECISION, fuse_mubatches=False
+    spec: ModelSpec,
+    opt,
+    precision=ops.DEFAULT_PRECISION,
+    fuse_mubatches=False,
+    clip_norm=None,
 ):
     """Returns jitted ``step(params, opt_state, xb, yb) -> (params, opt_state)``.
 
     ``xb``: (M, mubatch, in_dim); ``yb``: (M, mubatch, out_dim) one-hot.
     """
-    batch_step = _make_batch_step(spec, opt, precision, fuse_mubatches)
+    batch_step = _make_batch_step(spec, opt, precision, fuse_mubatches, clip_norm)
 
     def step(params, opt_state, xb, yb):
         params, opt_state, _ = batch_step(params, opt_state, xb, yb)
@@ -100,6 +113,7 @@ def make_train_epoch(
     precision=ops.DEFAULT_PRECISION,
     fuse_mubatches=False,
     unroll=1,
+    clip_norm=None,
 ):
     """Whole-epoch scan: ``epoch(params, opt_state, X, Y) -> (params,
     opt_state, mean_loss)`` with X: (num_batches, M, mubatch, in_dim). One
@@ -110,7 +124,7 @@ def make_train_epoch(
     batch body is a handful of small matmuls, so unrolling amortizes the
     per-iteration loop overhead (a throughput knob; identical numerics).
     """
-    batch_step = _make_batch_step(spec, opt, precision, fuse_mubatches)
+    batch_step = _make_batch_step(spec, opt, precision, fuse_mubatches, clip_norm)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def epoch(params, opt_state, X, Y):

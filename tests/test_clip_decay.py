@@ -1,0 +1,151 @@
+"""Global-norm gradient clipping + decoupled weight decay.
+
+Both compose elementwise with every execution path, so the bar is the usual
+one: mesh layouts (incl. zero1 and interleaved) must match sequential
+training with the same settings, and clipping must actually bound the norm.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shallowspeed_tpu import model as Mo
+from shallowspeed_tpu import schedules as S
+from shallowspeed_tpu import trainer
+from shallowspeed_tpu.optimizer import SGD, Adam, MomentumSGD, clip_scale
+from shallowspeed_tpu.parallel import executor as E
+from shallowspeed_tpu.parallel import lower_schedule, make_mesh
+
+SIZES = (24, 20, 18, 16, 14, 12, 11, 10)
+B, M, NB = 64, 4, 3
+CLIP = 0.05  # far below this problem's natural grad norm -> always active
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(NB, B, SIZES[0]).astype(np.float32)
+    Y = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, 10, (NB, B))]
+    return X, Y
+
+
+def _sequential(opt, clip_norm):
+    X, Y = _data()
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+    step = trainer.make_train_step(spec, opt, clip_norm=clip_norm)
+    st = opt.init(params)
+    for i in range(NB):
+        params, st = step(
+            params,
+            st,
+            jnp.asarray(X[i].reshape(M, B // M, -1)),
+            jnp.asarray(Y[i].reshape(M, B // M, -1)),
+        )
+    return [l for s in params for l in s]
+
+
+def _mesh(opt, clip_norm, dp, pp, zero1=False, virtual=1):
+    X, Y = _data()
+    mesh = make_mesh(dp, pp)
+    spec = Mo.make_model_spec(SIZES, pp * virtual, B)
+    order = E.interleave_order(pp * virtual, pp) if virtual > 1 else None
+    sched = S.InterleavedSchedule if virtual > 1 else S.GPipeSchedule
+    prog = lower_schedule(sched, M, pp, virtual=virtual)
+    stacked, flags = E.init_stacked(spec, mesh, order=order)
+    st = E.zero1_init_state(opt, spec, mesh) if zero1 else opt.init(stacked)
+    step = E.make_pipeline_step(
+        mesh, spec, prog, B // dp // M, opt, zero1=zero1, clip_norm=clip_norm
+    )
+    for i in range(NB):
+        stacked, st, _ = step(stacked, flags, st, jnp.asarray(X[i]), jnp.asarray(Y[i]))
+    return [l for s in E.unstack_params(stacked, spec, order=order) for l in s]
+
+
+@pytest.mark.parametrize("zero1,virtual", [(False, 1), (True, 1), (True, 2)])
+def test_clipping_mesh_matches_sequential(zero1, virtual):
+    opt = MomentumSGD(0.01, 0.9)
+    want = _sequential(opt, CLIP)
+    got = _mesh(opt, CLIP, 2, 2, zero1=zero1, virtual=virtual)
+    assert len(want) == len(got)
+    for a, b in zip(want, got):
+        np.testing.assert_allclose(np.asarray(a["W"]), b["W"], rtol=5e-4, atol=5e-6)
+        np.testing.assert_allclose(
+            np.asarray(a["b"]).reshape(-1), b["b"].reshape(-1), rtol=5e-4, atol=5e-6
+        )
+
+
+def test_clipping_changes_training_and_bounds_step():
+    """With clip far below the natural norm, the first update must have
+    global norm exactly lr * CLIP (SGD), and differ from unclipped."""
+    opt = SGD(0.01)
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    X, Y = _data()
+    p0 = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+    step_c = trainer.make_train_step(spec, opt, clip_norm=CLIP)
+    step_u = trainer.make_train_step(spec, opt)
+    xb = jnp.asarray(X[0].reshape(M, B // M, -1))
+    yb = jnp.asarray(Y[0].reshape(M, B // M, -1))
+    pc, _ = step_c(jax.tree.map(jnp.copy, p0), (), xb, yb)
+    pu, _ = step_u(jax.tree.map(jnp.copy, p0), (), xb, yb)
+    d_c = jax.tree.map(lambda a, b: a - b, pc, p0)
+    step_norm = float(
+        jnp.sqrt(sum(jnp.sum(g * g) for g in jax.tree.leaves(d_c)))
+    )
+    assert step_norm == pytest.approx(0.01 * CLIP, rel=1e-4)
+    du = jax.tree.leaves(jax.tree.map(lambda a, b: jnp.abs(a - b).max(), pc, pu))
+    assert max(float(x) for x in du) > 0
+
+
+def test_clip_scale_definition():
+    assert float(clip_scale(jnp.asarray(4.0), 1.0)) == pytest.approx(0.5)
+    assert float(clip_scale(jnp.asarray(0.25), 1.0)) == 1.0  # under the cap
+
+
+@pytest.mark.parametrize("opt_cls", [SGD, MomentumSGD, Adam])
+def test_weight_decay_shrinks_weights(opt_cls):
+    """Decoupled decay: same grads, decayed params strictly smaller in norm
+    than the undecayed run after a step; padded stacked regions stay zero."""
+    kw = {"lr": 0.01}
+    opt_p = opt_cls(**kw)
+    opt_d = opt_cls(weight_decay=0.1, **kw)
+    want_p = _sequential(opt_p, None)
+    want_d = _sequential(opt_d, None)
+    n_p = sum(float(np.square(l["W"]).sum()) for l in want_p)
+    n_d = sum(float(np.square(l["W"]).sum()) for l in want_d)
+    assert n_d < n_p
+
+    got_d = _mesh(opt_d, None, 2, 2, zero1=True)
+    for a, b in zip(want_d, got_d):
+        np.testing.assert_allclose(np.asarray(a["W"]), b["W"], rtol=5e-3, atol=5e-5)
+        np.testing.assert_allclose(
+            np.asarray(a["b"]).reshape(-1), b["b"].reshape(-1), rtol=5e-3, atol=5e-5
+        )
+
+
+def test_bad_weight_decay_rejected():
+    from shallowspeed_tpu.optimizer import make_optimizer
+
+    with pytest.raises(ValueError, match="weight_decay"):
+        make_optimizer("sgd", 0.01, weight_decay=-0.1)
+    with pytest.raises(ValueError, match="sign"):
+        make_optimizer("sgd", 10.0, weight_decay=0.2)
+
+
+def test_weight_decay_mismatch_on_resume_rejected(tmp_path):
+    from shallowspeed_tpu.api import TrainingSession
+
+    rng = np.random.RandomState(0)
+    for suffix, n in (("train", 128), ("val", 32)):
+        np.save(tmp_path / f"x_{suffix}.npy", rng.rand(n, SIZES[0]).astype(np.float32))
+        np.save(
+            tmp_path / f"y_{suffix}.npy",
+            np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], n)],
+        )
+    kw = dict(sizes=SIZES, global_batch_size=B, data_dir=tmp_path)
+    run = TrainingSession(weight_decay=0.01, **kw)
+    run.train_epoch()
+    ck = tmp_path / "wd.npz"
+    run.save(ck)
+    with pytest.raises(ValueError, match="weight_decay"):
+        TrainingSession(resume=ck, **kw)

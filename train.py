@@ -96,6 +96,20 @@ def main():
         "larger matmuls for the MXU",
     )
     ap.add_argument(
+        "--weight-decay",
+        type=float,
+        default=0.0,
+        help="decoupled weight decay, uniform over every param element "
+        "(0 = reference parity)",
+    )
+    ap.add_argument(
+        "--clip-norm",
+        type=float,
+        default=None,
+        help="global-norm gradient clipping over ALL params (the norm spans "
+        "stages/replicas on mesh layouts); off by default",
+    )
+    ap.add_argument(
         "--scan-unroll",
         type=int,
         default=1,
@@ -139,6 +153,8 @@ def main():
         zero1=args.zero1,
         scan_unroll=args.scan_unroll,
         tick_unroll=args.tick_unroll,
+        weight_decay=args.weight_decay,
+        clip_norm=args.clip_norm,
     )
     if args.dp == 1 and args.pp == 1 and args.virtual_stages == 1:
         layout = "sequential"
