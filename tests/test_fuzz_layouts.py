@@ -118,7 +118,8 @@ def _random_case_r2(seed):
     B = int(dp * M * rng.choice([4, 8]))
     opt = OPTS[seed % 3]
     sched = S.InterleavedSchedule if V > 1 else SCHEDS[seed % 3]
-    return sizes, dp, pp, V, M, B, opt, zero1, sched
+    clip = [None, 0.05][(seed // 3) % 2]  # independent of the other bits
+    return sizes, dp, pp, V, M, B, opt, zero1, sched, clip
 
 
 @pytest.mark.parametrize("seed", range(12))
@@ -126,7 +127,7 @@ def test_random_r2_feature_combo_matches_sequential(seed):
     """Random (optimizer, zero1, virtual-stage) combinations must still equal
     sequential training with the same optimizer — the round-2 features
     compose, not just work in isolation."""
-    sizes, dp, pp, V, M, B, opt, zero1, sched = _random_case_r2(seed)
+    sizes, dp, pp, V, M, B, opt, zero1, sched, clip = _random_case_r2(seed)
     spec_pp = Mo.make_model_spec(sizes, pp * V, B)
     assert spec_pp.stages[-1].n_linears > 0  # generator guarantees parity regime
 
@@ -136,7 +137,7 @@ def test_random_r2_feature_combo_matches_sequential(seed):
 
     spec1 = Mo.make_model_spec(sizes, 1, B)
     params = jax.tree.map(jnp.asarray, Mo.init_model(spec1))
-    step1 = trainer.make_train_step(spec1, opt)
+    step1 = trainer.make_train_step(spec1, opt, clip_norm=clip)
     st = opt.init(params)
     for i in range(2):
         params, st = step1(
@@ -152,7 +153,9 @@ def test_random_r2_feature_combo_matches_sequential(seed):
     prog = lower_schedule(sched, M, pp, virtual=V)
     stacked, flags = E.init_stacked(spec_pp, mesh, order=order)
     ost = E.zero1_init_state(opt, spec_pp, mesh) if zero1 else opt.init(stacked)
-    step = E.make_pipeline_step(mesh, spec_pp, prog, B // dp // M, opt, zero1=zero1)
+    step = E.make_pipeline_step(
+        mesh, spec_pp, prog, B // dp // M, opt, zero1=zero1, clip_norm=clip
+    )
     for i in range(2):
         stacked, ost, _ = step(stacked, flags, ost, jnp.asarray(X[i]), jnp.asarray(Y[i]))
     got = [l for s in E.unstack_params(stacked, spec_pp, order=order) for l in s]
@@ -160,7 +163,7 @@ def test_random_r2_feature_combo_matches_sequential(seed):
 
     label = (
         f"sizes={sizes} dp={dp} pp={pp} V={V} M={M} B={B} "
-        f"{type(opt).__name__} zero1={zero1} {sched.__name__}"
+        f"{type(opt).__name__} zero1={zero1} clip={clip} {sched.__name__}"
     )
     # Adam's early update direction is ~g/|g| per element: near-zero second
     # moments amplify ulp-level cross-layout reassociation of g, so its
