@@ -13,11 +13,14 @@ they exercise the full multihost surface:
   4. one REAL pipeline-executor training step (DP=2 x PP=2, GPipe) over the
      process-spanning mesh, with ``dp`` laid across the process boundary the
      way it would be laid across hosts on a pod;
-  5. the same with interleaved virtual stages (P=2 x V=2): ring relays stay
+  5. the same step under ZeRO-1 + gradient clipping: the reduce_scatter that
+     shards the gradient and the all_gather that rebuilds the params both
+     cross the process boundary;
+  6. the same with interleaved virtual stages (P=2 x V=2): ring relays stay
      on-process while the dp reduce crosses the boundary.
 
-Prints one JSON line {"pid", "psum_ok", "loss", "loss_i"} on success; any
-assertion failure exits non-zero and fails the parent test.
+Prints one JSON line {"pid", "psum_ok", "loss", "loss_z", "loss_i"} on
+success; any assertion failure exits non-zero and fails the parent test.
 """
 
 import json
@@ -104,6 +107,21 @@ def main():
     step = E.make_pipeline_step(mesh, spec, prog, half // M, SGD(0.05))
     _, _, loss = step(stacked, fl, (), xg, yg)
 
+    # --- ZeRO-1 across the process boundary --------------------------------
+    # dp spans the two processes, so the reduce_scatter that shards the
+    # gradient and the all_gather that rebuilds the params BOTH cross it.
+    from shallowspeed_tpu.optimizer import MomentumSGD
+
+    opt_z = MomentumSGD(0.05, 0.9)
+    st_z, fl_z = E.stack_params(Mo.init_model(spec), spec)
+    st_z = jax.tree.map(lambda a: put_global(a, P("pp")), st_z)
+    fl_z = jax.tree.map(lambda a: put_global(a, P("pp")), fl_z)
+    oz = E.zero1_init_state(opt_z, spec, mesh)
+    step_z = E.make_pipeline_step(
+        mesh, spec, prog, half // M, opt_z, zero1=True, clip_norm=1.0
+    )
+    _, oz, loss_z = step_z(st_z, fl_z, oz, xg, yg)
+
     # --- interleaved virtual stages under the distributed runtime ---------
     # P=2 x V=2 = 4 model stages on each process's pp pair (ring relays incl.
     # the chunk wrap stay on-process) while the dp gradient reduce crosses
@@ -120,7 +138,13 @@ def main():
 
     print(
         json.dumps(
-            {"pid": pid, "psum_ok": True, "loss": float(loss), "loss_i": float(loss_i)}
+            {
+                "pid": pid,
+                "psum_ok": True,
+                "loss": float(loss),
+                "loss_z": float(loss_z),
+                "loss_i": float(loss_i),
+            }
         )
     )
 
