@@ -63,7 +63,8 @@ def main():
         "layout). NOTE on lr: momentum's effective step is lr/(1-mu) — "
         "divide sgd's lr by ~1/(1-mu) (1e-3 reaches 99.65%% in 20 epochs; "
         "sgd's 6e-3 diverges late). adam's normalized step is ~lr per "
-        "element — 2e-4 reaches 99.86%% after ONE epoch",
+        "element — 2e-4 reaches 99.86%% after ONE epoch, but destabilizes on "
+        "long runs (see BASELINE.md); prefer sgd/momentum past a few epochs",
     )
     ap.add_argument("--momentum", type=float, default=0.9)
     ap.add_argument(
