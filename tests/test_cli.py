@@ -100,6 +100,16 @@ def test_mesh_cli_interleaved_zero1_momentum(tiny_data):
     assert re.search(r"final model hash: [0-9a-f]{40}", out)
 
 
+def test_cli_clip_and_decay_flags(tiny_data):
+    out = _run(
+        ["--epochs", "1", "--global-batch-size", "32", "--mubatches", "2",
+         "--no-eval", "--clip-norm", "0.5", "--weight-decay", "0.01",
+         "--optimizer", "momentum", "--lr", "0.001"],
+        tiny_data,
+    )
+    assert re.search(r"final model hash: [0-9a-f]{40}", out)
+
+
 def test_cli_checkpoint_resume_round_trip(tiny_data, tmp_path):
     ck = tmp_path / "ck.npz"
     _run(
