@@ -15,6 +15,12 @@ report end-to-end training throughput.
 - value: this framework's jitted whole-epoch lax.scan on the default JAX
   device (the TPU chip when run by the driver).
 - vs_baseline: value / baseline  (>1 = faster than the NumPy reference).
+
+Timing protocol: two-point slope with forced host readbacks (see
+slope_epoch_seconds) — required because on the remote-TPU tunnel dispatch is
+fully async and jax.block_until_ready can return before execution finishes,
+which would otherwise measure dispatch latency and report physically
+impossible throughput.
 """
 
 import json
@@ -85,6 +91,83 @@ from shallowspeed_tpu.api import (  # the reference's canonical config
 N_SAMPLES = 59392  # MNIST train size after drop-last to 128-multiples
 
 
+def flops_per_sample():
+    """~FLOPs per training sample: fwd 2P + bwd 4P for P = sum(in*out)."""
+    return 6 * sum(SIZES[i] * SIZES[i + 1] for i in range(len(SIZES) - 1))
+
+
+def sync_readback(tree):
+    """Force device completion by reading back the smallest leaf.
+
+    On the axon remote-TPU tunnel, dispatch is fully asynchronous AND
+    jax.block_until_ready can return before execution finishes (observed:
+    5 dispatched epochs "ready" in 0.35 ms, then a 7 s readback). A host
+    readback cannot lie — materializing an output's bytes requires the whole
+    dependency chain to have executed — so every timing boundary here ends
+    in one.
+    """
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    np.asarray(min(leaves, key=lambda a: a.nbytes))
+
+
+def slope_epoch_seconds(run_k, k1=2, k2=8, trials=3):
+    """Honest seconds-per-epoch via a two-point slope.
+
+    ``run_k(k)`` must dispatch k epochs (advancing its own state) and end
+    with a forced readback (sync_readback). Timing k1 and k2 epochs and
+    taking (t2-t1)/(k2-k1) cancels both the constant dispatch cost and the
+    constant readback/tunnel-RTT cost, leaving pure per-epoch device time —
+    robust even when block_until_ready is untrustworthy (see sync_readback).
+
+    The chip pool shows transient multi-tenant contention (observed 3.3 ms
+    to 131 ms per epoch for identical work across claim windows), so each
+    leg is measured `trials` times and the MINIMUM PER LEG is taken BEFORE
+    differencing: each leg's minimum converges to its least-contended cost
+    and the constants still cancel. (Taking min over per-trial slopes
+    instead would be biased fast whenever a trial's k1 leg was contended
+    while its k2 leg was not.)
+    """
+    t_smalls, t_larges = [], []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        run_k(k1)
+        t_smalls.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_k(k2)
+        t_larges.append(time.perf_counter() - t0)
+    slope = (min(t_larges) - min(t_smalls)) / (k2 - k1)
+    if slope <= 0:
+        raise RuntimeError(
+            "slope timing failed: k2 epochs never measurably slower than k1 "
+            "(device not actually executing the work?)"
+        )
+    return slope
+
+
+def measured_epoch_sps(epoch_fn, params, opt_state, X, Y, trials=3):
+    """Honest samples/sec for a compiled-or-compilable whole-epoch function.
+
+    Shared timing-protocol entry point (bench.py, scripts/bench_tpu_matrix.py
+    and scripts/tpu_capture.py all measure through here so the protocol is
+    defined once). ``epoch_fn(params, opt_state, X, Y) -> (params, opt_state,
+    loss)`` with donated params/opt_state; X is (num_batches, M, mb, D).
+    """
+    state = {"p": params, "s": opt_state}
+
+    def run_k(k):
+        p, s = state["p"], state["s"]
+        for _ in range(k):
+            p, s, _ = epoch_fn(p, s, X, Y)
+        state["p"], state["s"] = p, s
+        sync_readback(p)
+
+    run_k(1)  # compile + warmup, synced
+    samples_per_epoch = X.shape[0] * X.shape[1] * X.shape[2]
+    return samples_per_epoch / slope_epoch_seconds(run_k, trials=trials)
+
+
 def numpy_baseline_sps(n_batches=40):
     """Fresh NumPy training step (reference-equivalent math), timed."""
     from shallowspeed_tpu.init import linear_init
@@ -130,7 +213,7 @@ def numpy_baseline_sps(n_batches=40):
     return n_batches * B / dt
 
 
-def jax_sps(n_epochs=5):
+def jax_sps():
     import jax
     import jax.numpy as jnp
 
@@ -157,15 +240,7 @@ def jax_sps(n_epochs=5):
         np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, M, B // M))]
     )
 
-    state = ()
-    params, state, _ = epoch(params, state, X, Y)  # compile + warmup
-    jax.block_until_ready(params)
-    t0 = time.perf_counter()
-    for _ in range(n_epochs):
-        params, state, _ = epoch(params, state, X, Y)
-    jax.block_until_ready(params)
-    dt = time.perf_counter() - t0
-    return n_epochs * nb * B / dt
+    return measured_epoch_sps(epoch, params, (), X, Y, trials=5)
 
 
 def main():
@@ -174,6 +249,15 @@ def main():
     value = jax_sps()
     # a degraded run is unmistakable in the recorded metric itself
     metric = "mnist_mlp_train_samples_per_sec_per_chip" + fallback_tag
+    # physical plausibility guard: if the implied FLOP rate exceeds anything a
+    # single chip can do, the timing protocol was defeated — label, don't lie
+    if value * flops_per_sample() > 100e12:
+        metric += "_SUSPECT_TIMING"
+        print(
+            f"bench: implied {value * flops_per_sample() / 1e12:.0f} TFLOP/s "
+            "exceeds single-chip fp32 plausibility; tagging metric",
+            file=sys.stderr,
+        )
     print(
         json.dumps(
             {

@@ -9,14 +9,13 @@ Reference cell: scanned microbatches + HIGHEST precision + XLA kernels (the
 NumPy-parity configuration). Runs anywhere (CPU included) — on CPU it mostly
 measures XLA CPU codegen, which is still useful for regression tracking.
 
-    python scripts/bench_tpu_matrix.py --batches 116 --reps 3
+    python scripts/bench_tpu_matrix.py --batches 116 --trials 3
 """
 
 import argparse
 import itertools
 import json
 import sys
-import time
 from pathlib import Path
 
 import numpy as np
@@ -31,7 +30,7 @@ from shallowspeed_tpu.api import (  # the reference's canonical config
 )
 
 
-def measure(fused, precision_name, pallas, nb, reps):
+def measure(fused, precision_name, pallas, nb, trials):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -57,14 +56,9 @@ def measure(fused, precision_name, pallas, nb, reps):
                 rng.randint(0, SIZES[-1], (nb, M, B // M))
             ]
         )
-        st = ()
-        params, st, _ = epoch(params, st, X, Y)
-        jax.block_until_ready(params)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            params, st, _ = epoch(params, st, X, Y)
-        jax.block_until_ready(params)
-        return reps * nb * B / (time.perf_counter() - t0)
+        import bench
+
+        return bench.measured_epoch_sps(epoch, params, (), X, Y, trials=trials)
     finally:
         ops.set_pallas(False)
 
@@ -72,7 +66,13 @@ def measure(fused, precision_name, pallas, nb, reps):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", type=int, default=116)
-    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument(
+        "--trials",
+        type=int,
+        default=3,
+        help="slope-timing trials per cell; each trial times 2+8 epochs "
+        "(see bench.slope_epoch_seconds)",
+    )
     ap.add_argument("--skip-pallas", action="store_true")
     args = ap.parse_args()
 
@@ -88,7 +88,7 @@ def main():
             prec,
             "pallas" if pallas else "xla",
         )
-        sps = measure(fused, prec, pallas, args.batches, args.reps)
+        sps = measure(fused, prec, pallas, args.batches, args.trials)
         results[key] = sps
         print(
             json.dumps(
