@@ -33,3 +33,64 @@ def test_train_cli_help():
     assert r.returncode == 0
     for flag in ("--dp", "--pp", "--schedule", "--checkpoint", "--resume", "--precision"):
         assert flag in r.stdout
+
+
+def _import_bench():
+    sys.path.insert(0, str(ROOT))
+    try:
+        import bench
+    finally:
+        sys.path.remove(str(ROOT))
+    return bench
+
+
+def test_slope_timing_per_leg_minima(monkeypatch):
+    """The slope estimator must take per-leg minima BEFORE differencing, so a
+    contended leg in one trial cannot corrupt the estimate (TPU_STATUS_r02.md
+    finding 5: chip-pool contention varies 40x across claim windows)."""
+    bench = _import_bench()
+    fake = {"t": 0.0}
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: fake["t"])
+    calls = {"n": 0}
+
+    def run_k(k):
+        contention = 0.5 if calls["n"] == 0 else 0.0  # first k1 leg contended
+        calls["n"] += 1
+        fake["t"] += 0.1 + 0.01 * k + contention  # constant + per-epoch cost
+
+    est = bench.slope_epoch_seconds(run_k, k1=2, k2=8, trials=3)
+    assert abs(est - 0.01) < 1e-12  # constants and the contended leg cancel out
+
+
+def test_slope_timing_rejects_non_positive_slope(monkeypatch):
+    """If more epochs never cost more time, the device isn't executing the
+    work (the async-dispatch failure mode) — the protocol must refuse."""
+    import pytest
+
+    bench = _import_bench()
+    fake = {"t": 0.0}
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: fake["t"])
+
+    def run_k(k):
+        fake["t"] += 0.1  # pure constant: dispatch-only, no real execution
+
+    with pytest.raises(RuntimeError, match="slope timing failed"):
+        bench.slope_epoch_seconds(run_k, trials=2)
+
+
+def test_measured_epoch_sps_protocol(monkeypatch):
+    """measured_epoch_sps = samples_per_epoch / honest-slope, warmup excluded."""
+    import numpy as np
+
+    bench = _import_bench()
+    fake = {"t": 0.0}
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: fake["t"])
+    monkeypatch.setattr(bench, "sync_readback", lambda tree: None)
+
+    def epoch_fn(p, s, X, Y):
+        fake["t"] += 0.02  # 20 ms per epoch of "device" time
+        return p, s, 0.0
+
+    X = np.zeros((4, 2, 8, 3), np.float32)  # 4 batches x 2 mubatches x 8 rows
+    sps = bench.measured_epoch_sps(epoch_fn, {"w": np.zeros(2)}, (), X, None)
+    assert abs(sps - (4 * 2 * 8) / 0.02) < 1e-6
