@@ -132,6 +132,7 @@ class TrainingSession:
         # load nor the device transfer
         self._vx = self._vy = None
         self._predict_cache = {}  # mesh predict() programs, keyed by row count
+        self._run_fns = {}  # fused multi-epoch programs, keyed by with_eval
 
         nb = self._train_ds.get_num_batches()
         if nb == 0:
@@ -226,6 +227,10 @@ class TrainingSession:
                 clip_norm=clip_norm,
             )
             self._predict = trainer.make_predict(self.spec, precision=self.precision)
+            self._run_kwargs = dict(
+                precision=self.precision, fuse_mubatches=fuse_mubatches,
+                unroll=scan_unroll, clip_norm=clip_norm,
+            )
             self._Xe = self._X.reshape(nb, self.M, self.B // self.M, -1)
             self._Ye = self._Y.reshape(nb, self.M, self.B // self.M, -1)
             self._X = self._Y = None  # the microbatched views are the only users
@@ -269,6 +274,13 @@ class TrainingSession:
                 unroll=scan_unroll, tick_unroll=tick_unroll,
                 clip_norm=clip_norm,
             )
+            self._prog = prog
+            self._mubatch_local = local_batch // mubatches
+            self._run_kwargs = dict(
+                precision=self.precision, unroll=scan_unroll,
+                tick_unroll=tick_unroll, zero1=self._zero1,
+                clip_norm=clip_norm,
+            )
             self._eval_step = None  # built lazily, sized to the val split
 
     # -- training -----------------------------------------------------------
@@ -287,6 +299,66 @@ class TrainingSession:
             )
         self.epoch += 1
         return float(mean_loss)
+
+    def train_run(self, epochs: int, with_eval: bool = True):
+        """Train ``epochs`` epochs; returns ``(losses, accuracies)`` as lists
+        of floats (``accuracies`` is None when ``with_eval=False``).
+
+        The ENTIRE run — every epoch and (when ``with_eval``) its full-split
+        accuracy — is one on-device XLA program on EVERY layout
+        (trainer.make_train_run sequentially, executor.make_pipeline_run on
+        the mesh): zero host round-trips, which on a remote-tunneled chip
+        removes an ~epoch-count × RTT readback cost. Matches the reference's
+        epoch structure, train.py:132-137.
+        """
+        if epochs <= 0:
+            raise ValueError("epochs must be positive")
+        if with_eval and self._vx is None:
+            self._load_val()
+        if self._sequential:
+            if with_eval not in self._run_fns:
+                self._run_fns[with_eval] = trainer.make_train_run(
+                    self.spec, self._opt, with_eval=with_eval, **self._run_kwargs
+                )
+            if with_eval:
+                self._params, self._opt_state, losses, accs = self._run_fns[True](
+                    self._params, self._opt_state, self._Xe, self._Ye,
+                    self._vx, self._vy, epochs,
+                )
+            else:
+                self._params, self._opt_state, losses = self._run_fns[False](
+                    self._params, self._opt_state, self._Xe, self._Ye, epochs
+                )
+                accs = None
+        else:
+            if with_eval not in self._run_fns:
+                eval_kwargs = {}
+                if with_eval:
+                    rows = self._vx_padded.shape[0]
+                    eval_kwargs = dict(
+                        eval_prog=self._lower_inference_prog(),
+                        eval_mubatch_size=rows // self.dp,
+                    )
+                self._run_fns[with_eval] = E.make_pipeline_run(
+                    self.mesh, self.spec, self._prog, self._mubatch_local,
+                    self._opt, **self._run_kwargs, **eval_kwargs,
+                )
+            if with_eval:
+                self._stacked, self._opt_state, losses, accs = self._run_fns[True](
+                    self._stacked, self._flags, self._opt_state,
+                    self._X, self._Y, self._vx_padded, self._vy_labels, epochs,
+                )
+            else:
+                self._stacked, self._opt_state, losses = self._run_fns[False](
+                    self._stacked, self._flags, self._opt_state,
+                    self._X, self._Y, epochs,
+                )
+                accs = None
+        self.epoch += epochs
+        return (
+            [float(v) for v in np.asarray(losses)],
+            [float(v) for v in np.asarray(accs)] if with_eval else None,
+        )
 
     # -- evaluation ---------------------------------------------------------
 
@@ -328,21 +400,24 @@ class TrainingSession:
         xb = jnp.asarray(np.pad(x, ((0, rows - n), (0, 0))))
         return np.asarray(step(self._stacked, self._flags, xb))[:n, :out_dim]
 
+    def _lower_inference_prog(self):
+        """The layout's inference TickProgram (interleaved-aware) — shared by
+        the cached predict/eval programs and the fused train_run eval."""
+        if self.V > 1:
+            return lower_schedule(
+                S.InterleavedInferenceSchedule, 1, self.pp,
+                training=False, virtual=self.V,
+            )
+        return lower_schedule(S.InferenceSchedule, 1, self.pp, training=False)
+
     def _inference_step(self, rows):
         """Cached whole-batch inference program for a padded row count
         (mesh layouts; shared by predict() and the validation path)."""
         step = self._predict_cache.get(rows)
         if step is None:
-            if self.V > 1:
-                prog = lower_schedule(
-                    S.InterleavedInferenceSchedule, 1, self.pp,
-                    training=False, virtual=self.V,
-                )
-            else:
-                prog = lower_schedule(S.InferenceSchedule, 1, self.pp, training=False)
             step = E.make_pipeline_step(
-                self.mesh, self.spec, prog, rows // self.dp,
-                precision=self.precision,
+                self.mesh, self.spec, self._lower_inference_prog(),
+                rows // self.dp, precision=self.precision,
             )
             self._predict_cache[rows] = step
         return step

@@ -793,9 +793,15 @@ def make_pipeline_epoch(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
         tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
     )
+    return jax.jit(_make_pipeline_epoch_core(step, unroll), donate_argnums=(0, 2))
 
-    @partial(jax.jit, donate_argnums=(0, 2))
-    def epoch(stacked, flags, opt_state, X, Y):
+
+def _make_pipeline_epoch_core(step, unroll):
+    """The one batch-scan epoch body shared by make_pipeline_epoch and
+    make_pipeline_run: ``core(stacked, flags, opt_state, X, Y) ->
+    (stacked, opt_state, mean_loss)``."""
+
+    def epoch_core(stacked, flags, opt_state, X, Y):
         def body(carry, xy):
             stacked, opt_state, loss_sum = carry
             stacked, opt_state, loss = step(stacked, flags, opt_state, xy[0], xy[1])
@@ -806,4 +812,86 @@ def make_pipeline_epoch(
         )
         return stacked, opt_state, loss_sum / X.shape[0]
 
-    return epoch
+    return epoch_core
+
+
+def make_pipeline_run(
+    mesh,
+    spec,
+    prog,
+    mubatch_size,
+    opt,
+    precision=ops.DEFAULT_PRECISION,
+    unroll=1,
+    tick_unroll=1,
+    zero1=False,
+    clip_norm=None,
+    eval_prog=None,
+    eval_mubatch_size=None,
+):
+    """Epochs-outer scan around the pipeline epoch: the whole multi-epoch run
+    as ONE XLA program over the mesh (the pipeline counterpart of
+    trainer.make_train_run — zero host round-trips for the full run).
+
+    Without eval: ``run(stacked, flags, opt_state, X, Y, n_epochs) ->
+    (stacked, opt_state, losses[n_epochs])``.
+
+    With ``eval_prog`` (an InferenceSchedule TickProgram lowered for the
+    padded validation row count): ``run(stacked, flags, opt_state, X, Y,
+    vx_padded, vy_labels, n_epochs) -> (stacked, opt_state, losses, accs)``
+    where the full-split argmax accuracy is computed on-device after each
+    epoch (vy_labels: (n_val,) int labels, unpadded — the static slice
+    drops the padded rows).
+
+    ``n_epochs`` is static (one compile per value).
+    """
+    step = make_pipeline_step(
+        mesh, spec, prog, mubatch_size, opt, precision, jit=False,
+        tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
+    )
+    eval_step = None
+    if eval_prog is not None:
+        eval_step = make_pipeline_step(
+            mesh, spec, eval_prog, eval_mubatch_size, precision=precision,
+            jit=False,
+        )
+    out_dim = spec.out_dim
+    epoch_core = _make_pipeline_epoch_core(step, unroll)
+
+    if eval_step is None:
+
+        @partial(jax.jit, static_argnums=(5,), donate_argnums=(0, 2))
+        def run(stacked, flags, opt_state, X, Y, n_epochs):
+            def epoch_body(carry, _):
+                stacked, opt_state = carry
+                stacked, opt_state, mean_loss = epoch_core(
+                    stacked, flags, opt_state, X, Y
+                )
+                return (stacked, opt_state), mean_loss
+
+            (stacked, opt_state), losses = lax.scan(
+                epoch_body, (stacked, opt_state), None, length=n_epochs
+            )
+            return stacked, opt_state, losses
+
+        return run
+
+    @partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 2))
+    def run(stacked, flags, opt_state, X, Y, vx_padded, vy_labels, n_epochs):
+        n_val = vy_labels.shape[0]
+
+        def epoch_body(carry, _):
+            stacked, opt_state = carry
+            stacked, opt_state, mean_loss = epoch_core(
+                stacked, flags, opt_state, X, Y
+            )
+            preds = eval_step(stacked, flags, vx_padded)[:n_val, :out_dim]
+            acc = jnp.mean((jnp.argmax(preds, axis=1) == vy_labels).astype(jnp.float32))
+            return (stacked, opt_state), (mean_loss, acc)
+
+        (stacked, opt_state), (losses, accs) = lax.scan(
+            epoch_body, (stacked, opt_state), None, length=n_epochs
+        )
+        return stacked, opt_state, losses, accs
+
+    return run

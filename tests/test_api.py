@@ -190,3 +190,70 @@ def test_invalid_config_rejected(data_dir):
         _session(data_dir, pp=2, schedule="1f1b")  # not a registered name
     with pytest.raises(ValueError):
         _session(data_dir, global_batch_size=4096)  # > training split
+
+
+def test_train_run_matches_epoch_loop(data_dir):
+    """The fused multi-epoch program (one dispatch for every epoch + its
+    on-device full-split accuracy) must reproduce the looped
+    train_epoch()/accuracy() path: same losses, same accuracies, same
+    final weights."""
+    looped = _session(data_dir)
+    loop_losses, loop_accs = [], []
+    for _ in range(3):
+        loop_losses.append(looped.train_epoch())
+        loop_accs.append(looped.accuracy())
+
+    fused = _session(data_dir)
+    losses, accs = fused.train_run(3)
+    assert fused.epoch == 3
+    assert np.allclose(losses, loop_losses, rtol=1e-6, atol=0)
+    assert np.allclose(accs, loop_accs, atol=1e-6)
+    assert fused.model_hash() == looped.model_hash()
+
+    # a second fused run continues from the advanced state
+    more_losses, _ = fused.train_run(2)
+    assert fused.epoch == 5
+    assert more_losses[0] < losses[0]
+
+
+def test_train_run_mesh_fused(data_dir):
+    """Mesh layouts run the whole multi-epoch program on-device too
+    (executor.make_pipeline_run) and agree with the sequential run and with
+    the mesh epoch loop."""
+    run = _session(data_dir, dp=2, pp=2, schedule="gpipe")
+    losses, accs = run.train_run(2)
+    assert len(losses) == len(accs) == 2 and run.epoch == 2
+
+    seq = _session(data_dir)
+    seq_losses, seq_accs = seq.train_run(2)
+    assert np.allclose(losses, seq_losses, rtol=1e-5)
+    assert np.allclose(accs, seq_accs, atol=1e-6)
+
+    looped = _session(data_dir, dp=2, pp=2, schedule="gpipe")
+    loop_losses = [looped.train_epoch() for _ in range(2)]
+    assert np.allclose(losses, loop_losses, rtol=1e-6)
+    assert run.model_hash() == looped.model_hash()
+
+    # losses-only variant and the interleaved inference-program branch
+    ne = _session(data_dir, dp=2, pp=2, schedule="gpipe")
+    ne_losses, ne_accs = ne.train_run(2, with_eval=False)
+    assert ne_accs is None and np.allclose(ne_losses, losses, rtol=1e-6)
+    iv = _session(data_dir, pp=2, virtual_stages=2, schedule="interleaved")
+    iv_losses, iv_accs = iv.train_run(2)
+    assert len(iv_losses) == len(iv_accs) == 2
+
+
+def test_train_run_rejects_nonpositive_epochs(data_dir):
+    with pytest.raises(ValueError, match="epochs"):
+        _session(data_dir).train_run(0)
+
+
+def test_train_run_without_eval(data_dir):
+    """with_eval=False: no val split load, accs is None, same training."""
+    ref = _session(data_dir)
+    ref_losses, _ = ref.train_run(2)
+    run = _session(data_dir)
+    losses, accs = run.train_run(2, with_eval=False)
+    assert accs is None and run._vx is None  # val split never loaded
+    assert np.allclose(losses, ref_losses, rtol=1e-6, atol=0)
+    assert run.model_hash() == ref.model_hash()

@@ -124,3 +124,40 @@ def test_cli_checkpoint_resume_round_trip(tiny_data, tmp_path):
         tiny_data,
     )
     assert "resumed at epoch 1" in out
+
+
+def test_fused_run_cli_matches_loop(tiny_data):
+    """--fused-run (all epochs + eval in one device program) prints the SAME
+    per-epoch contract as the epoch loop — same epoch-labeled accuracy
+    sequence (pre-epoch semantics), same losses, same final hash."""
+    common = ["--epochs", "2", "--global-batch-size", "32", "--mubatches", "2"]
+    fused = _run(common + ["--fused-run"], tiny_data)
+    loop = _run(common, tiny_data)
+
+    def contract(out):
+        # fused mode omits the per-line cumulative clock (all its lines print
+        # after the one dispatch) — epoch labels and values must still agree
+        accs = re.findall(
+            r"Epoch: (\d+),(?: Time Spent: [\d.]+s,)? Accuracy: ([\d.]+)%", out
+        )
+        losses = re.findall(r"Epoch: (\d+), mean train loss: ([\d.]+)", out)
+        h = re.search(r"final model hash: ([0-9a-f]{40})", out).group(1)
+        return accs, losses, h
+
+    f_accs, f_losses, f_hash = contract(fused)
+    l_accs, l_losses, l_hash = contract(loop)
+    assert f_losses == l_losses and len(f_losses) == 2
+    assert f_accs == l_accs and len(f_accs) == 3  # pre-run, between, final
+    assert f_hash == l_hash
+
+
+def test_fused_run_cli_no_eval(tiny_data):
+    """--fused-run honors --no-eval: losses printed, no accuracy lines except
+    the final summary."""
+    out = _run(
+        ["--epochs", "2", "--global-batch-size", "32", "--mubatches", "2",
+         "--fused-run", "--no-eval"],
+        tiny_data,
+    )
+    assert out.count("mean train loss") == 2
+    assert out.count("Accuracy:") == 1  # the final summary only

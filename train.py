@@ -77,6 +77,15 @@ def main():
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--no-eval", action="store_true", help="skip per-epoch accuracy")
     ap.add_argument(
+        "--fused-run",
+        action="store_true",
+        help="run ALL epochs (+ per-epoch validation accuracy unless "
+        "--no-eval) as one on-device program — works on every layout "
+        "(sequential and DP x PP mesh). Prints the same per-epoch lines as "
+        "the loop. --profile-dir traces nothing per-epoch here, and "
+        "--checkpoint writes once at the end instead of per epoch.",
+    )
+    ap.add_argument(
         "--checkpoint", default=None, help="path to save a checkpoint after each epoch"
     )
     ap.add_argument(
@@ -178,20 +187,40 @@ def main():
         return contextlib.nullcontext()
 
     t0 = time.time()
-    for i in range(args.epochs):
+    if args.fused_run and args.epochs > 0:
+        # same accuracy semantics as the loop below — the "Epoch: N ...
+        # Accuracy" line reports the model's accuracy BEFORE epoch N trains
+        # (the initial one costs a single pre-run dispatch; the rest come
+        # out of the fused program's per-epoch accuracies). No per-epoch
+        # "Time Spent" here: all lines print after the single dispatch
+        # returns, so a per-line cumulative clock would be misleading.
         if not args.no_eval:
-            print(
-                f"Epoch: {run.epoch}, Time Spent: {time.time() - t0:.2f}s, "
-                f"Accuracy: {run.accuracy() * 100:.2f}%"
-            )
-        with profiled(i):
-            loss = run.train_epoch()
-        print(f"Epoch: {run.epoch - 1}, mean train loss: {loss:.5f}")
+            print(f"Epoch: {run.epoch}, Accuracy: {run.accuracy() * 100:.2f}%")
+        start = run.epoch
+        losses, accs = run.train_run(args.epochs, with_eval=not args.no_eval)
+        for e, loss in enumerate(losses):
+            print(f"Epoch: {start + e}, mean train loss: {loss:.5f}")
+            if not args.no_eval and e < len(losses) - 1:
+                print(f"Epoch: {start + e + 1}, Accuracy: {accs[e] * 100:.2f}%")
         if args.checkpoint:
             run.save(args.checkpoint)
+        final_acc = accs[-1] if accs else run.accuracy()
+    else:
+        for i in range(args.epochs):
+            if not args.no_eval:
+                print(
+                    f"Epoch: {run.epoch}, Time Spent: {time.time() - t0:.2f}s, "
+                    f"Accuracy: {run.accuracy() * 100:.2f}%"
+                )
+            with profiled(i):
+                loss = run.train_epoch()
+            print(f"Epoch: {run.epoch - 1}, mean train loss: {loss:.5f}")
+            if args.checkpoint:
+                run.save(args.checkpoint)
+        final_acc = run.accuracy()
     print(
         f"Epoch: {run.epoch}, Time Spent: {time.time() - t0:.2f}s, "
-        f"Accuracy: {run.accuracy() * 100:.2f}%"
+        f"Accuracy: {final_acc * 100:.2f}%"
     )
     run.assert_replicas_in_sync()
     if args.dp > 1:
