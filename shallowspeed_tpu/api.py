@@ -133,6 +133,7 @@ class TrainingSession:
         self._vx = self._vy = None
         self._predict_cache = {}  # mesh predict() programs, keyed by row count
         self._run_fns = {}  # fused multi-epoch programs, keyed by with_eval
+        self._compiled_runs = {}  # AOT warm_run executables, keyed by (with_eval, epochs)
 
         nb = self._train_ds.get_num_batches()
         if nb == 0:
@@ -315,23 +316,56 @@ class TrainingSession:
             raise ValueError("epochs must be positive")
         if with_eval and self._vx is None:
             self._load_val()
+        compiled = self._compiled_runs.get((with_eval, epochs))
+        if compiled is not None:
+            out = compiled(*self._fused_run_args(with_eval))
+        else:
+            out = self._fused_run_fn(with_eval)(
+                *self._fused_run_args(with_eval), epochs
+            )
+        if with_eval:
+            state, opt_state, losses, accs = out
+        else:
+            state, opt_state, losses = out
+            accs = None
         if self._sequential:
-            if with_eval not in self._run_fns:
+            self._params = state
+        else:
+            self._stacked = state
+        self._opt_state = opt_state
+        self.epoch += epochs
+        return (
+            [float(v) for v in np.asarray(losses)],
+            [float(v) for v in np.asarray(accs)] if with_eval else None,
+        )
+
+    def warm_run(self, epochs: int, with_eval: bool = True):
+        """AOT-compile the fused ``train_run`` program without executing it.
+
+        The compiled executable is cached and reused by the next
+        ``train_run(epochs, with_eval)``, so e.g. a profiler trace around
+        that call captures steady-state device execution, not compilation.
+        """
+        if epochs <= 0:
+            raise ValueError("epochs must be positive")
+        if with_eval and self._vx is None:
+            self._load_val()
+        key = (with_eval, epochs)
+        if key not in self._compiled_runs:
+            self._compiled_runs[key] = (
+                self._fused_run_fn(with_eval)
+                .lower(*self._fused_run_args(with_eval), epochs)
+                .compile()
+            )
+
+    def _fused_run_fn(self, with_eval):
+        """Build (once per with_eval) the layout's fused whole-run program."""
+        if with_eval not in self._run_fns:
+            if self._sequential:
                 self._run_fns[with_eval] = trainer.make_train_run(
                     self.spec, self._opt, with_eval=with_eval, **self._run_kwargs
                 )
-            if with_eval:
-                self._params, self._opt_state, losses, accs = self._run_fns[True](
-                    self._params, self._opt_state, self._Xe, self._Ye,
-                    self._vx, self._vy, epochs,
-                )
             else:
-                self._params, self._opt_state, losses = self._run_fns[False](
-                    self._params, self._opt_state, self._Xe, self._Ye, epochs
-                )
-                accs = None
-        else:
-            if with_eval not in self._run_fns:
                 eval_kwargs = {}
                 if with_eval:
                     rows = self._vx_padded.shape[0]
@@ -343,22 +377,16 @@ class TrainingSession:
                     self.mesh, self.spec, self._prog, self._mubatch_local,
                     self._opt, **self._run_kwargs, **eval_kwargs,
                 )
-            if with_eval:
-                self._stacked, self._opt_state, losses, accs = self._run_fns[True](
-                    self._stacked, self._flags, self._opt_state,
-                    self._X, self._Y, self._vx_padded, self._vy_labels, epochs,
-                )
-            else:
-                self._stacked, self._opt_state, losses = self._run_fns[False](
-                    self._stacked, self._flags, self._opt_state,
-                    self._X, self._Y, epochs,
-                )
-                accs = None
-        self.epoch += epochs
-        return (
-            [float(v) for v in np.asarray(losses)],
-            [float(v) for v in np.asarray(accs)] if with_eval else None,
-        )
+        return self._run_fns[with_eval]
+
+    def _fused_run_args(self, with_eval):
+        """The layout's runtime argument tuple for the fused run (everything
+        except the static n_epochs)."""
+        if self._sequential:
+            base = (self._params, self._opt_state, self._Xe, self._Ye)
+            return base + ((self._vx, self._vy) if with_eval else ())
+        base = (self._stacked, self._flags, self._opt_state, self._X, self._Y)
+        return base + ((self._vx_padded, self._vy_labels) if with_eval else ())
 
     # -- evaluation ---------------------------------------------------------
 

@@ -257,3 +257,24 @@ def test_train_run_without_eval(data_dir):
     assert accs is None and run._vx is None  # val split never loaded
     assert np.allclose(losses, ref_losses, rtol=1e-6, atol=0)
     assert run.model_hash() == ref.model_hash()
+
+
+def test_warm_run_precompiles_and_matches(data_dir):
+    """warm_run AOT-compiles the fused program; the next train_run reuses the
+    executable and produces identical results to the un-warmed path."""
+    ref = _session(data_dir)
+    ref_losses, ref_accs = ref.train_run(2)
+
+    warmed = _session(data_dir)
+    warmed.warm_run(2)
+    assert (True, 2) in warmed._compiled_runs
+    losses, accs = warmed.train_run(2)
+    assert np.allclose(losses, ref_losses, rtol=1e-6, atol=0)
+    assert np.allclose(accs, ref_accs, atol=1e-6)
+    assert warmed.model_hash() == ref.model_hash()
+
+    # mesh layout too
+    m = _session(data_dir, dp=2, pp=2, schedule="gpipe")
+    m.warm_run(2)
+    m_losses, _ = m.train_run(2)
+    assert np.allclose(m_losses, ref_losses, rtol=1e-5)

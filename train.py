@@ -82,8 +82,9 @@ def main():
         help="run ALL epochs (+ per-epoch validation accuracy unless "
         "--no-eval) as one on-device program — works on every layout "
         "(sequential and DP x PP mesh). Prints the same per-epoch lines as "
-        "the loop. --profile-dir traces nothing per-epoch here, and "
-        "--checkpoint writes once at the end instead of per epoch.",
+        "the loop (without per-line clocks — everything returns in one "
+        "dispatch). --profile-dir traces that single dispatch; --checkpoint "
+        "writes once at the end instead of per epoch.",
     )
     ap.add_argument(
         "--checkpoint", default=None, help="path to save a checkpoint after each epoch"
@@ -197,7 +198,16 @@ def main():
         if not args.no_eval:
             print(f"Epoch: {run.epoch}, Accuracy: {run.accuracy() * 100:.2f}%")
         start = run.epoch
-        losses, accs = run.train_run(args.epochs, with_eval=not args.no_eval)
+        if args.profile_dir:
+            # AOT-compile first so the trace holds steady-state execution,
+            # not compilation (mirrors the loop mode's post-compile trace)
+            run.warm_run(args.epochs, with_eval=not args.no_eval)
+        with (
+            jax.profiler.trace(args.profile_dir)
+            if args.profile_dir
+            else contextlib.nullcontext()
+        ):
+            losses, accs = run.train_run(args.epochs, with_eval=not args.no_eval)
         for e, loss in enumerate(losses):
             print(f"Epoch: {start + e}, mean train loss: {loss:.5f}")
             if not args.no_eval and e < len(losses) - 1:
