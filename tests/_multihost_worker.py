@@ -17,10 +17,13 @@ they exercise the full multihost surface:
      shards the gradient and the all_gather that rebuilds the params both
      cross the process boundary;
   6. the same with interleaved virtual stages (P=2 x V=2): ring relays stay
-     on-process while the dp reduce crosses the boundary.
+     on-process while the dp reduce crosses the boundary;
+  7. the fused multi-epoch program (make_pipeline_run): two epochs in one
+     dispatch with the cross-process dp psum inside the epochs-outer scan.
 
-Prints one JSON line {"pid", "psum_ok", "loss", "loss_z", "loss_i"} on
-success; any assertion failure exits non-zero and fails the parent test.
+Prints one JSON line {"pid", "psum_ok", "loss", "loss_z", "loss_i",
+"loss_run"} on success; any assertion failure exits non-zero and fails the
+parent test.
 """
 
 import json
@@ -94,6 +97,12 @@ def main():
         sh = NamedSharding(mesh, pspec)
         return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
 
+    def init_global(spec_, order=None):
+        st, flg = E.stack_params(Mo.init_model(spec_), spec_, order=order)
+        st = jax.tree.map(lambda x: put_global(x, P("pp")), st)
+        flg = jax.tree.map(lambda x: put_global(x, P("pp")), flg)
+        return st, flg
+
     stacked = jax.tree.map(lambda x: put_global(x, P("pp")), stacked)
     fl = jax.tree.map(lambda x: put_global(x, P("pp")), fl)
 
@@ -113,9 +122,7 @@ def main():
     from shallowspeed_tpu.optimizer import MomentumSGD
 
     opt_z = MomentumSGD(0.05, 0.9)
-    st_z, fl_z = E.stack_params(Mo.init_model(spec), spec)
-    st_z = jax.tree.map(lambda a: put_global(a, P("pp")), st_z)
-    fl_z = jax.tree.map(lambda a: put_global(a, P("pp")), fl_z)
+    st_z, fl_z = init_global(spec)
     oz = E.zero1_init_state(opt_z, spec, mesh)
     step_z = E.make_pipeline_step(
         mesh, spec, prog, half // M, opt_z, zero1=True, clip_norm=1.0
@@ -130,11 +137,18 @@ def main():
     spec_i = Mo.make_model_spec(SIZES_I, 4, B)
     order = E.interleave_order(4, 2)
     prog_i = lower_schedule(S.InterleavedSchedule, M, 2, virtual=2)
-    st_i, fl_i = E.stack_params(Mo.init_model(spec_i), spec_i, order=order)
-    st_i = jax.tree.map(lambda x: put_global(x, P("pp")), st_i)
-    fl_i = jax.tree.map(lambda x: put_global(x, P("pp")), fl_i)
+    st_i, fl_i = init_global(spec_i, order=order)
     step_i = E.make_pipeline_step(mesh, spec_i, prog_i, half // M, SGD(0.05))
     _, _, loss_i = step_i(st_i, fl_i, (), xg, yg)
+
+    # --- fused multi-epoch run across the process boundary -----------------
+    # the epochs-outer scan (make_pipeline_run) compiled once, executing two
+    # epochs with the dp psum crossing processes inside a single dispatch
+    st_r, fl_r = init_global(spec)
+    run = E.make_pipeline_run(mesh, spec, prog, half // M, SGD(0.05))
+    _, _, losses_r = run(st_r, fl_r, (), xg[None], yg[None], 2)
+    losses_r = np.asarray(losses_r)
+    assert losses_r.shape == (2,) and losses_r[1] < losses_r[0]
 
     print(
         json.dumps(
@@ -144,6 +158,7 @@ def main():
                 "loss": float(loss),
                 "loss_z": float(loss_z),
                 "loss_i": float(loss_i),
+                "loss_run": float(losses_r[-1]),
             }
         )
     )

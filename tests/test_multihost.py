@@ -88,7 +88,7 @@ def test_two_process_distributed_training_step():
             break
     assert outs is not None, f"workers failed 3x:\n{errs[-1][-3000:]}"
     assert all(o["psum_ok"] for o in outs)
-    for key in ("loss", "loss_z", "loss_i"):
+    for key in ("loss", "loss_z", "loss_i", "loss_run"):
         losses = sorted((o["pid"], o[key]) for o in outs)
         assert losses[0][1] == pytest.approx(losses[1][1], rel=1e-6)
         assert np.isfinite(losses[0][1]) and losses[0][1] > 0
