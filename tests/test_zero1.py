@@ -94,16 +94,20 @@ def test_zero1_state_round_trip(opt):
     )
 
 
-def test_zero1_session_resume_matches_plain(tmp_path):
-    """TrainingSession surface: a zero1+momentum run checkpoints its sharded
-    state logically and resumes — into a PLAIN momentum session — matching
-    the uninterrupted plain run."""
+def _write_dataset(tmp_path):
     rng = np.random.RandomState(0)
     for suffix, n in (("train", 256), ("val", 64)):
         x = rng.randn(n, SIZES[0]).astype(np.float32)
         y = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], n)]
         np.save(tmp_path / f"x_{suffix}.npy", x)
         np.save(tmp_path / f"y_{suffix}.npy", y)
+
+
+def test_zero1_session_resume_matches_plain(tmp_path):
+    """TrainingSession surface: a zero1+momentum run checkpoints its sharded
+    state logically and resumes — into a PLAIN momentum session — matching
+    the uninterrupted plain run."""
+    _write_dataset(tmp_path)
     kw = dict(
         sizes=SIZES, global_batch_size=B, lr=0.01, data_dir=tmp_path,
         optimizer="momentum", dp=2, pp=2, schedule="gpipe",
@@ -124,3 +128,22 @@ def test_zero1_session_resume_matches_plain(tmp_path):
 def test_zero1_rejected_on_sequential():
     with pytest.raises(ValueError, match="zero1"):
         TrainingSession(sizes=SIZES, zero1=True, data_dir="/nonexistent")
+
+
+def test_zero1_fused_run_matches_epoch_loop(tmp_path):
+    """The fused multi-epoch program composes with ZeRO-1: train_run(2) on a
+    zero1 session equals two looped train_epoch() calls bit-for-bit."""
+    _write_dataset(tmp_path)
+    kw = dict(
+        sizes=SIZES, global_batch_size=B, lr=0.01, data_dir=tmp_path,
+        optimizer="momentum", dp=2, pp=2, schedule="gpipe", zero1=True,
+    )
+    looped = TrainingSession(**kw)
+    loop_losses = [looped.train_epoch() for _ in range(2)]
+
+    fused = TrainingSession(**kw)
+    losses, accs = fused.train_run(2)
+    assert np.allclose(losses, loop_losses, rtol=1e-6)
+    assert len(accs) == 2 and all(np.isfinite(a) and 0.0 <= a <= 1.0 for a in accs)
+    assert accs[-1] == pytest.approx(fused.accuracy(), abs=1e-6)
+    assert fused.model_hash() == looped.model_hash()
