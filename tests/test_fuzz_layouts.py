@@ -119,7 +119,11 @@ def _random_case_r2(seed):
     opt = OPTS[seed % 3]
     sched = S.InterleavedSchedule if V > 1 else SCHEDS[seed % 3]
     clip = [None, 0.05][(seed // 3) % 2]  # independent of the other bits
-    return sizes, dp, pp, V, M, B, opt, zero1, sched, clip
+    # per-step loop vs fused whole-run program: offset the parity per mesh
+    # block so every mesh (incl. the 2x2 square and 4-way dp) sees BOTH
+    # execution modes across the 12 seeds
+    fused = bool((seed + seed // 4) % 2)
+    return sizes, dp, pp, V, M, B, opt, zero1, sched, clip, fused
 
 
 @pytest.mark.parametrize("seed", range(12))
@@ -127,7 +131,7 @@ def test_random_r2_feature_combo_matches_sequential(seed):
     """Random (optimizer, zero1, virtual-stage) combinations must still equal
     sequential training with the same optimizer — the round-2 features
     compose, not just work in isolation."""
-    sizes, dp, pp, V, M, B, opt, zero1, sched, clip = _random_case_r2(seed)
+    sizes, dp, pp, V, M, B, opt, zero1, sched, clip, fused = _random_case_r2(seed)
     spec_pp = Mo.make_model_spec(sizes, pp * V, B)
     assert spec_pp.stages[-1].n_linears > 0  # generator guarantees parity regime
 
@@ -153,17 +157,27 @@ def test_random_r2_feature_combo_matches_sequential(seed):
     prog = lower_schedule(sched, M, pp, virtual=V)
     stacked, flags = E.init_stacked(spec_pp, mesh, order=order)
     ost = E.zero1_init_state(opt, spec_pp, mesh) if zero1 else opt.init(stacked)
-    step = E.make_pipeline_step(
-        mesh, spec_pp, prog, B // dp // M, opt, zero1=zero1, clip_norm=clip
-    )
-    for i in range(2):
-        stacked, ost, _ = step(stacked, flags, ost, jnp.asarray(X[i]), jnp.asarray(Y[i]))
+    if fused:
+        # same two batches as one epoch inside the fused whole-run program
+        run = E.make_pipeline_run(
+            mesh, spec_pp, prog, B // dp // M, opt, zero1=zero1, clip_norm=clip
+        )
+        stacked, ost, _ = run(stacked, flags, ost, jnp.asarray(X), jnp.asarray(Y), 1)
+    else:
+        step = E.make_pipeline_step(
+            mesh, spec_pp, prog, B // dp // M, opt, zero1=zero1, clip_norm=clip
+        )
+        for i in range(2):
+            stacked, ost, _ = step(
+                stacked, flags, ost, jnp.asarray(X[i]), jnp.asarray(Y[i])
+            )
     got = [l for s in E.unstack_params(stacked, spec_pp, order=order) for l in s]
     assert len(want) == len(got)
 
     label = (
         f"sizes={sizes} dp={dp} pp={pp} V={V} M={M} B={B} "
-        f"{type(opt).__name__} zero1={zero1} clip={clip} {sched.__name__}"
+        f"{type(opt).__name__} zero1={zero1} clip={clip} fused={fused} "
+        f"{sched.__name__}"
     )
     # Adam's early update direction is ~g/|g| per element: near-zero second
     # moments amplify ulp-level cross-layout reassociation of g, so its
