@@ -1,7 +1,15 @@
 """Benchmark: MNIST-MLP training samples/sec/chip vs the NumPy reference.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "samples/s", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "samples/s", "vs_baseline": N,
+     "config": <headline-config label>,
+     "value_fp32_highest": N|null, "vs_baseline_fp32_highest": N|null}
+
+The headline ``value`` is the fused+DEFAULT-precision config
+(convergence-verified against the fp32 recipe — see main()); the
+``*_fp32_highest`` companions carry the bitwise-NumPy-parity fp32 HIGHEST
+measurement from the same process (null if only the headline cell survived
+a mid-run tunnel failure).
 
 Protocol (BASELINE.md: the reference publishes no numbers, so the baseline is
 measured here): train the flagship 7-layer MLP (sizes [784,128,...,10],
@@ -129,31 +137,49 @@ def slope_epoch_seconds(run_k, k1=2, k2=8, trials=3):
     instead would be biased fast whenever a trial's k1 leg was contended
     while its k2 leg was not.)
     """
-    t_smalls, t_larges = [], []
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        run_k(k1)
-        t_smalls.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        run_k(k2)
-        t_larges.append(time.perf_counter() - t0)
-    slope = (min(t_larges) - min(t_smalls)) / (k2 - k1)
-    if slope <= 0:
-        raise RuntimeError(
-            "slope timing failed: k2 epochs never measurably slower than k1 "
-            "(device not actually executing the work?)"
-        )
-    return slope
+    return slope_epoch_seconds_many({"_": run_k}, k1=k1, k2=k2, trials=trials)["_"]
 
 
-def measured_epoch_sps(epoch_fn, params, opt_state, X, Y, trials=3):
-    """Honest samples/sec for a compiled-or-compilable whole-epoch function.
+def slope_epoch_seconds_many(run_ks, k1=2, k2=8, trials=3):
+    """Interleaved two-point slopes for several configs at once.
 
-    Shared timing-protocol entry point (bench.py, scripts/bench_tpu_matrix.py
-    and scripts/tpu_capture.py all measure through here so the protocol is
-    defined once). ``epoch_fn(params, opt_state, X, Y) -> (params, opt_state,
-    loss)`` with donated params/opt_state; X is (num_batches, M, mb, D).
+    ``run_ks`` is ``{name: run_k}``. Each trial times the k1 and k2 legs of
+    EVERY config back-to-back before the next trial, so all configs sample
+    the same contention windows — measuring configs sequentially (minutes
+    apart) lets pool contention invert a comparison (observed: the
+    default-precision cell measuring 0.6x the fp32 cell it beats 1.8-3.8x
+    in same-window pairs). Per-config estimation is then identical to
+    slope_epoch_seconds (per-leg minima before differencing).
     """
+    t_smalls = {name: [] for name in run_ks}
+    t_larges = {name: [] for name in run_ks}
+    for _ in range(trials):
+        for name, run_k in run_ks.items():
+            t0 = time.perf_counter()
+            run_k(k1)
+            t_smalls[name].append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            run_k(k2)
+            t_larges[name].append(time.perf_counter() - t0)
+    out = {}
+    for name in run_ks:
+        slope = (min(t_larges[name]) - min(t_smalls[name])) / (k2 - k1)
+        if slope <= 0:
+            raise RuntimeError(
+                "slope timing failed: k2 epochs never measurably slower than "
+                f"k1 for {name!r} (device not actually executing the work?)"
+            )
+        out[name] = slope
+    return out
+
+
+def make_run_k(epoch_fn, params, opt_state, X, Y):
+    """Build the timing harness for one epoch function: a ``run_k(k)`` that
+    dispatches k epochs (advancing captured state, so donation stays legal)
+    and ends in a forced readback. Compiles + warms up (one synced epoch)
+    before returning — THE single definition of the measurement discipline,
+    used by every path (measured_epoch_sps, jax_sps_many, the capture
+    scripts)."""
     state = {"p": params, "s": opt_state}
 
     def run_k(k):
@@ -164,6 +190,18 @@ def measured_epoch_sps(epoch_fn, params, opt_state, X, Y, trials=3):
         sync_readback(p)
 
     run_k(1)  # compile + warmup, synced
+    return run_k
+
+
+def measured_epoch_sps(epoch_fn, params, opt_state, X, Y, trials=3):
+    """Honest samples/sec for a compiled-or-compilable whole-epoch function.
+
+    Shared timing-protocol entry point (bench.py, scripts/bench_tpu_matrix.py
+    and scripts/tpu_capture.py all measure through here so the protocol is
+    defined once). ``epoch_fn(params, opt_state, X, Y) -> (params, opt_state,
+    loss)`` with donated params/opt_state; X is (num_batches, M, mb, D).
+    """
+    run_k = make_run_k(epoch_fn, params, opt_state, X, Y)
     samples_per_epoch = X.shape[0] * X.shape[1] * X.shape[2]
     return samples_per_epoch / slope_epoch_seconds(run_k, trials=trials)
 
@@ -213,12 +251,15 @@ def numpy_baseline_sps(n_batches=40):
     return n_batches * B / dt
 
 
-def jax_sps():
+def _jax_epoch_setup(precision, unroll=None):
+    """Build the headline measurement setup (fused sequential epoch) at the
+    named matmul precision: returns ``(epoch_fn, params, X, Y)``."""
     import jax
     import jax.numpy as jnp
 
     from shallowspeed_tpu import model as Mo
     from shallowspeed_tpu import trainer
+    from shallowspeed_tpu.api import PRECISIONS
     from shallowspeed_tpu.optimizer import SGD
 
     spec = Mo.make_model_spec(SIZES, 1, B)
@@ -228,9 +269,11 @@ def jax_sps():
     # path. unroll: batch-scan unroll factor (bit-identical numerics); the
     # default can be overridden with the value scripts/tpu_capture.py measures
     # best on the chip.
-    unroll = int(os.environ.get("SHALLOWSPEED_BENCH_UNROLL", "1"))
+    if unroll is None:
+        unroll = int(os.environ.get("SHALLOWSPEED_BENCH_UNROLL", "1"))
     epoch = trainer.make_train_epoch(
-        spec, SGD(LR), fuse_mubatches=True, unroll=unroll
+        spec, SGD(LR), precision=PRECISIONS[precision], fuse_mubatches=True,
+        unroll=unroll,
     )
 
     nb = N_SAMPLES // B
@@ -239,25 +282,195 @@ def jax_sps():
     Y = jnp.asarray(
         np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, M, B // M))]
     )
+    return epoch, params, X, Y
 
-    return measured_epoch_sps(epoch, params, (), X, Y, trials=5)
+
+def jax_sps(precision="highest", trials=5, unroll=None):
+    """Measure the headline config at one matmul precision. The single
+    definition of the measurement setup — the convergence-experiment script
+    (scripts/tpu_default_precision.py) calls this too, so its same-window
+    throughput pairs use the exact code path the published headline does."""
+    return jax_sps_many((precision,), trials=trials, unroll=unroll)[precision]
+
+
+def jax_sps_many(precisions, trials=5, unroll=None):
+    """Measure several precision configs with INTERLEAVED trials (see
+    slope_epoch_seconds_many: sequential cells minutes apart let pool
+    contention invert a comparison). Returns ``{precision: samples/s}``."""
+    run_ks = {}
+    samples_per_epoch = None
+    for precision in precisions:
+        epoch, params, X, Y = _jax_epoch_setup(precision, unroll=unroll)
+        run_ks[precision] = make_run_k(epoch, params, (), X, Y)
+        samples_per_epoch = X.shape[0] * X.shape[1] * X.shape[2]
+    slopes = slope_epoch_seconds_many(run_ks, trials=trials)
+    return {p: samples_per_epoch / s for p, s in slopes.items()}
+
+
+# Per-config physical plausibility ceiling for the timing guard: a v5e-class
+# chip peaks ~100 TFLOP/s for fp32-accumulate-with-fp32-inputs (HIGHEST) and
+# ~200 TFLOP/s for bf16-input MXU passes (DEFAULT). Anything above means the
+# timing protocol was defeated (e.g. block_until_ready returning early) and
+# the metric must be tagged, not published as-is.
+_PLAUSIBLE_TFLOPS = {"highest": 100e12, "default": 200e12}
+
+
+def _measure_child(precisions):
+    """Child mode: measure the precisions with interleaved trials (so the
+    published pair shares contention windows), printing one flushed JSON
+    line per result so a parent that must kill a wedged child can still
+    salvage output. If the interleaved pass fails (e.g. slope refusal in
+    one cell aborts it), fall back to independent per-cell measurement so
+    one cell's deterministic failure cannot take the others down."""
+    try:
+        for precision, sps in jax_sps_many(precisions).items():
+            print(json.dumps({"precision": precision, "sps": sps}), flush=True)
+        sys.exit(0)
+    except Exception as e:  # noqa: BLE001 — isolate cells below
+        print(
+            f"bench child: interleaved pass failed ({e!r}); "
+            "re-measuring cells independently",
+            file=sys.stderr,
+        )
+    ok = True
+    for precision in precisions:
+        try:
+            sps = jax_sps(precision)
+        except Exception as e:  # noqa: BLE001 — report, continue, flag
+            print(
+                json.dumps({"precision": precision, "error": repr(e)}), flush=True
+            )
+            ok = False
+            continue
+        print(json.dumps({"precision": precision, "sps": sps}), flush=True)
+    sys.exit(0 if ok else 4)
+
+
+def _run_measurements(precisions, timeout_s, attempts=2, force_cpu=False):
+    """Run the JAX measurements in a watchdog subprocess.
+
+    The tunnel has been observed to wedge MID-RUN (after a healthy probe) —
+    an in-process measurement would then hang the benchmark forever and the
+    driver would record nothing. Isolating it in a killable child with
+    per-result flushed output bounds the damage to ``attempts * timeout_s``
+    and keeps any results completed before the wedge. Returns
+    ``{precision: sps}`` for whatever succeeded.
+
+    stdout/stderr go to FILES, never pipes (same grandchild-survives-kill
+    hazard as in _ensure_responsive_backend).
+    """
+    import tempfile
+
+    env = dict(os.environ)
+    if force_cpu:
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # ungate the tunnel plugin
+        env["JAX_PLATFORMS"] = "cpu"
+    results, errors = {}, {}
+    saw_timeout = False
+    for _ in range(attempts):
+        missing = [p for p in precisions if p not in results]
+        if not missing:
+            break
+        with tempfile.TemporaryFile() as outf, tempfile.TemporaryFile() as errf:
+            try:
+                subprocess.run(
+                    [sys.executable, __file__, "--_measure", ",".join(missing)],
+                    timeout=timeout_s,
+                    stdout=outf,
+                    stderr=errf,
+                    env=env,
+                )
+            except subprocess.TimeoutExpired:
+                saw_timeout = True
+                print(
+                    f"bench: measurement subprocess exceeded {timeout_s}s "
+                    "(tunnel wedged mid-run?); salvaging completed results",
+                    file=sys.stderr,
+                )
+            outf.seek(0)
+            for line in outf.read().decode(errors="replace").splitlines():
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # non-JSON noise (e.g. plugin warnings)
+                if not isinstance(rec, dict):
+                    continue  # JSON-shaped noise (bare numbers/strings)
+                if "sps" in rec:
+                    results[rec["precision"]] = rec["sps"]
+                    errors.pop(rec["precision"], None)
+                elif "error" in rec:
+                    errors[rec["precision"]] = rec["error"]
+            if any(p not in results for p in precisions):
+                errf.seek(0)
+                tail = errf.read().decode(errors="replace").strip().splitlines()
+                if tail:
+                    print(f"bench: child stderr: {tail[-1]}", file=sys.stderr)
+    for precision, err in errors.items():
+        print(f"bench: {precision} measurement raised: {err}", file=sys.stderr)
+    return results, saw_timeout, errors
 
 
 def main():
     fallback_tag = _ensure_responsive_backend()
     baseline = numpy_baseline_sps()
-    value = jax_sps()
+    # Headline config: fused microbatches + DEFAULT matmul precision
+    # (bf16-input, fp32-accumulate MXU passes). Convergence-equivalence of
+    # this config to the fp32-HIGHEST reference recipe is chip-verified:
+    # 20-epoch flagship run reaches 99.40% val accuracy / 0.0168 final loss,
+    # epoch-for-epoch matching the HIGHEST trajectory (99.39% / 0.0168) —
+    # TPU_DEFAULT_PRECISION_r02.json, scripts/tpu_default_precision.py.
+    # The fp32-HIGHEST number (the bitwise-NumPy-parity config) is also
+    # measured and reported alongside.
+    precisions = ("default", "highest")
+    results, saw_timeout, errors = _run_measurements(precisions, timeout_s=900)
+    if "default" not in results and not fallback_tag:
+        # the headline cell failed on the accelerator on every attempt: a
+        # degraded CPU number with an unmistakable tag beats recording
+        # nothing — and the tag says WHICH failure mode it was. A recorded
+        # in-measurement error for the headline cell (e.g. the slope
+        # protocol refusing untrustworthy timing) is the definitive cause
+        # and wins over a timeout seen on some other attempt.
+        fallback_tag = (
+            "_CPU_FALLBACK_TUNNEL_WEDGED_MIDRUN"
+            if saw_timeout and "default" not in errors
+            else "_CPU_FALLBACK_MEASUREMENT_FAILED"
+        )
+        print(
+            f"bench: falling back to CPU for missing cells ({fallback_tag})",
+            file=sys.stderr,
+        )
+        missing = tuple(p for p in precisions if p not in results)
+        cpu_results, _, _ = _run_measurements(
+            missing, timeout_s=900, attempts=1, force_cpu=True
+        )
+        results.update(cpu_results)
+    value = results.get("default")
+    value_fp32 = results.get("highest")
+    if value is None:
+        print("bench: no measurement succeeded on any backend", file=sys.stderr)
+        sys.exit(1)
     # a degraded run is unmistakable in the recorded metric itself
     metric = "mnist_mlp_train_samples_per_sec_per_chip" + fallback_tag
     # physical plausibility guard: if the implied FLOP rate exceeds anything a
     # single chip can do, the timing protocol was defeated — label, don't lie
-    if value * flops_per_sample() > 100e12:
+    implausible = []
+    if value * flops_per_sample() > _PLAUSIBLE_TFLOPS["default"]:
+        implausible.append(("default", value))
+    if (
+        value_fp32 is not None
+        and value_fp32 * flops_per_sample() > _PLAUSIBLE_TFLOPS["highest"]
+    ):
+        implausible.append(("highest", value_fp32))
+    if implausible:
         metric += "_SUSPECT_TIMING"
-        print(
-            f"bench: implied {value * flops_per_sample() / 1e12:.0f} TFLOP/s "
-            "exceeds single-chip fp32 plausibility; tagging metric",
-            file=sys.stderr,
-        )
+        for precision, v in implausible:
+            print(
+                f"bench: {precision} cell implies "
+                f"{v * flops_per_sample() / 1e12:.0f} TFLOP/s, above its "
+                f"{_PLAUSIBLE_TFLOPS[precision] / 1e12:.0f} TFLOP/s "
+                "single-chip ceiling; tagging metric",
+                file=sys.stderr,
+            )
     print(
         json.dumps(
             {
@@ -265,10 +478,21 @@ def main():
                 "value": round(value, 1),
                 "unit": "samples/s",
                 "vs_baseline": round(value / baseline, 2),
+                "config": "fused+default_precision (bf16-input MXU, fp32 accum; "
+                "convergence-verified vs fp32 recipe)",
+                "value_fp32_highest": (
+                    None if value_fp32 is None else round(value_fp32, 1)
+                ),
+                "vs_baseline_fp32_highest": (
+                    None if value_fp32 is None else round(value_fp32 / baseline, 2)
+                ),
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--_measure":
+        _measure_child(sys.argv[2].split(","))
+    else:
+        main()

@@ -1,0 +1,117 @@
+"""Chip experiment: does DEFAULT-precision training reach reference accuracy?
+
+The round-2 tuning matrix (TPU_CAPTURE_r02.json) measured the fused
+sequential epoch at ~3.8x higher throughput with matmul
+``precision=DEFAULT`` (bf16-input, fp32-accumulate on the MXU) than with
+``HIGHEST`` in the same contention window. HIGHEST is the framework default
+because the NumPy-trajectory parity tests require it — but the north-star
+criterion (BASELINE.json) is "reaches NumPy-reference loss", a convergence
+property, not bitwise parity. This script settles whether the fast config
+is *convergence-equivalent*: 20-epoch flagship run at precision=default
+(fused mubatches), per-epoch validation accuracy, final loss, plus a
+throughput point for the same config, all in one chip claim.
+
+Writes TPU_DEFAULT_PRECISION_r02.json at the repo root.
+Run:  python scripts/tpu_default_precision.py [--epochs 20]
+"""
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+import bench
+
+
+def convergence(data_dir, epochs, precision):
+    from shallowspeed_tpu.api import TrainingSession
+
+    run = TrainingSession(
+        data_dir=data_dir, precision=precision, fuse_mubatches=True
+    )
+    accs, losses = [], []
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        losses.append(run.train_epoch())
+        accs.append(round(run.accuracy(), 4))
+    wall = time.perf_counter() - t0
+    return {
+        "precision": precision,
+        "epochs": epochs,
+        "wall_s_incl_eval": round(wall, 3),
+        "per_epoch_val_accuracy": accs,
+        "final_val_accuracy": accs[-1],
+        "first_loss": round(losses[0], 4),
+        "final_loss": round(losses[-1], 4),
+        "model_hash": run.model_hash(),
+    }
+
+
+def throughput_pair():
+    # the exact code path AND config the published headline uses
+    # (bench.jax_sps_many defaults: trials=5, unroll from
+    # SHALLOWSPEED_BENCH_UNROLL), with the two cells' trials INTERLEAVED so
+    # the recorded default/highest ratio really is same-window
+    return bench.jax_sps_many(("default", "highest"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default="/tmp/ssd_data")
+    ap.add_argument("--epochs", type=int, default=20, choices=range(1, 1001),
+                    metavar="1..1000")
+    ap.add_argument("--out", default=str(ROOT / "TPU_DEFAULT_PRECISION_r02.json"))
+    args = ap.parse_args()
+
+    tag = bench._ensure_responsive_backend()
+    if tag:
+        print(f"tunnel not healthy ({tag}); aborting", file=sys.stderr)
+        sys.exit(3)
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} ({dev.platform})", flush=True)
+
+    if not Path(args.data_dir).is_dir():
+        import subprocess
+
+        subprocess.run(
+            [sys.executable, str(ROOT / "prepare_data.py"), "--save-dir", args.data_dir],
+            check=True,
+        )
+
+    out = {
+        "info": {
+            "platform": dev.platform,
+            "device": str(dev),
+            "captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+        }
+    }
+
+    print("throughput pair (interleaved trials, same-window)...", flush=True)
+    pair = throughput_pair()
+    sps_d, sps_h = pair["default"], pair["highest"]
+    print(f"  fused+default+xla: {sps_d:,.0f} samples/s", flush=True)
+    print(f"  fused+highest+xla: {sps_h:,.0f} samples/s", flush=True)
+    out["throughput"] = {
+        "fused+default+xla": round(sps_d, 1),
+        "fused+highest+xla": round(sps_h, 1),
+        "default_over_highest": round(sps_d / sps_h, 2),
+    }
+
+    print(f"convergence at precision=default ({args.epochs} epochs)...", flush=True)
+    conv_d = convergence(args.data_dir, args.epochs, "default")
+    print(f"  {conv_d}", flush=True)
+    out["convergence_default"] = conv_d
+
+    Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps({k: out[k] for k in ("throughput",)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,7 +44,9 @@ FLAGSHIP_BATCH = 128
 FLAGSHIP_MUBATCHES = 4
 FLAGSHIP_LR = 0.006
 
-_PRECISIONS = {
+# Matmul-precision names accepted everywhere a precision string is taken
+# (TrainingSession, train.py --precision, bench.py) — single source of truth.
+PRECISIONS = {
     "highest": lax.Precision.HIGHEST,
     "default": lax.Precision.DEFAULT,
 }
@@ -84,15 +86,15 @@ class TrainingSession:
         self.dp, self.pp = dp, pp
         self.B, self.M = global_batch_size, mubatches
         self.schedule = schedule
-        if precision not in _PRECISIONS:
+        if precision not in PRECISIONS:
             raise ValueError(
-                f"precision must be one of {sorted(_PRECISIONS)}, got {precision!r}"
+                f"precision must be one of {sorted(PRECISIONS)}, got {precision!r}"
             )
         if schedule not in S.SCHEDULES:
             raise ValueError(
                 f"schedule must be one of {sorted(S.SCHEDULES)}, got {schedule!r}"
             )
-        self.precision = _PRECISIONS[precision]
+        self.precision = PRECISIONS[precision]
         if fuse_mubatches and not (dp == 1 and pp == 1 and virtual_stages == 1):
             raise ValueError(
                 "fuse_mubatches applies to the sequential path only; in the "

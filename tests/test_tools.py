@@ -94,3 +94,49 @@ def test_measured_epoch_sps_protocol(monkeypatch):
     X = np.zeros((4, 2, 8, 3), np.float32)  # 4 batches x 2 mubatches x 8 rows
     sps = bench.measured_epoch_sps(epoch_fn, {"w": np.zeros(2)}, (), X, None)
     assert abs(sps - (4 * 2 * 8) / 0.02) < 1e-6
+
+
+def test_bench_watchdog_salvage_and_error_protocol(monkeypatch, tmp_path):
+    """_run_measurements must salvage per-cell results from a child that
+    fails one cell, and report the failed cell's error instead of silently
+    misdiagnosing it as a tunnel wedge (the fallback tag depends on it)."""
+    bench = _import_bench()
+
+    # a stand-in "bench.py" child that succeeds for 'default', errors for
+    # 'highest', using the real per-line flushed protocol
+    child = tmp_path / "fake_bench.py"
+    child.write_text(
+        "import json, sys\n"
+        "for p in sys.argv[2].split(','):\n"
+        "    if p == 'default':\n"
+        "        print(json.dumps({'precision': p, 'sps': 123.0}), flush=True)\n"
+        "    else:\n"
+        "        print(json.dumps({'precision': p, 'error': 'boom'}), flush=True)\n"
+        "sys.exit(4)\n"
+    )
+    monkeypatch.setattr(bench, "__file__", str(child))
+    results, saw_timeout, errors = bench._run_measurements(
+        ("default", "highest"), timeout_s=30, attempts=2
+    )
+    assert results == {"default": 123.0}
+    assert not saw_timeout  # a crash is NOT a wedge
+    assert "boom" in errors.get("highest", "")
+
+
+def test_bench_watchdog_timeout_is_flagged(monkeypatch, tmp_path):
+    """A child that hangs must be killed at the timeout and reported as a
+    wedge (saw_timeout=True), with any flushed results still salvaged."""
+    bench = _import_bench()
+
+    child = tmp_path / "hang_bench.py"
+    child.write_text(
+        "import json, sys, time\n"
+        "print(json.dumps({'precision': 'default', 'sps': 7.0}), flush=True)\n"
+        "time.sleep(60)\n"
+    )
+    monkeypatch.setattr(bench, "__file__", str(child))
+    results, saw_timeout, errors = bench._run_measurements(
+        ("default", "highest"), timeout_s=3, attempts=1
+    )
+    assert results == {"default": 7.0}  # flushed before the hang — salvaged
+    assert saw_timeout
