@@ -120,7 +120,25 @@ def sync_readback(tree):
     np.asarray(min(leaves, key=lambda a: a.nbytes))
 
 
-def slope_epoch_seconds(run_k, k1=2, k2=8, trials=3):
+_probe_jit = None
+
+
+def probe_constants(tree):
+    """Measure-able dispatch+readback: run a trivial jitted computation on
+    the smallest leaf and read the FRESH result back. Re-reading an
+    already-materialized array is free (jax.Array caches its host copy), so
+    a zero-epoch "leg" must dispatch something new or it measures nothing.
+    """
+    global _probe_jit
+    import jax
+
+    if _probe_jit is None:
+        _probe_jit = jax.jit(lambda x: x + 0.0)
+    leaves = jax.tree.leaves(tree)
+    np.asarray(_probe_jit(min(leaves, key=lambda a: a.nbytes)))
+
+
+def slope_epoch_seconds(run_k, k1=2, k2=8, trials=3, min_delta_s=0.25):
     """Honest seconds-per-epoch via a two-point slope.
 
     ``run_k(k)`` must dispatch k epochs (advancing its own state) and end
@@ -137,39 +155,114 @@ def slope_epoch_seconds(run_k, k1=2, k2=8, trials=3):
     instead would be biased fast whenever a trial's k1 leg was contended
     while its k2 leg was not.)
     """
-    return slope_epoch_seconds_many({"_": run_k}, k1=k1, k2=k2, trials=trials)["_"]
+    return slope_epoch_seconds_many(
+        {"_": run_k}, k1=k1, k2=k2, trials=trials, min_delta_s=min_delta_s
+    )["_"]
 
 
-def slope_epoch_seconds_many(run_ks, k1=2, k2=8, trials=3):
+def slope_epoch_seconds_many(
+    run_ks, k1=2, k2=8, trials=3, min_delta_s=0.25, k_max=4096
+):
     """Interleaved two-point slopes for several configs at once.
 
-    ``run_ks`` is ``{name: run_k}``. Each trial times the k1 and k2 legs of
-    EVERY config back-to-back before the next trial, so all configs sample
-    the same contention windows — measuring configs sequentially (minutes
-    apart) lets pool contention invert a comparison (observed: the
-    default-precision cell measuring 0.6x the fp32 cell it beats 1.8-3.8x
-    in same-window pairs). Per-config estimation is then identical to
+    ``run_ks`` is ``{name: run_k}``. Each trial times the small and large
+    legs of EVERY config back-to-back before the next trial, so all configs
+    sample the same contention windows — measuring configs sequentially
+    (minutes apart) lets pool contention invert a comparison (observed: the
+    default-precision cell measuring 0.6x the fp32 cell it beats 1.8x in
+    same-window pairs). Per-config estimation is then identical to
     slope_epoch_seconds (per-leg minima before differencing).
+
+    ``min_delta_s`` > 0 enables LEG-SIZE ADAPTATION, which is what makes
+    the estimate trustworthy on a high-RTT tunnel: dispatched epochs
+    overlap the readback round-trip, so if a whole leg's device time fits
+    inside the transport constants the k2-vs-k1 wall delta is pure noise
+    and the slope explodes (observed: matrix cells "measuring" 1.65e9
+    samples/s ~= 1.8 PFLOP/s when 8 epochs fit inside one ~80 ms RTT).
+    Per config: measure the zero-epoch wall c0 (pure dispatch+readback
+    constants), grow k1 until a k1-leg's device time is resolvable ABOVE
+    those constants (wall - c0 >= min_delta_s — an unhidden small leg is
+    what makes the constants actually cancel in the subtraction), and use
+    k2 = 4*k1. If a cleaner later window shrinks the resolved delta back
+    under min_delta_s, re-adapt (bounded) rather than publish an
+    under-resolved slope.
     """
-    t_smalls = {name: [] for name in run_ks}
-    t_larges = {name: [] for name in run_ks}
+    names = list(run_ks)
+
+    def leg(name, k):
+        t0 = time.perf_counter()
+        run_ks[name](k)
+        return time.perf_counter() - t0
+
+    k1s = {n: k1 for n in names}
+    k2s = {n: k2 for n in names}
+    t_smalls = {n: [] for n in names}
+    t_larges = {n: [] for n in names}
+
+    def adapt(name, k_start):
+        """Grow the small leg until its device time clears the constants.
+        Adaptation probes are sequential per config and are NOT recorded as
+        trial data — only the interleaved trials below are, preserving the
+        same-window property of every recorded sample."""
+        c0 = min(leg(name, 0), leg(name, 0))
+        k = min(max(2, k_start), k_max // 4)
+        while True:
+            t = leg(name, k)
+            excess = t - c0
+            if excess >= min_delta_s or k >= k_max // 4:
+                break
+            grow = (min_delta_s * 1.5) / excess if excess > 0 else 2.0
+            k = min(k_max // 4, max(k * 2, int(k * grow) + 1))
+        k1s[name], k2s[name] = k, 4 * k
+
+    if min_delta_s > 0:
+        for n in names:
+            adapt(n, k1)
     for _ in range(trials):
-        for name, run_k in run_ks.items():
-            t0 = time.perf_counter()
-            run_k(k1)
-            t_smalls[name].append(time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            run_k(k2)
-            t_larges[name].append(time.perf_counter() - t0)
+        for n in names:
+            t_smalls[n].append(leg(n, k1s[n]))
+            t_larges[n].append(leg(n, k2s[n]))
+
+    if min_delta_s > 0:
+        # resolution recheck: if the least-contended legs resolve to less
+        # than min_delta_s (the probe ran in a contended window, so the
+        # chosen legs are too short for a clean window), re-adapt — an
+        # under-resolved delta inflates throughput, never deflates it
+        for _ in range(2):
+            unresolved = [
+                n
+                for n in names
+                if min(t_larges[n]) - min(t_smalls[n]) < min_delta_s
+                and k2s[n] < k_max
+            ]
+            if not unresolved:
+                break
+            for n in unresolved:
+                t_smalls[n].clear()
+                t_larges[n].clear()
+                adapt(n, k1s[n] * 2)
+            for _ in range(trials):
+                for n in unresolved:
+                    t_smalls[n].append(leg(n, k1s[n]))
+                    t_larges[n].append(leg(n, k2s[n]))
+
     out = {}
-    for name in run_ks:
-        slope = (min(t_larges[name]) - min(t_smalls[name])) / (k2 - k1)
-        if slope <= 0:
+    for name in names:
+        delta = min(t_larges[name]) - min(t_smalls[name])
+        if delta <= 0:
             raise RuntimeError(
-                "slope timing failed: k2 epochs never measurably slower than "
-                f"k1 for {name!r} (device not actually executing the work?)"
+                "slope timing failed: the large leg never measurably slower "
+                f"than the small leg for {name!r} (device not actually "
+                "executing the work?)"
             )
-        out[name] = slope
+        if min_delta_s > 0 and delta < min_delta_s:
+            raise RuntimeError(
+                f"slope timing failed: could not resolve {name!r} above "
+                f"transport constants even at {k2s[name]} epochs/leg "
+                "(extreme contention variance?) — refusing to publish an "
+                "under-resolved (inflated) throughput"
+            )
+        out[name] = delta / (k2s[name] - k1s[name])
     return out
 
 
@@ -184,12 +277,19 @@ def make_run_k(epoch_fn, params, opt_state, X, Y):
 
     def run_k(k):
         p, s = state["p"], state["s"]
+        if k == 0:
+            # zero-epoch leg: measure the dispatch+readback constants with a
+            # FRESH trivial computation — re-reading the already-materialized
+            # params is served from the host cache and measures nothing
+            probe_constants(p)
+            return
         for _ in range(k):
             p, s, _ = epoch_fn(p, s, X, Y)
         state["p"], state["s"] = p, s
         sync_readback(p)
 
     run_k(1)  # compile + warmup, synced
+    run_k(0)  # compile the constants probe too, outside any timed leg
     return run_k
 
 

@@ -9,6 +9,12 @@ Reference cell: scanned microbatches + HIGHEST precision + XLA kernels (the
 NumPy-parity configuration). Runs anywhere (CPU included) — on CPU it mostly
 measures XLA CPU codegen, which is still useful for regression tracking.
 
+All cells share one dataset upload and their slope-timing trials are
+INTERLEAVED (bench.slope_epoch_seconds_many): the chip pool shows transient
+multi-tenant contention, and cells measured minutes apart can have their
+ratios inverted by a contention window — interleaving makes every in-matrix
+ratio a same-window comparison.
+
     python scripts/bench_tpu_matrix.py --batches 116 --trials 3
 """
 
@@ -30,37 +36,81 @@ from shallowspeed_tpu.api import (  # the reference's canonical config
 )
 
 
-def measure(fused, precision_name, pallas, nb, trials):
+# The full matrix: every (fused, precision, pallas) combination. The single
+# cell enumeration shared by this CLI and scripts/tpu_capture.py.
+ALL_CELLS = [
+    (fused, prec, pallas)
+    for fused, prec, pallas in itertools.product(
+        (False, True), ("highest", "default"), (False, True)
+    )
+]
+
+
+def matrix_data(nb):
+    """The shared (X, Y) epoch arrays every cell measures on."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.rand(nb, M, B // M, SIZES[0]).astype(np.float32))
+    Y = jnp.asarray(
+        np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, M, B // M))]
+    )
+    return X, Y
+
+
+def build_cell(fused, precision_name, pallas, X, Y):
+    """Build + warm one cell's timing harness (bench.make_run_k). The pallas
+    flag is a trace-time global: it must be set while the warmup call traces
+    the program, after which the compiled executable keeps its kernels."""
     import jax
     import jax.numpy as jnp
-    from jax import lax
 
+    import bench
     from shallowspeed_tpu import model as Mo
     from shallowspeed_tpu import ops, trainer
+    from shallowspeed_tpu.api import PRECISIONS
     from shallowspeed_tpu.optimizer import SGD
 
     ops.set_pallas(pallas)
     try:
-        precision = (
-            lax.Precision.HIGHEST if precision_name == "highest" else lax.Precision.DEFAULT
-        )
         spec = Mo.make_model_spec(SIZES, 1, B)
         params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
         epoch = trainer.make_train_epoch(
-            spec, SGD(LR), precision=precision, fuse_mubatches=fused
+            spec, SGD(LR), precision=PRECISIONS[precision_name], fuse_mubatches=fused
         )
-        rng = np.random.RandomState(0)
-        X = jnp.asarray(rng.rand(nb, M, B // M, SIZES[0]).astype(np.float32))
-        Y = jnp.asarray(
-            np.eye(SIZES[-1], dtype=np.float32)[
-                rng.randint(0, SIZES[-1], (nb, M, B // M))
-            ]
-        )
-        import bench
-
-        return bench.measured_epoch_sps(epoch, params, (), X, Y, trials=trials)
+        return bench.make_run_k(epoch, params, (), X, Y)
     finally:
         ops.set_pallas(False)
+
+
+def run_matrix(cells, nb, trials):
+    """Measure the given (fused, precision, pallas) cells with interleaved
+    trials on shared data. Returns {cell_tuple: samples_per_sec}."""
+    import bench
+
+    X, Y = matrix_data(nb)
+    run_ks = {}
+    for fused, prec, pallas in cells:
+        key = (
+            "fused" if fused else "scanned",
+            prec,
+            "pallas" if pallas else "xla",
+        )
+        run_ks[key] = build_cell(fused, prec, pallas, X, Y)
+        print(f"  built {'+'.join(key)}", file=sys.stderr, flush=True)
+    slopes = bench.slope_epoch_seconds_many(run_ks, trials=trials)
+    samples_per_epoch = nb * B
+    return {key: samples_per_epoch / s for key, s in slopes.items()}
+
+
+def measure(fused, precision_name, pallas, nb, trials):
+    """Single-cell measurement (non-interleaved) — kept for one-off
+    regression checks; the matrix path goes through run_matrix."""
+    import bench
+
+    X, Y = matrix_data(nb)
+    run_k = build_cell(fused, precision_name, pallas, X, Y)
+    return nb * B / bench.slope_epoch_seconds(run_k, trials=trials)
 
 
 def main():
@@ -71,25 +121,17 @@ def main():
         type=int,
         default=3,
         help="slope-timing trials per cell; each trial times 2+8 epochs "
-        "(see bench.slope_epoch_seconds)",
+        "per cell, interleaved across cells (bench.slope_epoch_seconds_many)",
     )
     ap.add_argument("--skip-pallas", action="store_true")
     args = ap.parse_args()
 
+    cells = [
+        c for c in ALL_CELLS if not (c[2] and args.skip_pallas)
+    ]
+    results = run_matrix(cells, args.batches, args.trials)
     ref_key = ("scanned", "highest", "xla")
-    results = {}
-    for fused, prec, pallas in itertools.product(
-        (False, True), ("highest", "default"), (False, True)
-    ):
-        if pallas and args.skip_pallas:
-            continue
-        key = (
-            "fused" if fused else "scanned",
-            prec,
-            "pallas" if pallas else "xla",
-        )
-        sps = measure(fused, prec, pallas, args.batches, args.trials)
-        results[key] = sps
+    for key, sps in results.items():
         print(
             json.dumps(
                 {

@@ -4,9 +4,13 @@ The axon TPU tunnel is single-client and historically fragile, so when it IS
 healthy we capture every number in one process/one device claim:
 
   1. NumPy reference baseline (host CPU — the denominator, bench.py protocol);
-  2. headline: fused fp32 sequential epoch throughput, scan-unroll sweep;
-  3. the single-chip tuning matrix (fusion x precision x pallas backend) —
-     the pallas cells compile for real on the chip (non-interpret mode);
+  2. headline: fused sequential epoch throughput, scan-unroll sweep, at both
+     DEFAULT precision (the convergence-verified bench headline config) and
+     fp32 HIGHEST (the bitwise-NumPy-parity config) — each sweep's cells
+     measured with interleaved trials (same-window comparisons);
+  3. the single-chip tuning matrix (fusion x precision x pallas backend),
+     cells interleaved — the pallas cells compile for real on the chip
+     (non-interpret mode);
   4. 20-epoch flagship convergence on the prepared dataset, with per-epoch
      validation accuracy (end-to-end wall time, final accuracy, model hash);
   5. a jax.profiler trace of one post-compile epoch (artifacts/tpu_trace/).
@@ -37,7 +41,10 @@ sys.path.insert(0, str(ROOT))
 import bench  # the probe + the NumPy baseline + the headline protocol
 
 
-def headline_sweep(unrolls, trials):
+def headline_sweep(unrolls, trials, precision="highest"):
+    """Scan-unroll sweep of the fused sequential epoch, all unroll variants'
+    trials interleaved (bench.slope_epoch_seconds_many) so the sweep is a
+    same-window comparison rather than one cell per contention window."""
     import jax
     import jax.numpy as jnp
 
@@ -48,6 +55,7 @@ def headline_sweep(unrolls, trials):
         FLAGSHIP_LR as LR,
         FLAGSHIP_MUBATCHES as M,
         FLAGSHIP_SIZES as SIZES,
+        PRECISIONS,
     )
     from shallowspeed_tpu.optimizer import SGD
 
@@ -58,17 +66,23 @@ def headline_sweep(unrolls, trials):
     Y = jnp.asarray(
         np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, M, B // M))]
     )
-    out = {}
+    run_ks = {}
     for unroll in unrolls:
         params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
         epoch = trainer.make_train_epoch(
-            spec, SGD(LR), fuse_mubatches=True, unroll=unroll
+            spec, SGD(LR), precision=PRECISIONS[precision],
+            fuse_mubatches=True, unroll=unroll,
         )
-        sps = bench.measured_epoch_sps(
-            epoch, params, (), X, Y, trials=trials
+        run_ks[f"unroll={unroll}"] = bench.make_run_k(epoch, params, (), X, Y)
+    slopes = bench.slope_epoch_seconds_many(run_ks, trials=trials)
+    out = {}
+    for name, slope in slopes.items():
+        sps = nb * B / slope
+        out[name] = round(sps, 1)
+        print(
+            f"  headline fused {precision} {name}: {sps:,.0f} samples/s",
+            flush=True,
         )
-        out[f"unroll={unroll}"] = round(sps, 1)
-        print(f"  headline fused fp32 unroll={unroll}: {sps:,.0f} samples/s", flush=True)
     return out
 
 
@@ -179,25 +193,26 @@ def main():
     baseline = bench.numpy_baseline_sps(n_batches=10 if args.quick else 40)
     print(f"  numpy: {baseline:,.0f} samples/s", flush=True)
 
-    print("2) headline sweep (fused fp32 sequential epoch)...", flush=True)
-    sweep = headline_sweep((1, 2, 4, 8), 2 if args.quick else 3)
+    print("2) headline sweep (fused sequential epoch, DEFAULT precision "
+          "— the convergence-verified bench headline config)...", flush=True)
+    sweep = headline_sweep((1, 2, 4, 8), 2 if args.quick else 3,
+                           precision="default")
     best = max(sweep.values())
+    print("2b) fp32 HIGHEST sweep (the bitwise-NumPy-parity config)...",
+          flush=True)
+    sweep_fp32 = headline_sweep((1, 2, 4, 8), 2 if args.quick else 3,
+                                precision="highest")
+    best_fp32 = max(sweep_fp32.values())
 
-    print("3) tuning matrix...", flush=True)
+    print("3) tuning matrix (interleaved cells, same-window ratios)...", flush=True)
     sys.path.insert(0, str(ROOT / "scripts"))
-    from bench_tpu_matrix import measure
+    from bench_tpu_matrix import ALL_CELLS, run_matrix
 
+    raw = run_matrix(ALL_CELLS, 29 if args.quick else 116, 2)
     matrix = {}
-    for fused in (False, True):
-        for prec in ("highest", "default"):
-            for pallas in (False, True):
-                key = (
-                    ("fused" if fused else "scanned")
-                    + "+" + prec + "+" + ("pallas" if pallas else "xla")
-                )
-                sps = measure(fused, prec, pallas, 29 if args.quick else 116, 2)
-                matrix[key] = round(sps, 1)
-                print(f"  {key}: {sps:,.0f} samples/s", flush=True)
+    for key, sps in raw.items():
+        matrix["+".join(key)] = round(sps, 1)
+        print(f"  {'+'.join(key)}: {sps:,.0f} samples/s", flush=True)
 
     print("4) convergence (real dataset, per-epoch eval)...", flush=True)
     conv = convergence_run(args.data_dir, 5 if args.quick else 20)
@@ -208,9 +223,12 @@ def main():
     result = {
         "info": info,
         "numpy_baseline_sps": round(baseline, 1),
-        "headline_sweep": sweep,
+        "headline_sweep_default_precision": sweep,
         "headline_best_sps": best,
         "vs_baseline": round(best / baseline, 2),
+        "headline_sweep_fp32_highest": sweep_fp32,
+        "headline_best_fp32_sps": best_fp32,
+        "vs_baseline_fp32": round(best_fp32 / baseline, 2),
         "matrix": matrix,
         "convergence": conv,
         "trace": trace,

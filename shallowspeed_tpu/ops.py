@@ -98,7 +98,7 @@ def linear_relu_fused(x, w, b, precision=DEFAULT_PRECISION):
     if _PALLAS:
         from shallowspeed_tpu import pallas_ops
 
-        y, mask = pallas_ops.linear_relu_fwd(x, w, b)
+        y, mask = pallas_ops.linear_relu_fwd(x, w, b, precision=precision)
         return y, mask > 0
     y = linear(x, w, b, precision=precision)
     return relu(y), y > 0
@@ -110,7 +110,7 @@ def linear_relu_grad_fused(g, bitmask, x, w, precision=DEFAULT_PRECISION):
         from shallowspeed_tpu import pallas_ops
 
         dx, dw, db = pallas_ops.linear_relu_bwd(
-            g, bitmask.astype(jnp.float32), x, w
+            g, bitmask.astype(jnp.float32), x, w, precision=precision
         )
         return dx, dw, jnp.reshape(db, (-1,))
     return linear_grad(relu_grad(g, bitmask), x, w, precision=precision)

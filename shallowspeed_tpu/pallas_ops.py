@@ -76,20 +76,23 @@ def _pad_to(a, axis, mult):
     return jnp.pad(a, widths)
 
 
-def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, mask_ref):
+def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, mask_ref, *, precision):
     z = (
-        jnp.dot(x_ref[:], w_ref[:].T, preferred_element_type=jnp.float32)
+        jnp.dot(
+            x_ref[:], w_ref[:].T,
+            precision=precision, preferred_element_type=jnp.float32,
+        )
         + b_ref[:]
     )
     mask_ref[:] = (z > 0.0).astype(jnp.float32)
     y_ref[:] = jnp.maximum(z, 0.0)
 
 
-def _linear_relu_fwd_single(x, w, b2):
+def _linear_relu_fwd_single(x, w, b2, precision):
     mb, _ = x.shape
     dout = w.shape[0]
     return pl.pallas_call(
-        _fwd_kernel,
+        functools.partial(_fwd_kernel, precision=precision),
         out_shape=(
             jax.ShapeDtypeStruct((mb, dout), jnp.float32),
             jax.ShapeDtypeStruct((mb, dout), jnp.float32),
@@ -107,13 +110,16 @@ def _linear_relu_fwd_single(x, w, b2):
     )(x, w, b2)
 
 
-def _fwd_tiled_kernel(x_ref, w_ref, b_ref, y_ref, mask_ref):
+def _fwd_tiled_kernel(x_ref, w_ref, b_ref, y_ref, mask_ref, *, precision):
     # grid = (row tiles i, out-col tiles j, contraction tiles c); c is
     # INNERMOST: the revisited y block accumulates partial products, and the
     # bias/relu/mask epilogue runs once on the final contraction step
     c = pl.program_id(2)
     nc = pl.num_programs(2)
-    partial = jnp.dot(x_ref[:], w_ref[:].T, preferred_element_type=jnp.float32)
+    partial = jnp.dot(
+        x_ref[:], w_ref[:].T,
+        precision=precision, preferred_element_type=jnp.float32,
+    )
 
     @pl.when(c == 0)
     def _init():
@@ -130,7 +136,7 @@ def _fwd_tiled_kernel(x_ref, w_ref, b_ref, y_ref, mask_ref):
         y_ref[:] = jnp.maximum(z, 0.0)
 
 
-def linear_relu_fwd_tiled(x, w, b2, tile=TILE):
+def linear_relu_fwd_tiled(x, w, b2, tile=TILE, precision=None):
     """Grid-tiled forward: every dim tiled (rows x out-cols x contraction),
     so per-block VMEM is ~4 tile^2 floats regardless of shape. Ragged edges
     zero-padded here, sliced off after (exact: pads contribute zeros)."""
@@ -142,7 +148,7 @@ def linear_relu_fwd_tiled(x, w, b2, tile=TILE):
     mbp, dinp = xp.shape
     doutp = wp.shape[0]
     y, mask = pl.pallas_call(
-        _fwd_tiled_kernel,
+        functools.partial(_fwd_tiled_kernel, precision=precision),
         grid=(mbp // tile, doutp // tile, dinp // tile),
         out_shape=(
             jax.ShapeDtypeStruct((mbp, doutp), jnp.float32),
@@ -162,28 +168,37 @@ def linear_relu_fwd_tiled(x, w, b2, tile=TILE):
     return y[:mb, :dout], mask[:mb, :dout]
 
 
-@functools.partial(jax.jit, static_argnames=())
-def linear_relu_fwd(x, w, b):
+@functools.partial(jax.jit, static_argnames=("precision",))
+def linear_relu_fwd(x, w, b, precision=None):
+    """``precision`` is the MXU dot precision (lax.Precision; None = the
+    backend default, a single bf16-input pass). The framework's ops layer
+    passes its caller's precision through, so HIGHEST really means the
+    multi-pass fp32-class dot inside the kernel too — without this the
+    'pallas' and 'xla' backends would silently measure different math."""
     mb, din = x.shape
     dout = w.shape[0]
     b2 = jnp.reshape(b, (1, -1))
     if _fwd_bytes(mb, din, dout) <= SINGLE_BLOCK_BUDGET_BYTES:
-        return _linear_relu_fwd_single(x, w, b2)
-    return linear_relu_fwd_tiled(x, w, b2, tile=TILE)
+        return _linear_relu_fwd_single(x, w, b2, precision)
+    return linear_relu_fwd_tiled(x, w, b2, tile=TILE, precision=precision)
 
 
-def _bwd_kernel(g_ref, mask_ref, x_ref, w_ref, dx_ref, dw_ref, db_ref):
+def _bwd_kernel(g_ref, mask_ref, x_ref, w_ref, dx_ref, dw_ref, db_ref, *, precision):
     ge = g_ref[:] * mask_ref[:]
-    dx_ref[:] = jnp.dot(ge, w_ref[:], preferred_element_type=jnp.float32)
-    dw_ref[:] = jnp.dot(ge.T, x_ref[:], preferred_element_type=jnp.float32)
+    dx_ref[:] = jnp.dot(
+        ge, w_ref[:], precision=precision, preferred_element_type=jnp.float32
+    )
+    dw_ref[:] = jnp.dot(
+        ge.T, x_ref[:], precision=precision, preferred_element_type=jnp.float32
+    )
     db_ref[:] = jnp.sum(ge, axis=0, keepdims=True)
 
 
-def _linear_relu_bwd_single(g, mask, x, w):
+def _linear_relu_bwd_single(g, mask, x, w, precision):
     mb, dout = g.shape
     din = x.shape[1]
     return pl.pallas_call(
-        _bwd_kernel,
+        functools.partial(_bwd_kernel, precision=precision),
         out_shape=(
             jax.ShapeDtypeStruct((mb, din), jnp.float32),
             jax.ShapeDtypeStruct((dout, din), jnp.float32),
@@ -195,12 +210,14 @@ def _linear_relu_bwd_single(g, mask, x, w):
     )(g, mask, x, w)
 
 
-def _bwd_dx_kernel(g_ref, mask_ref, w_ref, dx_ref):
+def _bwd_dx_kernel(g_ref, mask_ref, w_ref, dx_ref, *, precision):
     # grid = (row tiles i, in-col tiles j, out-col/contraction tiles c);
     # c INNERMOST accumulates into the revisited dx block
     c = pl.program_id(2)
     ge = g_ref[:] * mask_ref[:]
-    partial = jnp.dot(ge, w_ref[:], preferred_element_type=jnp.float32)
+    partial = jnp.dot(
+        ge, w_ref[:], precision=precision, preferred_element_type=jnp.float32
+    )
 
     @pl.when(c == 0)
     def _init():
@@ -211,13 +228,15 @@ def _bwd_dx_kernel(g_ref, mask_ref, w_ref, dx_ref):
         dx_ref[:] += partial
 
 
-def _bwd_dw_kernel(g_ref, mask_ref, x_ref, dw_ref, db_ref):
+def _bwd_dw_kernel(g_ref, mask_ref, x_ref, dw_ref, db_ref, *, precision):
     # grid = (out-col tiles j, in-col tiles k, row tiles i); i is INNERMOST so
     # the revisited dw block accumulates partial products over row tiles
     k = pl.program_id(1)
     i = pl.program_id(2)
     ge = g_ref[:] * mask_ref[:]
-    contrib = jnp.dot(ge.T, x_ref[:], preferred_element_type=jnp.float32)
+    contrib = jnp.dot(
+        ge.T, x_ref[:], precision=precision, preferred_element_type=jnp.float32
+    )
 
     @pl.when(i == 0)
     def _init():
@@ -239,7 +258,7 @@ def _bwd_dw_kernel(g_ref, mask_ref, x_ref, dw_ref, db_ref):
         db_ref[:] += dbc
 
 
-def linear_relu_bwd_tiled(g, mask, x, w, tile=TILE):
+def linear_relu_bwd_tiled(g, mask, x, w, tile=TILE, precision=None):
     """Grid-tiled backward, two kernels, every dim tiled (per-block VMEM is
     ~4 tile^2 floats regardless of shape): dx on a (row x in-col x out-col)
     grid accumulating over the innermost out-col/contraction tiles; dw/db on
@@ -254,7 +273,7 @@ def linear_relu_bwd_tiled(g, mask, x, w, tile=TILE):
     mbp, doutp = gp.shape
     dinp = xp.shape[1]
     dx = pl.pallas_call(
-        _bwd_dx_kernel,
+        functools.partial(_bwd_dx_kernel, precision=precision),
         grid=(mbp // tile, dinp // tile, doutp // tile),
         out_shape=jax.ShapeDtypeStruct((mbp, dinp), jnp.float32),
         in_specs=[
@@ -268,7 +287,7 @@ def linear_relu_bwd_tiled(g, mask, x, w, tile=TILE):
         interpret=_interpret(),
     )(gp, mp, wp)
     dw, db = pl.pallas_call(
-        _bwd_dw_kernel,
+        functools.partial(_bwd_dw_kernel, precision=precision),
         grid=(doutp // tile, dinp // tile, mbp // tile),
         out_shape=(
             jax.ShapeDtypeStruct((doutp, dinp), jnp.float32),
@@ -288,10 +307,12 @@ def linear_relu_bwd_tiled(g, mask, x, w, tile=TILE):
     return dx[:mb, :din], dw[:dout, :din], db[:, :dout]
 
 
-@functools.partial(jax.jit, static_argnames=())
-def linear_relu_bwd(g, mask, x, w):
+@functools.partial(jax.jit, static_argnames=("precision",))
+def linear_relu_bwd(g, mask, x, w, precision=None):
+    """See linear_relu_fwd: ``precision`` makes the kernel's dots match the
+    caller's precision class instead of silently using the backend default."""
     mb, dout = g.shape
     din = x.shape[1]
     if _bwd_bytes(mb, din, dout) <= SINGLE_BLOCK_BUDGET_BYTES:
-        return _linear_relu_bwd_single(g, mask, x, w)
-    return linear_relu_bwd_tiled(g, mask, x, w, tile=TILE)
+        return _linear_relu_bwd_single(g, mask, x, w, precision)
+    return linear_relu_bwd_tiled(g, mask, x, w, tile=TILE, precision=precision)

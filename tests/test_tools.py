@@ -140,3 +140,52 @@ def test_bench_watchdog_timeout_is_flagged(monkeypatch, tmp_path):
     )
     assert results == {"default": 7.0}  # flushed before the hang — salvaged
     assert saw_timeout
+
+
+def test_slope_timing_interleaved_same_window(monkeypatch):
+    """slope_epoch_seconds_many must interleave configs WITHIN each trial
+    (so a contention window hits all configs equally) and estimate each
+    config's slope with the same per-leg-minimum discipline."""
+    bench = _import_bench()
+    fake = {"t": 0.0}
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: fake["t"])
+    order = []
+
+    def make_run_k(name, per_epoch):
+        def run_k(k):
+            order.append(name)
+            # trial 2 of 3 is globally contended: both configs see it, so
+            # per-leg minima drop it for both and the ratio stays truthful
+            contended = 0.7 if len(order) // 4 == 1 else 0.0
+            fake["t"] += 0.05 + per_epoch * k + contended
+        return run_k
+
+    slopes = bench.slope_epoch_seconds_many(
+        {"a": make_run_k("a", 0.01), "b": make_run_k("b", 0.02)},
+        trials=3,
+        min_delta_s=0,  # fixed legs: this test pins the interleaving order
+    )
+    assert abs(slopes["a"] - 0.01) < 1e-12
+    assert abs(slopes["b"] - 0.02) < 1e-12
+    # interleaving: each trial visits a then b before the next trial
+    assert order[:4] == ["a", "a", "b", "b"]
+
+
+def test_slope_timing_adapts_legs_past_rtt_hiding(monkeypatch):
+    """On a high-RTT tunnel, a whole leg's device work can hide inside the
+    dispatch+readback constants (wall = max(RTT, device_time)), making the
+    naive fixed-leg delta pure noise (observed: 1.65e9 'samples/s' matrix
+    cells). The estimator must measure the zero-epoch constants, grow the
+    small leg until device time is resolvable ABOVE them, and then recover
+    the true per-epoch cost exactly (both legs unhidden => constants
+    cancel)."""
+    bench = _import_bench()
+    fake = {"t": 0.0}
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: fake["t"])
+    RTT, PER_EPOCH = 0.08, 0.001
+
+    def run_k(k):
+        fake["t"] += max(RTT, PER_EPOCH * k)  # k epochs fully overlap the RTT
+
+    slopes = bench.slope_epoch_seconds_many({"cell": run_k}, trials=3)
+    assert abs(slopes["cell"] - PER_EPOCH) < 1e-12
