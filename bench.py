@@ -161,7 +161,7 @@ def slope_epoch_seconds(run_k, k1=2, k2=8, trials=3, min_delta_s=0.25):
 
 
 def slope_epoch_seconds_many(
-    run_ks, k1=2, k2=8, trials=3, min_delta_s=0.25, k_max=4096
+    run_ks, k1=2, k2=8, trials=3, min_delta_s=0.25, k_max=4096, failures=None
 ):
     """Interleaved two-point slopes for several configs at once.
 
@@ -249,19 +249,28 @@ def slope_epoch_seconds_many(
     out = {}
     for name in names:
         delta = min(t_larges[name]) - min(t_smalls[name])
+        err = None
         if delta <= 0:
-            raise RuntimeError(
+            err = (
                 "slope timing failed: the large leg never measurably slower "
                 f"than the small leg for {name!r} (device not actually "
                 "executing the work?)"
             )
-        if min_delta_s > 0 and delta < min_delta_s:
-            raise RuntimeError(
+        elif min_delta_s > 0 and delta < min_delta_s:
+            err = (
                 f"slope timing failed: could not resolve {name!r} above "
                 f"transport constants even at {k2s[name]} epochs/leg "
                 "(extreme contention variance?) — refusing to publish an "
                 "under-resolved (inflated) throughput"
             )
+        if err is not None:
+            # With a `failures` dict the caller keeps every healthy config's
+            # result (one bad cell must not discard a whole chip-claim's
+            # measurements); without one, refusing loudly is the contract.
+            if failures is None:
+                raise RuntimeError(err)
+            failures[name] = err
+            continue
         out[name] = delta / (k2s[name] - k1s[name])
     return out
 
@@ -351,19 +360,34 @@ def numpy_baseline_sps(n_batches=40):
     return n_batches * B / dt
 
 
-def _jax_epoch_setup(precision, unroll=None):
-    """Build the headline measurement setup (fused sequential epoch) at the
-    named matmul precision: returns ``(epoch_fn, params, X, Y)``."""
+def _headline_data():
+    """The headline measurement's model + data: ``(spec, params, X, Y)`` —
+    the single definition shared by the slope measurement and the whole-run
+    cross-check, so both provably measure the same model on the same data."""
     import jax
     import jax.numpy as jnp
 
     from shallowspeed_tpu import model as Mo
+
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+    nb = N_SAMPLES // B
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.rand(nb, M, B // M, SIZES[0]).astype(np.float32))
+    Y = jnp.asarray(
+        np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, M, B // M))]
+    )
+    return spec, params, X, Y
+
+
+def _jax_epoch_setup(precision, unroll=None):
+    """Build the headline measurement setup (fused sequential epoch) at the
+    named matmul precision: returns ``(epoch_fn, params, X, Y)``."""
     from shallowspeed_tpu import trainer
     from shallowspeed_tpu.api import PRECISIONS
     from shallowspeed_tpu.optimizer import SGD
 
-    spec = Mo.make_model_spec(SIZES, 1, B)
-    params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+    spec, params, X, Y = _headline_data()
     # fuse_mubatches: identical training (sum-gradient ledger), one full-batch
     # forward/backward per step — the TPU-shaped way to run the sequential
     # path. unroll: batch-scan unroll factor (bit-identical numerics); the
@@ -374,13 +398,6 @@ def _jax_epoch_setup(precision, unroll=None):
     epoch = trainer.make_train_epoch(
         spec, SGD(LR), precision=PRECISIONS[precision], fuse_mubatches=True,
         unroll=unroll,
-    )
-
-    nb = N_SAMPLES // B
-    rng = np.random.RandomState(0)
-    X = jnp.asarray(rng.rand(nb, M, B // M, SIZES[0]).astype(np.float32))
-    Y = jnp.asarray(
-        np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, M, B // M))]
     )
     return epoch, params, X, Y
 
@@ -415,6 +432,45 @@ def jax_sps_many(precisions, trials=5, unroll=None):
 _PLAUSIBLE_TFLOPS = {"highest": 100e12, "default": 200e12}
 
 
+def crosscheck_whole_run_sps(precision="default", measured_sps=None, trials=3):
+    """Independent cross-check: time N epochs as ONE device program
+    (epochs-outer scan, single dispatch + single readback) by plain
+    wall-clock. With ~2 s of device work per call, the one RTT+dispatch
+    constant bounds the error to a few percent, and NO slope/estimator
+    logic is involved — a protocol bug that inflates the slope-based
+    headline cannot inflate this number, so the headline must stay within
+    a small factor of it. Best-of-``trials`` (least-contended window) to be
+    comparable with the min-based slope estimate.
+
+    ``measured_sps`` (the slope-based estimate being cross-checked) sizes
+    the run to ~2 s of expected device work — a fixed epoch count would be
+    milliseconds on the chip but many minutes on a CPU-fallback backend."""
+    from shallowspeed_tpu import trainer
+    from shallowspeed_tpu.api import PRECISIONS
+    from shallowspeed_tpu.optimizer import SGD
+
+    spec, params, X, Y = _headline_data()
+    samples_per_epoch = X.shape[0] * X.shape[1] * X.shape[2]
+    if measured_sps:
+        epochs = int(min(1000, max(20, 2.0 * measured_sps / samples_per_epoch)))
+    else:
+        epochs = 300
+    run = trainer.make_train_run(
+        spec, SGD(LR), precision=PRECISIONS[precision], fuse_mubatches=True,
+        with_eval=False,
+    )
+    params, opt_state, losses = run(params, (), X, Y, epochs)  # compile+warm
+    sync_readback(losses)
+    best = None
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        params, opt_state, losses = run(params, opt_state, X, Y, epochs)
+        sync_readback(losses)
+        wall = time.perf_counter() - t0
+        best = wall if best is None else min(best, wall)
+    return samples_per_epoch * epochs / best
+
+
 def _measure_child(precisions):
     """Child mode: measure the precisions with interleaved trials (so the
     published pair shares contention windows), printing one flushed JSON
@@ -423,8 +479,17 @@ def _measure_child(precisions):
     one cell aborts it), fall back to independent per-cell measurement so
     one cell's deterministic failure cannot take the others down."""
     try:
-        for precision, sps in jax_sps_many(precisions).items():
+        res = jax_sps_many(precisions)
+        for precision, sps in res.items():
             print(json.dumps({"precision": precision, "sps": sps}), flush=True)
+        try:
+            lb = crosscheck_whole_run_sps(
+                "default", measured_sps=res.get("default")
+            )
+            print(json.dumps({"crosscheck_whole_run_sps": lb}), flush=True)
+        except Exception as e:  # noqa: BLE001 — the cross-check is optional
+            print(f"bench child: whole-run cross-check failed ({e!r})",
+                  file=sys.stderr)
         sys.exit(0)
     except Exception as e:  # noqa: BLE001 — isolate cells below
         print(
@@ -495,7 +560,9 @@ def _run_measurements(precisions, timeout_s, attempts=2, force_cpu=False):
                     continue  # non-JSON noise (e.g. plugin warnings)
                 if not isinstance(rec, dict):
                     continue  # JSON-shaped noise (bare numbers/strings)
-                if "sps" in rec:
+                if "crosscheck_whole_run_sps" in rec:
+                    results["_crosscheck"] = rec["crosscheck_whole_run_sps"]
+                elif "sps" in rec:
                     results[rec["precision"]] = rec["sps"]
                     errors.pop(rec["precision"], None)
                 elif "error" in rec:
@@ -553,6 +620,7 @@ def main():
     metric = "mnist_mlp_train_samples_per_sec_per_chip" + fallback_tag
     # physical plausibility guard: if the implied FLOP rate exceeds anything a
     # single chip can do, the timing protocol was defeated — label, don't lie
+    crosscheck = results.get("_crosscheck")
     implausible = []
     if value * flops_per_sample() > _PLAUSIBLE_TFLOPS["default"]:
         implausible.append(("default", value))
@@ -571,6 +639,18 @@ def main():
                 "single-chip ceiling; tagging metric",
                 file=sys.stderr,
             )
+    # second, protocol-independent guard: the whole-run wall-clock lower
+    # bound (one program, one dispatch, one readback — nothing a slope bug
+    # can inflate). The headline must stay within a small factor of it.
+    if crosscheck is not None and value > 2.0 * crosscheck:
+        if "_SUSPECT_TIMING" not in metric:
+            metric += "_SUSPECT_TIMING"
+        print(
+            f"bench: headline {value:,.0f} samples/s exceeds 2x the "
+            f"whole-run wall-clock cross-check ({crosscheck:,.0f}); "
+            "tagging metric",
+            file=sys.stderr,
+        )
     print(
         json.dumps(
             {
@@ -585,6 +665,9 @@ def main():
                 ),
                 "vs_baseline_fp32_highest": (
                     None if value_fp32 is None else round(value_fp32 / baseline, 2)
+                ),
+                "whole_run_crosscheck_sps": (
+                    None if crosscheck is None else round(crosscheck, 1)
                 ),
             }
         )

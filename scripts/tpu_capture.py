@@ -214,6 +214,15 @@ def main():
         matrix["+".join(key)] = round(sps, 1)
         print(f"  {'+'.join(key)}: {sps:,.0f} samples/s", flush=True)
 
+    print("3b) full-epoch fused cells: pallas vs xla at equal precision "
+          "class (the kernels take the caller's precision)...", flush=True)
+    fused_cells = [(True, p, k) for p in ("highest", "default") for k in (False, True)]
+    raw_full = run_matrix(fused_cells, 29 if args.quick else bench.N_SAMPLES // 128, 2)
+    matrix_full = {}
+    for key, sps in raw_full.items():
+        matrix_full["+".join(key)] = round(sps, 1)
+        print(f"  {'+'.join(key)}: {sps:,.0f} samples/s", flush=True)
+
     print("4) convergence (real dataset, per-epoch eval)...", flush=True)
     conv = convergence_run(args.data_dir, 5 if args.quick else 20)
 
@@ -230,6 +239,7 @@ def main():
         "headline_best_fp32_sps": best_fp32,
         "vs_baseline_fp32": round(best_fp32 / baseline, 2),
         "matrix": matrix,
+        "matrix_full_epoch_fused": matrix_full,
         "convergence": conv,
         "trace": trace,
     }

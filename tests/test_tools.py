@@ -189,3 +189,26 @@ def test_slope_timing_adapts_legs_past_rtt_hiding(monkeypatch):
 
     slopes = bench.slope_epoch_seconds_many({"cell": run_k}, trials=3)
     assert abs(slopes["cell"] - PER_EPOCH) < 1e-12
+
+
+def test_slope_timing_failures_dict_salvages_good_configs(monkeypatch):
+    """With a `failures` dict, one unresolvable config must not discard the
+    other configs' completed measurements (a whole chip claim's worth on the
+    real tunnel)."""
+    bench = _import_bench()
+    fake = {"t": 0.0}
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: fake["t"])
+
+    def good(k):
+        fake["t"] += 0.1 + 0.01 * k
+
+    def stuck(k):
+        fake["t"] += 0.1  # pure constant: never resolves
+
+    failures = {}
+    slopes = bench.slope_epoch_seconds_many(
+        {"good": good, "stuck": stuck}, trials=2, failures=failures
+    )
+    assert abs(slopes["good"] - 0.01) < 1e-12
+    assert "good" not in failures
+    assert "stuck" in failures and "stuck" not in slopes
