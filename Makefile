@@ -26,10 +26,16 @@ bench-scaling:
 bench-matrix:
 	python scripts/bench_tpu_matrix.py
 
-# one-shot full TPU measurement (baseline, unroll sweep, matrix,
-# convergence, profiler trace) — run when the chip is healthy
+# one-shot full TPU measurement (baseline, unroll sweeps at both precision
+# classes, interleaved matrix + full-epoch pallas/xla cells, convergence,
+# profiler trace) — run when the chip is healthy
 tpu-capture:
 	python scripts/tpu_capture.py
+
+# the convergence-equivalence experiment behind the default-precision
+# bench headline (20-epoch run at --precision default + same-window pair)
+tpu-default-precision:
+	python scripts/tpu_default_precision.py
 
 schedules:
 	$(CPU_MESH) python scripts/show_schedule.py --all
