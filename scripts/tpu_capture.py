@@ -8,12 +8,16 @@ healthy we capture every number in one process/one device claim:
      DEFAULT precision (the convergence-verified bench headline config) and
      fp32 HIGHEST (the bitwise-NumPy-parity config) — each sweep's cells
      measured with interleaved trials (same-window comparisons);
-  3. the single-chip tuning matrix (fusion x precision x pallas backend),
-     cells interleaved — the pallas cells compile for real on the chip
-     (non-interpret mode);
-  4. 20-epoch flagship convergence on the prepared dataset, with per-epoch
+  3. 20-epoch flagship convergence on the prepared dataset, with per-epoch
      validation accuracy (end-to-end wall time, final accuracy, model hash);
-  5. a jax.profiler trace of one post-compile epoch (artifacts/tpu_trace/).
+  4. a jax.profiler trace of one post-compile epoch (artifacts/tpu_trace/);
+  5. the single-chip tuning matrix (fusion x precision x pallas backend) and
+     full-epoch fused pallas-vs-xla cells, interleaved — the pallas cells
+     compile for real on the chip (non-interpret mode). Deliberately LAST:
+     kernel compiles are the observed tunnel-wedge trigger, and progress is
+     checkpointed to <out>.partial after every phase so a wedge keeps
+     everything measured before it (the final artifact is renamed into
+     place with a completed_at marker).
 
 All throughput cells use bench.py's two-point-slope protocol with forced
 host readbacks: on the axon tunnel, dispatch is fully asynchronous and
@@ -189,22 +193,54 @@ def main():
             check=True,
         )
 
+    # Phase order is deliberate: most valuable first, riskiest LAST (the
+    # tunnel has wedged mid-capture on a kernel compile before, and a wedge
+    # hangs every subsequent RPC in this process). Progress goes to
+    # <out>.partial after every completed phase — never clobbering a
+    # previous complete artifact at <out> — and the final result is renamed
+    # into place carrying a completed_at marker, so a partial capture is
+    # both preserved and unmistakable.
+    result = {"info": info}
+    partial_path = Path(str(args.out) + ".partial")
+
+    def checkpoint_result():
+        partial_path.write_text(json.dumps(result, indent=2) + "\n")
+
     print("1) NumPy baseline (host CPU)...", flush=True)
     baseline = bench.numpy_baseline_sps(n_batches=10 if args.quick else 40)
     print(f"  numpy: {baseline:,.0f} samples/s", flush=True)
+    result["numpy_baseline_sps"] = round(baseline, 1)
+    checkpoint_result()
 
     print("2) headline sweep (fused sequential epoch, DEFAULT precision "
           "— the convergence-verified bench headline config)...", flush=True)
     sweep = headline_sweep((1, 2, 4, 8), 2 if args.quick else 3,
                            precision="default")
     best = max(sweep.values())
+    result["headline_sweep_default_precision"] = sweep
+    result["headline_best_sps"] = best
+    result["vs_baseline"] = round(best / baseline, 2)
+    checkpoint_result()
     print("2b) fp32 HIGHEST sweep (the bitwise-NumPy-parity config)...",
           flush=True)
     sweep_fp32 = headline_sweep((1, 2, 4, 8), 2 if args.quick else 3,
                                 precision="highest")
     best_fp32 = max(sweep_fp32.values())
+    result["headline_sweep_fp32_highest"] = sweep_fp32
+    result["headline_best_fp32_sps"] = best_fp32
+    result["vs_baseline_fp32"] = round(best_fp32 / baseline, 2)
+    checkpoint_result()
 
-    print("3) tuning matrix (interleaved cells, same-window ratios)...", flush=True)
+    print("3) convergence (real dataset, per-epoch eval)...", flush=True)
+    result["convergence"] = convergence_run(args.data_dir, 5 if args.quick else 20)
+    checkpoint_result()
+
+    print("4) profiler trace...", flush=True)
+    result["trace"] = profile_one_epoch(args.data_dir, ROOT / "artifacts" / "tpu_trace")
+    checkpoint_result()
+
+    print("5) tuning matrix (interleaved cells, same-window ratios; "
+          "pallas compiles — the risky phase — run last)...", flush=True)
     sys.path.insert(0, str(ROOT / "scripts"))
     from bench_tpu_matrix import ALL_CELLS, run_matrix
 
@@ -213,8 +249,10 @@ def main():
     for key, sps in raw.items():
         matrix["+".join(key)] = round(sps, 1)
         print(f"  {'+'.join(key)}: {sps:,.0f} samples/s", flush=True)
+    result["matrix"] = matrix
+    checkpoint_result()
 
-    print("3b) full-epoch fused cells: pallas vs xla at equal precision "
+    print("5b) full-epoch fused cells: pallas vs xla at equal precision "
           "class (the kernels take the caller's precision)...", flush=True)
     fused_cells = [(True, p, k) for p in ("highest", "default") for k in (False, True)]
     raw_full = run_matrix(fused_cells, 29 if args.quick else bench.N_SAMPLES // 128, 2)
@@ -222,28 +260,10 @@ def main():
     for key, sps in raw_full.items():
         matrix_full["+".join(key)] = round(sps, 1)
         print(f"  {'+'.join(key)}: {sps:,.0f} samples/s", flush=True)
-
-    print("4) convergence (real dataset, per-epoch eval)...", flush=True)
-    conv = convergence_run(args.data_dir, 5 if args.quick else 20)
-
-    print("5) profiler trace...", flush=True)
-    trace = profile_one_epoch(args.data_dir, ROOT / "artifacts" / "tpu_trace")
-
-    result = {
-        "info": info,
-        "numpy_baseline_sps": round(baseline, 1),
-        "headline_sweep_default_precision": sweep,
-        "headline_best_sps": best,
-        "vs_baseline": round(best / baseline, 2),
-        "headline_sweep_fp32_highest": sweep_fp32,
-        "headline_best_fp32_sps": best_fp32,
-        "vs_baseline_fp32": round(best_fp32 / baseline, 2),
-        "matrix": matrix,
-        "matrix_full_epoch_fused": matrix_full,
-        "convergence": conv,
-        "trace": trace,
-    }
-    Path(args.out).write_text(json.dumps(result, indent=2) + "\n")
+    result["matrix_full_epoch_fused"] = matrix_full
+    result["completed_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    checkpoint_result()
+    partial_path.rename(args.out)
     print(json.dumps({"headline_best_sps": best, "vs_baseline": result["vs_baseline"]}))
 
 
