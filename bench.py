@@ -40,7 +40,7 @@ import time
 import numpy as np
 
 
-def _ensure_responsive_backend(probe_timeout_s=180):
+def _ensure_responsive_backend(probe_timeout_s=180, patience_s=None):
     """Never hang the benchmark on a wedged accelerator tunnel.
 
     Backend init for a remote-tunneled TPU can block indefinitely if the
@@ -51,6 +51,13 @@ def _ensure_responsive_backend(probe_timeout_s=180):
     can label the published metric honestly and distinguish a hung tunnel
     from a backend that failed fast.
 
+    Wedges are transient (observed recovery: tens of minutes) and a tagged
+    CPU number is worth far less than a late chip number, so an unresponsive
+    tunnel is re-probed until ``patience_s`` of wall clock is spent (default
+    1800 s, override with SHALLOWSPEED_BENCH_PROBE_BUDGET_S; 0 = single
+    probe). A backend that fails FAST (init error, not a hang) is not
+    retried — the real run would die the same way.
+
     stdout goes to DEVNULL and stderr to a temp FILE (never a pipe): a tunnel
     helper grandchild surviving the timeout kill would keep a captured pipe
     open and make the probe itself hang in communicate(), while a file lets
@@ -58,30 +65,62 @@ def _ensure_responsive_backend(probe_timeout_s=180):
     """
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return ""  # no tunnel plugin, nothing to guard (and nothing to pay)
+    if patience_s is None:
+        patience_s = float(os.environ.get("SHALLOWSPEED_BENCH_PROBE_BUDGET_S", "1800"))
     # stderr goes to a FILE, not a pipe: a tunnel-helper grandchild surviving
     # the timeout kill would hold a pipe open and hang the probe itself
     import tempfile
 
-    with tempfile.TemporaryFile() as errf:
-        try:
-            subprocess.run(
+    deadline = time.monotonic() + patience_s
+    attempt = 0
+    while True:
+        attempt += 1
+        with tempfile.TemporaryFile() as errf:
+            # start_new_session: a timed-out probe must not leak a tunnel-
+            # helper grandchild — the tunnel is single-client, so a surviving
+            # helper would hold the claim and make every RETRY time out too
+            # (the retry loop would then convert a transient wedge into a
+            # guaranteed CPU fallback). Killing the whole process group
+            # before the next attempt keeps the retries meaningful.
+            proc = subprocess.Popen(
                 [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=probe_timeout_s,
-                check=True,
                 stdout=subprocess.DEVNULL,
                 stderr=errf,
+                start_new_session=True,
             )
-            return ""
-        except subprocess.TimeoutExpired:
-            detail = f"unresponsive (> {probe_timeout_s}s to init)"
-            tag = "_CPU_FALLBACK_TUNNEL_UNRESPONSIVE"
-        except subprocess.CalledProcessError:
-            # e.g. "UNAVAILABLE: TPU backend setup/compile error" — the real
-            # run would die the same way; a degraded CPU number beats none
-            errf.seek(0)
-            tail = errf.read().decode(errors="replace").strip().splitlines()
-            detail = f"failed to initialize ({tail[-1] if tail else 'no stderr'})"
-            tag = "_CPU_FALLBACK_BACKEND_INIT_FAILED"
+            try:
+                rc = proc.wait(timeout=probe_timeout_s)
+            except subprocess.TimeoutExpired:
+                rc = None
+                import signal
+
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                proc.wait()
+            if rc == 0:
+                return ""
+            if rc is None:
+                detail = f"unresponsive (> {probe_timeout_s}s to init)"
+                tag = "_CPU_FALLBACK_TUNNEL_UNRESPONSIVE"
+                if time.monotonic() < deadline:
+                    print(
+                        f"bench: tunnel probe {attempt} {detail}; retrying "
+                        f"({deadline - time.monotonic():.0f}s of patience left)",
+                        file=sys.stderr,
+                    )
+                    time.sleep(min(120, max(0, deadline - time.monotonic())))
+                    continue
+            else:
+                # e.g. "UNAVAILABLE: TPU backend setup/compile error" — the
+                # real run would die the same way; a degraded CPU number
+                # beats none. Fail-fast errors are deterministic: no retry.
+                errf.seek(0)
+                tail = errf.read().decode(errors="replace").strip().splitlines()
+                detail = f"failed to initialize ({tail[-1] if tail else 'no stderr'})"
+                tag = "_CPU_FALLBACK_BACKEND_INIT_FAILED"
+        break
     print(f"bench: accelerator backend {detail}; falling back to CPU", file=sys.stderr)
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -154,6 +193,12 @@ def slope_epoch_seconds(run_k, k1=2, k2=8, trials=3, min_delta_s=0.25):
     and the constants still cancel. (Taking min over per-trial slopes
     instead would be biased fast whenever a trial's k1 leg was contended
     while its k2 leg was not.)
+
+    k==0 CONTRACT: with ``min_delta_s > 0`` the adaptation phase calls
+    ``run_k(0)`` as a constants probe; run_k MUST respond by dispatching a
+    fresh trivial computation and reading it back (what probe_constants
+    does — see make_run_k), NOT by returning without touching the device.
+    A no-op k==0 yields c0~0 and silently weakens leg adaptation.
     """
     return slope_epoch_seconds_many(
         {"_": run_k}, k1=k1, k2=k2, trials=trials, min_delta_s=min_delta_s
@@ -186,6 +231,12 @@ def slope_epoch_seconds_many(
     k2 = 4*k1. If a cleaner later window shrinks the resolved delta back
     under min_delta_s, re-adapt (bounded) rather than publish an
     under-resolved slope.
+
+    k==0 CONTRACT (when ``min_delta_s > 0``): each run_k must treat
+    ``run_k(0)`` as a measurable constants probe — dispatch one fresh
+    trivial computation and read it back (probe_constants), never a plain
+    no-op return, or c0 is ~0 and the adaptation under-sizes the legs.
+    run_ks built by make_run_k implement this.
     """
     names = list(run_ks)
 
@@ -471,17 +522,41 @@ def crosscheck_whole_run_sps(precision="default", measured_sps=None, trials=3):
     return samples_per_epoch * epochs / best
 
 
+def _observed_backend():
+    """The platform that ACTUALLY measured, asked of the live backend in the
+    child — not inferred from env vars by the parent: the tunnel plugin's
+    sitecustomize forces jax_platforms='axon,cpu', so a child whose tunnel
+    init fails can silently fall back to host CPU while the parent's env
+    still says the accelerator was in play."""
+    import jax
+
+    plat = jax.devices()[0].platform
+    return "tpu" if plat in ("tpu", "axon") else plat
+
+
 def _measure_child(precisions):
     """Child mode: measure the precisions with interleaved trials (so the
     published pair shares contention windows), printing one flushed JSON
     line per result so a parent that must kill a wedged child can still
-    salvage output. If the interleaved pass fails (e.g. slope refusal in
-    one cell aborts it), fall back to independent per-cell measurement so
-    one cell's deterministic failure cannot take the others down."""
+    salvage output. Each line carries the OBSERVED backend platform. If the
+    interleaved pass fails (e.g. slope refusal in one cell aborts it), fall
+    back to independent per-cell measurement so one cell's deterministic
+    failure cannot take the others down."""
     try:
         res = jax_sps_many(precisions)
+        backend = _observed_backend()
         for precision, sps in res.items():
-            print(json.dumps({"precision": precision, "sps": sps}), flush=True)
+            print(
+                json.dumps(
+                    {
+                        "precision": precision,
+                        "sps": sps,
+                        "interleaved": True,
+                        "backend": backend,
+                    }
+                ),
+                flush=True,
+            )
         try:
             lb = crosscheck_whole_run_sps(
                 "default", measured_sps=res.get("default")
@@ -507,7 +582,20 @@ def _measure_child(precisions):
             )
             ok = False
             continue
-        print(json.dumps({"precision": precision, "sps": sps}), flush=True)
+        # interleaved=False: this cell was re-measured alone, so the
+        # default/highest pair no longer shares contention windows — a
+        # consumer must not trust the RATIO between such cells
+        print(
+            json.dumps(
+                {
+                    "precision": precision,
+                    "sps": sps,
+                    "interleaved": False,
+                    "backend": _observed_backend(),
+                }
+            ),
+            flush=True,
+        )
     sys.exit(0 if ok else 4)
 
 
@@ -519,7 +607,9 @@ def _run_measurements(precisions, timeout_s, attempts=2, force_cpu=False):
     driver would record nothing. Isolating it in a killable child with
     per-result flushed output bounds the damage to ``attempts * timeout_s``
     and keeps any results completed before the wedge. Returns
-    ``{precision: sps}`` for whatever succeeded.
+    ``{precision: sps}`` for whatever succeeded, plus per-cell provenance
+    in ``meta`` (``interleaved``: whether the cell came from the interleaved
+    same-window pass; ``backend``: which platform measured it).
 
     stdout/stderr go to FILES, never pipes (same grandchild-survives-kill
     hazard as in _ensure_responsive_backend).
@@ -530,7 +620,8 @@ def _run_measurements(precisions, timeout_s, attempts=2, force_cpu=False):
     if force_cpu:
         env.pop("PALLAS_AXON_POOL_IPS", None)  # ungate the tunnel plugin
         env["JAX_PLATFORMS"] = "cpu"
-    results, errors = {}, {}
+    backend = "cpu" if (force_cpu or not env.get("PALLAS_AXON_POOL_IPS")) else "tpu"
+    results, errors, meta = {}, {}, {}
     saw_timeout = False
     for _ in range(attempts):
         missing = [p for p in precisions if p not in results]
@@ -564,6 +655,12 @@ def _run_measurements(precisions, timeout_s, attempts=2, force_cpu=False):
                     results["_crosscheck"] = rec["crosscheck_whole_run_sps"]
                 elif "sps" in rec:
                     results[rec["precision"]] = rec["sps"]
+                    meta[rec["precision"]] = {
+                        "interleaved": bool(rec.get("interleaved", True)),
+                        # prefer the child's OBSERVED platform; the env-based
+                        # guess only covers legacy lines without the field
+                        "backend": rec.get("backend", backend),
+                    }
                     errors.pop(rec["precision"], None)
                 elif "error" in rec:
                     errors[rec["precision"]] = rec["error"]
@@ -574,7 +671,7 @@ def _run_measurements(precisions, timeout_s, attempts=2, force_cpu=False):
                     print(f"bench: child stderr: {tail[-1]}", file=sys.stderr)
     for precision, err in errors.items():
         print(f"bench: {precision} measurement raised: {err}", file=sys.stderr)
-    return results, saw_timeout, errors
+    return results, saw_timeout, errors, meta
 
 
 def main():
@@ -589,7 +686,7 @@ def main():
     # The fp32-HIGHEST number (the bitwise-NumPy-parity config) is also
     # measured and reported alongside.
     precisions = ("default", "highest")
-    results, saw_timeout, errors = _run_measurements(precisions, timeout_s=900)
+    results, saw_timeout, errors, meta = _run_measurements(precisions, timeout_s=900)
     if "default" not in results and not fallback_tag:
         # the headline cell failed on the accelerator on every attempt: a
         # degraded CPU number with an unmistakable tag beats recording
@@ -607,15 +704,31 @@ def main():
             file=sys.stderr,
         )
         missing = tuple(p for p in precisions if p not in results)
-        cpu_results, _, _ = _run_measurements(
+        cpu_results, _, _, cpu_meta = _run_measurements(
             missing, timeout_s=900, attempts=1, force_cpu=True
         )
         results.update(cpu_results)
+        meta.update(cpu_meta)
     value = results.get("default")
     value_fp32 = results.get("highest")
     if value is None:
         print("bench: no measurement succeeded on any backend", file=sys.stderr)
         sys.exit(1)
+    # the OBSERVED backend outranks the probe: a child whose tunnel init
+    # failed after a healthy probe silently measures on host CPU (reported
+    # via _observed_backend) — that degraded number must carry a fallback
+    # tag even though no parent-side probe or timeout ever fired
+    if (
+        not fallback_tag
+        and os.environ.get("PALLAS_AXON_POOL_IPS")
+        and meta.get("default", {}).get("backend") == "cpu"
+    ):
+        fallback_tag = "_CPU_FALLBACK_CHILD_BACKEND_DEGRADED"
+        print(
+            "bench: measurement child reported backend=cpu despite an active "
+            "tunnel env; tagging metric as a CPU fallback",
+            file=sys.stderr,
+        )
     # a degraded run is unmistakable in the recorded metric itself
     metric = "mnist_mlp_train_samples_per_sec_per_chip" + fallback_tag
     # physical plausibility guard: if the implied FLOP rate exceeds anything a
@@ -668,6 +781,19 @@ def main():
                 ),
                 "whole_run_crosscheck_sps": (
                     None if crosscheck is None else round(crosscheck, 1)
+                ),
+                # per-cell provenance: which platform measured each value, and
+                # whether the default/highest pair shares contention windows
+                # (interleaved trials on the same backend). A same_window=false
+                # pair's RATIO is untrustworthy even when both values are.
+                "value_backend": meta.get("default", {}).get("backend"),
+                "value_fp32_backend": meta.get("highest", {}).get("backend"),
+                "same_window": bool(
+                    value_fp32 is not None
+                    and meta.get("default", {}).get("interleaved")
+                    and meta.get("highest", {}).get("interleaved")
+                    and meta.get("default", {}).get("backend")
+                    == meta.get("highest", {}).get("backend")
                 ),
             }
         )

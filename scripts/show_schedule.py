@@ -22,6 +22,7 @@ from shallowspeed_tpu.parallel.lowering import (  # noqa: E402
     OP_BWD,
     OP_FWD,
     lower_schedule,
+    utilization,
 )
 
 ALL = {**S.SCHEDULES, "inference": S.InferenceSchedule}
@@ -32,7 +33,6 @@ def render(name, M, stages, virtual=1):
     # interleaved cells carry the virtual chunk as a suffix: F2'1 = forward
     # of microbatch 2, chunk 1
     width = max(2, len(str(M - 1)) + 1) + (2 if virtual > 1 else 0)
-    busy = 0
     lines = []
     for s in range(stages):
         cells = []
@@ -41,14 +41,12 @@ def render(name, M, stages, virtual=1):
             ck = f"'{int(prog.chunk[t, s])}" if virtual > 1 else ""
             if op == OP_FWD:
                 cells.append(f"F{mb}{ck}".ljust(width))
-                busy += 1
             elif op == OP_BWD:
                 cells.append(f"B{mb}{ck}".ljust(width))
-                busy += 1
             else:
                 cells.append(".".ljust(width))
         lines.append(f"stage {s} │ " + " ".join(cells))
-    util = busy / (prog.num_ticks * stages)
+    util = utilization(prog)
     vtag = f" V={virtual}" if virtual > 1 else ""
     header = (
         f"{name}  M={M} S={stages}{vtag}: {prog.num_ticks} ticks, "

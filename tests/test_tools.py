@@ -23,6 +23,30 @@ def test_show_schedule_renders_all(capsys):
     assert "gpipe  M=4 S=4: 14 ticks" in out
 
 
+def test_utilization_matches_documented_bubble_figures():
+    """The docs' bubble-shrink claims (docs/lowering.md: flat 1F1B 57% vs
+    interleaved V=2 73% at P=4, M=4; GPipe M/(M+S-1) per phase) must be
+    computable from the lowered tick tables, not hand-written prose."""
+    from shallowspeed_tpu import schedules as S
+    from shallowspeed_tpu.parallel.lowering import lower_schedule, utilization
+
+    flat = lower_schedule(S.PipeDreamFlushSchedule, 4, 4)
+    inter = lower_schedule(S.InterleavedSchedule, 4, 4, virtual=2)
+    gpipe = lower_schedule(S.GPipeSchedule, 4, 4)
+    # exact active-cell counts: every device computes V*M forwards + V*M
+    # backwards, so active = P * 2*V*M cells out of num_ticks * P
+    assert utilization(flat) == (2 * 4 * 4) / (flat.num_ticks * 4)
+    assert utilization(inter) == (2 * 2 * 4 * 4) / (inter.num_ticks * 4)
+    # the documented headline figures
+    assert round(utilization(flat) * 100) == 57
+    assert round(utilization(gpipe) * 100) == 57
+    assert round(utilization(inter) * 100) == 73
+    assert utilization(inter) > utilization(flat)  # the V-fold fill shrink
+    # inference relay: M/(M+S-1) utilization exactly
+    inf = lower_schedule(S.InferenceSchedule, 4, 4)
+    assert abs(utilization(inf) - 4 / (4 + 4 - 1)) < 1e-12
+
+
 def test_train_cli_help():
     r = subprocess.run(
         [sys.executable, str(ROOT / "train.py"), "--help"],
@@ -115,12 +139,15 @@ def test_bench_watchdog_salvage_and_error_protocol(monkeypatch, tmp_path):
         "sys.exit(4)\n"
     )
     monkeypatch.setattr(bench, "__file__", str(child))
-    results, saw_timeout, errors = bench._run_measurements(
+    results, saw_timeout, errors, meta = bench._run_measurements(
         ("default", "highest"), timeout_s=30, attempts=2
     )
     assert results == {"default": 123.0}
     assert not saw_timeout  # a crash is NOT a wedge
     assert "boom" in errors.get("highest", "")
+    # provenance: no tunnel env in tests -> backend recorded as cpu; a line
+    # without an explicit interleaved field defaults to True (legacy lines)
+    assert meta["default"] == {"interleaved": True, "backend": "cpu"}
 
 
 def test_bench_watchdog_timeout_is_flagged(monkeypatch, tmp_path):
@@ -135,7 +162,7 @@ def test_bench_watchdog_timeout_is_flagged(monkeypatch, tmp_path):
         "time.sleep(60)\n"
     )
     monkeypatch.setattr(bench, "__file__", str(child))
-    results, saw_timeout, errors = bench._run_measurements(
+    results, saw_timeout, errors, meta = bench._run_measurements(
         ("default", "highest"), timeout_s=3, attempts=1
     )
     assert results == {"default": 7.0}  # flushed before the hang — salvaged
