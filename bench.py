@@ -551,7 +551,11 @@ def _measure_child(precisions):
                     {
                         "precision": precision,
                         "sps": sps,
-                        "interleaved": True,
+                        # a cell is same-window pairable only if THIS pass
+                        # measured more than one precision: a retry child
+                        # that measured a lone missing cell is in a
+                        # different contention window than its partner
+                        "interleaved": len(res) > 1,
                         "backend": backend,
                     }
                 ),

@@ -45,6 +45,24 @@ sys.path.insert(0, str(ROOT))
 import bench  # the probe + the NumPy baseline + the headline protocol
 
 
+def _measure_salvaged(run_ks, trials, samples_per_epoch):
+    """The one measure-and-salvage policy for interleaved cell groups: run
+    the same-window slope estimator with a failures dict (one unresolvable
+    cell must not abort the capture), print + stringify the unresolved
+    cells for the artifact, convert resolved slopes to samples/s. Returns
+    ``(cells, unresolved)``; raise-on-empty is the CALLER's policy (the
+    headline sweep needs a best cell; phase 5c can record an empty group)."""
+    failures = {}
+    slopes = bench.slope_epoch_seconds_many(run_ks, trials=trials, failures=failures)
+    for name, err in failures.items():
+        print(f"  UNRESOLVED {name}: {err}", flush=True)
+    out = {}
+    for name, slope in slopes.items():
+        out[name] = round(samples_per_epoch / slope, 1)
+        print(f"  {name}: {out[name]:,.0f} samples/s", flush=True)
+    return out, {name: str(err) for name, err in failures.items()}
+
+
 def headline_sweep(unrolls, trials, precision="highest"):
     """Scan-unroll sweep of the fused sequential epoch, all unroll variants'
     trials interleaved (bench.slope_epoch_seconds_many) so the sweep is a
@@ -78,29 +96,62 @@ def headline_sweep(unrolls, trials, precision="highest"):
             fuse_mubatches=True, unroll=unroll,
         )
         run_ks[f"unroll={unroll}"] = bench.make_run_k(epoch, params, (), X, Y)
-    # failures={}: one unresolvable unroll cell (contention) must not abort
-    # the capture's remaining phases — salvage whatever resolved, same policy
-    # as run_matrix in phases 5/5b. Only an entirely-empty sweep is fatal.
-    failures = {}
-    slopes = bench.slope_epoch_seconds_many(run_ks, trials=trials, failures=failures)
-    for name, err in failures.items():
-        print(f"  headline fused {precision} {name}: UNRESOLVED ({err})", flush=True)
-    if not slopes:
-        raise RuntimeError(
-            f"headline sweep ({precision}): every unroll cell unresolved: {failures}"
-        )
-    out = {}
-    for name, slope in slopes.items():
-        sps = nb * B / slope
-        out[name] = round(sps, 1)
-        print(
-            f"  headline fused {precision} {name}: {sps:,.0f} samples/s",
-            flush=True,
-        )
     # unresolved cells go into the artifact too: a partial sweep must be
     # distinguishable from a complete one (best-of-sweep over different cell
     # sets is not comparable across captures)
-    return out, {name: str(err) for name, err in failures.items()}
+    out, unresolved = _measure_salvaged(run_ks, trials, nb * B)
+    if not out:
+        raise RuntimeError(
+            f"headline sweep ({precision}): every unroll cell unresolved: {unresolved}"
+        )
+    return out, unresolved
+
+
+def executor_backend_cells(nb, trials):
+    """Pipeline-executor epoch on one chip (dp=pp=1 degenerate pipeline —
+    the tick scan, stacked params and mailbox machinery run for real): XLA
+    vs Pallas kernel backends (executor.make_pipeline_step(kernel_backend=))
+    at both precision classes, interleaved so every ratio is same-window.
+    The pallas cells compile the flag-operand kernels non-interpret."""
+    import jax
+    import jax.numpy as jnp
+
+    from shallowspeed_tpu import model as Mo
+    from shallowspeed_tpu import schedules as S
+    from shallowspeed_tpu.api import (
+        FLAGSHIP_BATCH as B,
+        FLAGSHIP_LR as LR,
+        FLAGSHIP_MUBATCHES as M,
+        FLAGSHIP_SIZES as SIZES,
+        PRECISIONS,
+    )
+    from shallowspeed_tpu.optimizer import SGD
+    from shallowspeed_tpu.parallel import executor as E, lower_schedule, make_mesh
+
+    mesh = make_mesh(1, 1)
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    prog = lower_schedule(S.GPipeSchedule, M, 1)
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.rand(nb, B, SIZES[0]).astype(np.float32))
+    Y = jnp.asarray(
+        np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, B))]
+    )
+    run_ks = {}
+    for prec in ("default", "highest"):
+        for kb in ("xla", "pallas"):
+            epoch = E.make_pipeline_epoch(
+                mesh, spec, prog, B // M, SGD(LR),
+                precision=PRECISIONS[prec], kernel_backend=kb,
+            )
+            stacked, flags = E.init_stacked(spec, mesh)
+
+            def fn(p, s, X, Y, _epoch=epoch, _flags=flags):
+                return _epoch(p, _flags, s, X, Y)
+
+            key = f"executor+{prec}+{kb}"
+            run_ks[key] = bench.make_run_k(fn, stacked, (), X, Y)
+            print(f"  built {key}", file=sys.stderr, flush=True)
+    return _measure_salvaged(run_ks, trials, nb * B)
 
 
 def convergence_run(data_dir, epochs):
@@ -173,6 +224,24 @@ def profile_one_epoch(data_dir, trace_dir):
         run.train_epoch()
     files = [str(p.relative_to(trace_dir)) for p in Path(trace_dir).rglob("*") if p.is_file()]
     print(f"  trace: {len(files)} files in {trace_dir}", flush=True)
+    return {"dir": str(trace_dir), "n_files": len(files)}
+
+
+def profile_headline_epoch(trace_dir):
+    """Trace one post-compile epoch of the HEADLINE config (fused +
+    default precision — what `python bench.py` publishes), feeding the
+    roofline analysis in docs/performance.md with per-op numbers for the
+    exact program being scored."""
+    import jax
+
+    epoch, params, X, Y = bench._jax_epoch_setup("default")
+    params, st, _ = epoch(params, (), X, Y)  # compile + warm
+    bench.sync_readback(params)
+    with jax.profiler.trace(str(trace_dir)):
+        params, st, _ = epoch(params, st, X, Y)
+        bench.sync_readback(params)
+    files = [str(p.relative_to(trace_dir)) for p in Path(trace_dir).rglob("*") if p.is_file()]
+    print(f"  headline trace: {len(files)} files in {trace_dir}", flush=True)
     return {"dir": str(trace_dir), "n_files": len(files)}
 
 
@@ -255,6 +324,11 @@ def main():
     print("4) profiler trace...", flush=True)
     result["trace"] = profile_one_epoch(args.data_dir, ROOT / "artifacts" / "tpu_trace")
     checkpoint_result()
+    print("4b) headline-config (fused+default) trace...", flush=True)
+    result["trace_headline"] = profile_headline_epoch(
+        ROOT / "artifacts" / "tpu_trace_headline"
+    )
+    checkpoint_result()
 
     print("5) tuning matrix (interleaved cells, same-window ratios; "
           "pallas compiles — the risky phase — run last)...", flush=True)
@@ -278,6 +352,16 @@ def main():
         matrix_full["+".join(key)] = round(sps, 1)
         print(f"  {'+'.join(key)}: {sps:,.0f} samples/s", flush=True)
     result["matrix_full_epoch_fused"] = matrix_full
+    checkpoint_result()
+
+    print("5c) pipeline-executor kernel backends (xla vs pallas flag "
+          "kernels, dp=pp=1, same-window)...", flush=True)
+    exec_cells, exec_unresolved = executor_backend_cells(
+        29 if args.quick else 116, 2
+    )
+    result["executor_kernel_backends"] = exec_cells
+    if exec_unresolved:
+        result["executor_kernel_backends_unresolved"] = exec_unresolved
     result["completed_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
     checkpoint_result()
     partial_path.rename(args.out)

@@ -31,11 +31,17 @@ Two regimes, auto-selected per shape at trace time:
 
 Enable with SHALLOWSPEED_PALLAS=1 (or ``ops.set_pallas(True)``); off-TPU the
 kernels run in interpreter mode, so the same tests cover CPU CI and real
-hardware. Scope note: the flag applies to the SEQUENTIAL model path
-(model.stage_forward/backward). The pipeline executor keeps the pure-XLA
-path: its layer loop selects relu/identity behavior with traced per-device
-flags, so a statically-fused relu kernel cannot be slotted in without
-specializing the program per stage.
+hardware. The flag applies to the SEQUENTIAL model path
+(model.stage_forward/backward).
+
+The PIPELINE EXECUTOR has its own kernel pair (``linear_flag_fwd`` /
+``linear_flag_bwd``): its layer loop selects relu/identity behavior with
+TRACED per-device flags (flags["relu"] picked per virtual chunk), so the
+statically-fused relu kernels above can't be slotted in. The flag kernels
+are branch-free — the relu flag rides in as an SMEM scalar operand and the
+activation is ``where(flag, max(z, 0), z)`` on the VPU — so ONE compiled
+kernel serves every stage, chunk and schedule. Executor opt-in:
+``make_pipeline_step(..., kernel_backend="pallas")``.
 """
 
 import functools
@@ -316,3 +322,96 @@ def linear_relu_bwd(g, mask, x, w, precision=None):
     if _bwd_bytes(mb, din, dout) <= SINGLE_BLOCK_BUDGET_BYTES:
         return _linear_relu_bwd_single(g, mask, x, w, precision)
     return linear_relu_bwd_tiled(g, mask, x, w, tile=TILE, precision=precision)
+
+
+# ---------------------------------------------------------------------------
+# Flag-operand kernels for the pipeline executor (traced relu selection)
+# ---------------------------------------------------------------------------
+
+
+def _flag_fwd_kernel(flag_ref, x_ref, w_ref, b_ref, y_ref, mask_ref, *, precision):
+    # branch-free relu selection: flag is an SMEM scalar, the select runs on
+    # the VPU — one compiled kernel serves relu AND identity layers, which is
+    # what lets the executor's chunk-uniform layer loop call it with a
+    # traced per-(stage, slot) flag
+    z = (
+        jnp.dot(
+            x_ref[:], w_ref[:].T,
+            precision=precision, preferred_element_type=jnp.float32,
+        )
+        + b_ref[:]
+    )
+    mask_ref[:] = (z > 0.0).astype(jnp.float32)
+    y_ref[:] = jnp.where(flag_ref[0] != 0, jnp.maximum(z, 0.0), z)
+
+
+def linear_flag_fwd(x, w, b2, flag, precision=None):
+    """Executor forward unit: ``(y, mask)`` with ``y = relu(z) if flag else
+    z``, ``z = x @ w.T + b``, ``mask = z > 0`` (f32). ``flag`` is a TRACED
+    scalar (the executor's per-slot relu flag picked per virtual chunk);
+    single-block (the executor's stage shapes are the flagship regime —
+    the caller guards with ``flag_kernels_fit``)."""
+    mb, _ = x.shape
+    dout = w.shape[0]
+    return pl.pallas_call(
+        functools.partial(_flag_fwd_kernel, precision=precision),
+        out_shape=(
+            jax.ShapeDtypeStruct((mb, dout), jnp.float32),
+            jax.ShapeDtypeStruct((mb, dout), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        interpret=_interpret(),
+    )(jnp.reshape(flag, (1,)).astype(jnp.int32), x, w, b2)
+
+
+def _flag_bwd_kernel(
+    flag_ref, g_ref, mask_ref, x_ref, w_ref, dx_ref, dw_ref, db_ref, *, precision
+):
+    ge = jnp.where(flag_ref[0] != 0, g_ref[:] * mask_ref[:], g_ref[:])
+    dx_ref[:] = jnp.dot(
+        ge, w_ref[:], precision=precision, preferred_element_type=jnp.float32
+    )
+    dw_ref[:] = jnp.dot(
+        ge.T, x_ref[:], precision=precision, preferred_element_type=jnp.float32
+    )
+    db_ref[:] = jnp.sum(ge, axis=0, keepdims=True)
+
+
+def linear_flag_bwd(g, mask, x, w, flag, precision=None):
+    """Executor backward unit: ``(dx, dw, db)`` of linear_flag_fwd — the
+    relu-mask multiply is applied iff ``flag`` (traced), then all three
+    gradients come from one VMEM residency."""
+    mb, dout = g.shape
+    din = x.shape[1]
+    return pl.pallas_call(
+        functools.partial(_flag_bwd_kernel, precision=precision),
+        out_shape=(
+            jax.ShapeDtypeStruct((mb, din), jnp.float32),
+            jax.ShapeDtypeStruct((dout, din), jnp.float32),
+            jax.ShapeDtypeStruct((1, dout), jnp.float32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 4,
+        out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
+        interpret=_interpret(),
+    )(jnp.reshape(flag, (1,)).astype(jnp.int32), g, mask, x, w)
+
+
+def flag_kernels_fit(mb, din, dout):
+    """True when a (mb, din) x (dout, din) layer fits the single-block
+    budget for BOTH flag kernels (the executor checks every slot's padded
+    dims at build time and refuses the pallas backend otherwise — grid
+    tiling for the executor path is not implemented)."""
+    return (
+        _fwd_bytes(mb, din, dout) <= SINGLE_BLOCK_BUDGET_BYTES
+        and _bwd_bytes(mb, din, dout) <= SINGLE_BLOCK_BUDGET_BYTES
+    )

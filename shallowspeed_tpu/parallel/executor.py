@@ -364,29 +364,54 @@ def _fit(a, width):
     return jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, width - cur)])
 
 
-def _stage_fwd(Ws, bs, active, relu, dims, x, precision):
+def _stage_fwd(Ws, bs, active, relu, dims, x, precision, kernel_backend="xla"):
     """Forward through the per-slot stacks; returns (out, xs, masks) where
-    xs[l]: (mb, in_l) and masks[l]: (mb, out_l)."""
+    xs[l]: (mb, in_l) and masks[l]: (mb, out_l).
+
+    ``kernel_backend="pallas"`` runs each slot as one fused Pallas unit
+    (pallas_ops.linear_flag_fwd): the traced relu flag rides into the kernel
+    as a scalar operand, so the chunk-uniform layer loop needs no static
+    per-stage specialization. Same math (flag-selected relu on z = x@w.T+b,
+    mask = z > 0) either way."""
     xs, masks = [], []
     for l, (o, i) in enumerate(dims):
         x_l = _fit(x, i)
-        y = ops.linear(x_l, Ws[l], bs[l], precision=precision)
-        xs.append(x_l)
-        masks.append(y > 0)
-        y_act = jnp.where(relu[l], ops.relu(y), y)
+        if kernel_backend == "pallas":
+            from shallowspeed_tpu import pallas_ops
+
+            y_act, mask_f = pallas_ops.linear_flag_fwd(
+                x_l, Ws[l], jnp.reshape(bs[l], (1, -1)), relu[l],
+                precision=precision,
+            )
+            xs.append(x_l)
+            masks.append(mask_f > 0)
+        else:
+            y = ops.linear(x_l, Ws[l], bs[l], precision=precision)
+            xs.append(x_l)
+            masks.append(y > 0)
+            y_act = jnp.where(relu[l], ops.relu(y), y)
         x = jnp.where(active[l], y_act, _fit(x_l, o))
     return x, tuple(xs), tuple(masks)
 
 
-def _stage_bwd(Ws, active, relu, dims, xs, masks, g, precision):
+def _stage_bwd(Ws, active, relu, dims, xs, masks, g, precision, kernel_backend="xla"):
     """Backward through the per-slot stacks; returns (dx, gWs, gbs)."""
     L = len(dims)
     gWs, gbs = [None] * L, [None] * L
     for l in reversed(range(L)):
         o, i = dims[l]
         g_l = _fit(g, o)
-        g_eff = jnp.where(relu[l], g_l * masks[l], g_l)
-        dx, dw, db = ops.linear_grad(g_eff, xs[l], Ws[l], precision=precision)
+        if kernel_backend == "pallas":
+            from shallowspeed_tpu import pallas_ops
+
+            dx, dw, db2 = pallas_ops.linear_flag_bwd(
+                g_l, masks[l].astype(jnp.float32), xs[l], Ws[l], relu[l],
+                precision=precision,
+            )
+            db = jnp.reshape(db2, (-1,))
+        else:
+            g_eff = jnp.where(relu[l], g_l * masks[l], g_l)
+            dx, dw, db = ops.linear_grad(g_eff, xs[l], Ws[l], precision=precision)
         gWs[l] = jnp.where(active[l], dw, 0.0)
         gbs[l] = jnp.where(active[l], db, 0.0)
         g = jnp.where(active[l], dx, _fit(g_l, i))
@@ -404,6 +429,7 @@ def make_pipeline_step(
     tick_unroll=1,
     zero1=False,
     clip_norm=None,
+    kernel_backend="xla",
 ):
     """Build the jitted SPMD step executing one TickProgram over the mesh.
 
@@ -435,8 +461,27 @@ def make_pipeline_step(
     schedule's real peak activation memory is its scheduling property:
     GPipe allocates M slots, PipeDream-Flush min(M, depth) — the 1F1B memory
     advantage is physical buffer sizes here, not just a diagram.
+
+    ``kernel_backend``: "xla" (default) or "pallas" — the per-slot compute
+    unit inside every tick. "pallas" uses the flag-operand fused kernels
+    (pallas_ops.linear_flag_fwd/bwd; the traced relu flag is a kernel
+    operand, so one kernel serves every stage/chunk). Single-block only:
+    every slot's (mubatch, in, out) must fit the VMEM budget, validated
+    here at build time.
     """
+    if kernel_backend not in ("xla", "pallas"):
+        raise ValueError(f"unknown kernel_backend {kernel_backend!r}")
     dims = slot_shapes(spec)
+    if kernel_backend == "pallas":
+        from shallowspeed_tpu import pallas_ops
+
+        for o, i in dims:
+            if not pallas_ops.flag_kernels_fit(mubatch_size, i, o):
+                raise ValueError(
+                    f"kernel_backend='pallas': slot ({mubatch_size}, {i})x"
+                    f"({o}, {i}) exceeds the single-block VMEM budget; "
+                    "use the 'xla' backend for this shape"
+                )
     S_, L = spec.n_stages, len(dims)
     D_in, D_out = dims[0][1], dims[-1][0]
     W_rel = relay_width(spec)  # ppermute payload / mailbox width (<= D_in)
@@ -558,7 +603,7 @@ def make_pipeline_step(
                     load_in, x[mb_r], _fit(c["fwd_mail"][row["rf"][stage]], D_in)
                 )
                 out, xs_l, masks_l = _stage_fwd(
-                    Ws, bs, active, relu, dims, x_in, precision
+                    Ws, bs, active, relu, dims, x_in, precision, kernel_backend
                 )
                 c = dict(c)
                 p = ops.softmax(out, valid_mask=head_mask[None, :])
@@ -595,7 +640,8 @@ def make_pipeline_step(
                 xs_r = tuple(buf[sr] for buf in c["xs"])
                 masks_r = tuple(buf[sr] for buf in c["masks"])
                 dx, gW_d, gb_d = _stage_bwd(
-                    Ws, active, relu, dims, xs_r, masks_r, g_in, precision
+                    Ws, active, relu, dims, xs_r, masks_r, g_in, precision,
+                    kernel_backend,
                 )
                 c = dict(c)
                 if V == 1:
@@ -781,6 +827,7 @@ def make_pipeline_epoch(
     tick_unroll=1,
     zero1=False,
     clip_norm=None,
+    kernel_backend="xla",
 ):
     """Scan the pipeline train step over all batches of an epoch: one XLA
     program per epoch. X: (num_batches, global_batch, in_dim), batch axis
@@ -788,10 +835,13 @@ def make_pipeline_epoch(
     opt_state, mean_loss)``. ``unroll``/``tick_unroll``: lax.scan unroll
     factors for the batch loop / the per-tick loop (throughput knobs,
     identical numerics); ``zero1`` shards the optimizer update over dp;
-    ``clip_norm`` clips the global gradient norm before each update."""
+    ``clip_norm`` clips the global gradient norm before each update;
+    ``kernel_backend`` selects the per-slot compute unit (see
+    make_pipeline_step)."""
     step = make_pipeline_step(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
         tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
+        kernel_backend=kernel_backend,
     )
     return jax.jit(_make_pipeline_epoch_core(step, unroll), donate_argnums=(0, 2))
 
@@ -828,6 +878,7 @@ def make_pipeline_run(
     clip_norm=None,
     eval_prog=None,
     eval_mubatch_size=None,
+    kernel_backend="xla",
 ):
     """Epochs-outer scan around the pipeline epoch: the whole multi-epoch run
     as ONE XLA program over the mesh (the pipeline counterpart of
@@ -848,12 +899,13 @@ def make_pipeline_run(
     step = make_pipeline_step(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
         tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
+        kernel_backend=kernel_backend,
     )
     eval_step = None
     if eval_prog is not None:
         eval_step = make_pipeline_step(
             mesh, spec, eval_prog, eval_mubatch_size, precision=precision,
-            jit=False,
+            jit=False, kernel_backend=kernel_backend,
         )
     out_dim = spec.out_dim
     epoch_core = _make_pipeline_epoch_core(step, unroll)

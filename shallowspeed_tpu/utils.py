@@ -56,6 +56,63 @@ def assert_dp_replicas_in_sync(arr) -> None:
         raise ValueError(f"replica desync detected at shards: {mismatches}")
 
 
+def assert_dp_replicas_in_sync_global(arr) -> None:
+    """Multi-process extension of ``assert_dp_replicas_in_sync``.
+
+    One process can only hash the shards it can address, so on a
+    process-spanning mesh the local assert never compares the replicas that
+    live on OTHER hosts. Here every process hashes its addressable shards
+    (first 8 bytes of the SHA1, as two uint32 lanes — uint64 would be
+    silently truncated under JAX's default x64-disabled mode), the
+    per-device hash vectors are summed across processes with
+    ``multihost_utils.process_allgather`` (each device slot is filled by
+    exactly one process), and devices holding the same logical shard index
+    are compared — the cross-host analogue of the reference's
+    gather-hashes-over-the-dp-communicator check (utils.py:27-31). Raises
+    on desync, on every process.
+    """
+    if jax.process_count() == 1:
+        return assert_dp_replicas_in_sync(arr)
+    from jax.experimental import multihost_utils
+
+    leaves = [x for x in jax.tree.leaves(arr) if isinstance(x, jax.Array)]
+    vecs, groups = [], []
+    for li, x in enumerate(leaves):
+        # identical on all processes: the full device->shard-index map
+        dev_index = sorted(
+            x.sharding.devices_indices_map(x.shape).items(),
+            key=lambda kv: kv[0].id,
+        )
+        pos_of = {d.id: p for p, (d, _) in enumerate(dev_index)}
+        v = np.zeros((len(dev_index), 2), np.uint32)
+        for shard in x.addressable_shards:
+            h = sha1(np.ascontiguousarray(shard.data).tobytes()).digest()
+            # +1 so a real hash can't collide with the "not mine" sentinel 0
+            v[pos_of[shard.device.id], 0] = np.uint32(
+                int.from_bytes(h[:4], "big") % (2**32 - 1) + 1
+            )
+            v[pos_of[shard.device.id], 1] = np.uint32(int.from_bytes(h[4:8], "big"))
+        vecs.append(v)
+        by_index = {}
+        for p, (d, idx) in enumerate(dev_index):
+            by_index.setdefault(str(idx), []).append(p)
+        groups.append((li, by_index))
+    summed = [
+        np.asarray(g).sum(axis=0, dtype=np.uint64)
+        for g in multihost_utils.process_allgather(vecs)
+    ]
+    mismatches = []
+    for (li, by_index), total in zip(groups, summed):
+        for idx, positions in by_index.items():
+            hashes = {(int(total[p, 0]), int(total[p, 1])) for p in positions}
+            if len(hashes) > 1:
+                mismatches.append((li, idx))
+    if mismatches:
+        raise ValueError(
+            f"cross-process replica desync at (leaf, shard-index): {mismatches}"
+        )
+
+
 def p0print(*args, **kwargs):
     """Print from process 0 only (reference rprint, utils.py:8-10)."""
     if jax.process_index() == 0:

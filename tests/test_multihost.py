@@ -1,8 +1,9 @@
-"""Multi-host tests: single-process no-op semantics AND a real 2-process
-``jax.distributed`` run (localhost coordinator, CPU backend) that exercises
-cross-process collectives + the pipeline executor over a process-spanning
-mesh — the environment's stand-in for the reference's ``mpirun -n N``
-multi-process mode (reference train.py:87-94)."""
+"""Multi-host tests: single-process no-op semantics AND real multi-process
+``jax.distributed`` runs (localhost coordinator, CPU backend) — a 2-process
+fleet exercising cross-process collectives + the pipeline executor, and a
+4-process 2x2 mesh where every axis crosses process boundaries with
+cross-process replica-sync verification. The environment's stand-in for the
+reference's ``mpirun -n N`` multi-process mode (reference train.py:87-94)."""
 
 import json
 import os
@@ -35,13 +36,10 @@ def test_shard_batch_for_process_places_on_mesh():
     assert len({s.index for s in arr.addressable_shards}) == 2
 
 
-def test_two_process_distributed_training_step():
-    """Spawn 2 cooperating processes that form a 4-device global runtime and
-    run a cross-process psum + pipeline training steps (flat GPipe and
-    interleaved virtual stages — see _multihost_worker.py). Verifies
-    multihost.initialize, process-local batch feeding, and that both
-    processes agree on the (replicated) losses."""
-    worker = Path(__file__).parent / "_multihost_worker.py"
+def _run_worker_fleet(worker, n_procs, timeout=240):
+    """Spawn ``n_procs`` cooperating jax.distributed workers on a fresh
+    localhost coordinator port and collect one JSON line from each; retries
+    on the (racy) port pick. Returns (outs, errs); outs is None on failure."""
     env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
 
     def attempt():
@@ -58,13 +56,13 @@ def test_two_process_distributed_training_step():
                 env=env,
                 text=True,
             )
-            for pid in range(2)
+            for pid in range(n_procs)
         ]
         outs, errs = [], []
         try:
             for p in procs:
                 try:
-                    out, err = p.communicate(timeout=240)
+                    out, err = p.communicate(timeout=timeout)
                 except subprocess.TimeoutExpired:
                     # e.g. workers connected to a port-race winner and hung —
                     # kill and let the caller retry on a fresh port
@@ -87,8 +85,39 @@ def test_two_process_distributed_training_step():
         if outs is not None:
             break
     assert outs is not None, f"workers failed 3x:\n{errs[-1][-3000:]}"
+    return outs
+
+
+def test_two_process_distributed_training_step():
+    """Spawn 2 cooperating processes that form a 4-device global runtime and
+    run a cross-process psum + pipeline training steps (flat GPipe and
+    interleaved virtual stages — see _multihost_worker.py). Verifies
+    multihost.initialize, process-local batch feeding, and that both
+    processes agree on the (replicated) losses."""
+    outs = _run_worker_fleet(Path(__file__).parent / "_multihost_worker.py", 2)
     assert all(o["psum_ok"] for o in outs)
     for key in ("loss", "loss_z", "loss_i", "loss_run"):
         losses = sorted((o["pid"], o[key]) for o in outs)
         assert losses[0][1] == pytest.approx(losses[1][1], rel=1e-6)
         assert np.isfinite(losses[0][1]) and losses[0][1] > 0
+
+
+def test_four_process_2x2_mesh_cross_process_sync():
+    """4 processes x 1 device: a 2x2 mesh where BOTH axes cross process
+    boundaries (dp psum across {0,2}/{1,3}, tick ppermutes across
+    {0,1}/{2,3}) — the layout a real pod runs. Two stateful training steps
+    with utils.assert_dp_replicas_in_sync_global after each (each process
+    sees one device, so only the cross-process check compares anything),
+    plus the negative control: an injected process-divergent array must be
+    DETECTED by the checker on every process (see _multihost_worker4.py)."""
+    outs = _run_worker_fleet(
+        Path(__file__).parent / "_multihost_worker4.py", 4, timeout=300
+    )
+    assert len(outs) == 4
+    assert all(o["sync_ok"] for o in outs)
+    assert all(o["desync_detected"] for o in outs)
+    for key in ("loss", "loss2"):
+        vals = [o[key] for o in outs]
+        assert all(v == pytest.approx(vals[0], rel=1e-6) for v in vals)
+        assert np.isfinite(vals[0]) and vals[0] > 0
+    assert outs[0]["loss2"] < outs[0]["loss"]  # training actually progressed

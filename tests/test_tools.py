@@ -47,6 +47,27 @@ def test_utilization_matches_documented_bubble_figures():
     assert abs(utilization(inf) - 4 / (4 + 4 - 1)) < 1e-12
 
 
+def test_trace_stats_reproduces_roofline_numbers():
+    """docs/performance.md's latency-roofline evidence (63,238 device ops in
+    ~15 ms = ~238 ns/op issued, ~2.9x unit overlap) must be recomputable
+    from the committed chip trace by scripts/trace_stats.py."""
+    scripts_dir = str(ROOT / "scripts")
+    sys.path.insert(0, scripts_dir)
+    try:
+        import trace_stats
+    finally:
+        sys.path.remove(scripts_dir)
+    traces = trace_stats.find_traces(ROOT / "artifacts" / "tpu_trace")
+    assert traces, "committed chip trace missing"
+    s = trace_stats.summarize(traces[0])
+    assert s["device_ops"] == 63238
+    assert 230 <= s["ns_per_op_issued"] <= 250
+    assert 2.5 <= s["unit_overlap"] <= 3.5
+    # matmuls present and dominated in count by small fusions — the
+    # op-stream (not FLOPs) picture the roofline section describes
+    assert s["top_ops"].get("convolution_add_fusion", 0) > 10000
+
+
 def test_train_cli_help():
     r = subprocess.run(
         [sys.executable, str(ROOT / "train.py"), "--help"],
