@@ -431,7 +431,7 @@ def _headline_data():
     return spec, params, X, Y
 
 
-def _jax_epoch_setup(precision, unroll=None):
+def _jax_epoch_setup(precision, unroll=None, megakernel=None):
     """Build the headline measurement setup (fused sequential epoch) at the
     named matmul precision: returns ``(epoch_fn, params, X, Y)``."""
     from shallowspeed_tpu import trainer
@@ -443,12 +443,16 @@ def _jax_epoch_setup(precision, unroll=None):
     # forward/backward per step — the TPU-shaped way to run the sequential
     # path. unroll: batch-scan unroll factor (bit-identical numerics); the
     # default can be overridden with the value scripts/tpu_capture.py measures
-    # best on the chip.
+    # best on the chip. megakernel: the whole batch as ONE Pallas kernel
+    # (bit-identical math, shortest serial op chain — see
+    # docs/performance.md roofline); opt-in via env until chip-proven.
     if unroll is None:
         unroll = int(os.environ.get("SHALLOWSPEED_BENCH_UNROLL", "1"))
+    if megakernel is None:
+        megakernel = os.environ.get("SHALLOWSPEED_BENCH_MEGAKERNEL", "0") == "1"
     epoch = trainer.make_train_epoch(
         spec, SGD(LR), precision=PRECISIONS[precision], fuse_mubatches=True,
-        unroll=unroll,
+        unroll=unroll, megakernel=megakernel,
     )
     return epoch, params, X, Y
 
@@ -713,30 +717,55 @@ def main():
         )
         results.update(cpu_results)
         meta.update(cpu_meta)
+    record, warnings = build_record(
+        results,
+        meta,
+        baseline,
+        fallback_tag,
+        tunnel_env_active=bool(os.environ.get("PALLAS_AXON_POOL_IPS")),
+    )
+    for w in warnings:
+        print(f"bench: {w}", file=sys.stderr)
+    if record is None:
+        sys.exit(1)
+    print(json.dumps(record))
+
+
+def build_record(results, meta, baseline, fallback_tag, tunnel_env_active):
+    """Assemble the published one-line record from raw measurements — every
+    honesty rule in one pure, unit-tested place (tests/test_tools.py):
+
+    - the OBSERVED backend outranks the probe: a child whose tunnel init
+      failed after a healthy probe silently measures on host CPU; that
+      degraded number must carry a fallback tag even though no parent-side
+      probe or timeout ever fired;
+    - a degraded run is unmistakable in the metric NAME itself;
+    - physical-plausibility guard: an implied FLOP rate above the single-
+      chip ceiling means the timing protocol was defeated — label it;
+    - whole-run cross-check guard: the slope headline must stay within 2x
+      of the protocol-independent wall-clock bound;
+    - per-cell provenance fields (value_backend, same_window): a
+      same_window=false pair's RATIO is untrustworthy even when both
+      values are.
+
+    Returns ``(record_dict | None, warnings)``; None = nothing measured.
+    """
+    warnings = []
     value = results.get("default")
     value_fp32 = results.get("highest")
     if value is None:
-        print("bench: no measurement succeeded on any backend", file=sys.stderr)
-        sys.exit(1)
-    # the OBSERVED backend outranks the probe: a child whose tunnel init
-    # failed after a healthy probe silently measures on host CPU (reported
-    # via _observed_backend) — that degraded number must carry a fallback
-    # tag even though no parent-side probe or timeout ever fired
+        return None, ["no measurement succeeded on any backend"]
     if (
         not fallback_tag
-        and os.environ.get("PALLAS_AXON_POOL_IPS")
+        and tunnel_env_active
         and meta.get("default", {}).get("backend") == "cpu"
     ):
         fallback_tag = "_CPU_FALLBACK_CHILD_BACKEND_DEGRADED"
-        print(
-            "bench: measurement child reported backend=cpu despite an active "
-            "tunnel env; tagging metric as a CPU fallback",
-            file=sys.stderr,
+        warnings.append(
+            "measurement child reported backend=cpu despite an active "
+            "tunnel env; tagging metric as a CPU fallback"
         )
-    # a degraded run is unmistakable in the recorded metric itself
     metric = "mnist_mlp_train_samples_per_sec_per_chip" + fallback_tag
-    # physical plausibility guard: if the implied FLOP rate exceeds anything a
-    # single chip can do, the timing protocol was defeated — label, don't lie
     crosscheck = results.get("_crosscheck")
     implausible = []
     if value * flops_per_sample() > _PLAUSIBLE_TFLOPS["default"]:
@@ -749,59 +778,46 @@ def main():
     if implausible:
         metric += "_SUSPECT_TIMING"
         for precision, v in implausible:
-            print(
-                f"bench: {precision} cell implies "
+            warnings.append(
+                f"{precision} cell implies "
                 f"{v * flops_per_sample() / 1e12:.0f} TFLOP/s, above its "
                 f"{_PLAUSIBLE_TFLOPS[precision] / 1e12:.0f} TFLOP/s "
-                "single-chip ceiling; tagging metric",
-                file=sys.stderr,
+                "single-chip ceiling; tagging metric"
             )
-    # second, protocol-independent guard: the whole-run wall-clock lower
-    # bound (one program, one dispatch, one readback — nothing a slope bug
-    # can inflate). The headline must stay within a small factor of it.
     if crosscheck is not None and value > 2.0 * crosscheck:
         if "_SUSPECT_TIMING" not in metric:
             metric += "_SUSPECT_TIMING"
-        print(
-            f"bench: headline {value:,.0f} samples/s exceeds 2x the "
-            f"whole-run wall-clock cross-check ({crosscheck:,.0f}); "
-            "tagging metric",
-            file=sys.stderr,
+        warnings.append(
+            f"headline {value:,.0f} samples/s exceeds 2x the whole-run "
+            f"wall-clock cross-check ({crosscheck:,.0f}); tagging metric"
         )
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(value, 1),
-                "unit": "samples/s",
-                "vs_baseline": round(value / baseline, 2),
-                "config": "fused+default_precision (bf16-input MXU, fp32 accum; "
-                "convergence-verified vs fp32 recipe)",
-                "value_fp32_highest": (
-                    None if value_fp32 is None else round(value_fp32, 1)
-                ),
-                "vs_baseline_fp32_highest": (
-                    None if value_fp32 is None else round(value_fp32 / baseline, 2)
-                ),
-                "whole_run_crosscheck_sps": (
-                    None if crosscheck is None else round(crosscheck, 1)
-                ),
-                # per-cell provenance: which platform measured each value, and
-                # whether the default/highest pair shares contention windows
-                # (interleaved trials on the same backend). A same_window=false
-                # pair's RATIO is untrustworthy even when both values are.
-                "value_backend": meta.get("default", {}).get("backend"),
-                "value_fp32_backend": meta.get("highest", {}).get("backend"),
-                "same_window": bool(
-                    value_fp32 is not None
-                    and meta.get("default", {}).get("interleaved")
-                    and meta.get("highest", {}).get("interleaved")
-                    and meta.get("default", {}).get("backend")
-                    == meta.get("highest", {}).get("backend")
-                ),
-            }
-        )
-    )
+    record = {
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(value / baseline, 2),
+        "config": "fused+default_precision (bf16-input MXU, fp32 accum; "
+        "convergence-verified vs fp32 recipe)",
+        "value_fp32_highest": (
+            None if value_fp32 is None else round(value_fp32, 1)
+        ),
+        "vs_baseline_fp32_highest": (
+            None if value_fp32 is None else round(value_fp32 / baseline, 2)
+        ),
+        "whole_run_crosscheck_sps": (
+            None if crosscheck is None else round(crosscheck, 1)
+        ),
+        "value_backend": meta.get("default", {}).get("backend"),
+        "value_fp32_backend": meta.get("highest", {}).get("backend"),
+        "same_window": bool(
+            value_fp32 is not None
+            and meta.get("default", {}).get("interleaved")
+            and meta.get("highest", {}).get("interleaved")
+            and meta.get("default", {}).get("backend")
+            == meta.get("highest", {}).get("backend")
+        ),
+    }
+    return record, warnings
 
 
 if __name__ == "__main__":

@@ -107,6 +107,72 @@ def headline_sweep(unrolls, trials, precision="highest"):
     return out, unresolved
 
 
+def megakernel_cells(nb, trials):
+    """Same-window pair at both precision classes: the fused XLA epoch vs
+    the whole-batch mega-kernel epoch (pallas_ops.fused_train_step_sgd —
+    forward+head+backward+update as ONE op per batch). The roofline says
+    the epoch is op-issue bound, so this is the direct attack: interleaved
+    trials make the xla/mega ratio a genuine contention-window-free
+    comparison. Numerics are bit-identical by construction (tested)."""
+    import jax
+    import jax.numpy as jnp
+
+    from shallowspeed_tpu import model as Mo
+    from shallowspeed_tpu import trainer
+    from shallowspeed_tpu.api import (
+        FLAGSHIP_BATCH as B,
+        FLAGSHIP_LR as LR,
+        FLAGSHIP_MUBATCHES as M,
+        FLAGSHIP_SIZES as SIZES,
+        PRECISIONS,
+    )
+    from shallowspeed_tpu.optimizer import SGD
+
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.rand(nb, M, B // M, SIZES[0]).astype(np.float32))
+    Y = jnp.asarray(
+        np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, M, B // M))]
+    )
+    run_ks = {}
+    for prec in ("default", "highest"):
+        for mk in (False, True):
+            epoch = trainer.make_train_epoch(
+                spec, SGD(LR), precision=PRECISIONS[prec],
+                fuse_mubatches=True, megakernel=mk,
+            )
+            params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+            key = f"fused+{prec}+{'mega' if mk else 'xla'}"
+            run_ks[key] = bench.make_run_k(epoch, params, (), X, Y)
+            print(f"  built {key}", file=sys.stderr, flush=True)
+    return _measure_salvaged(run_ks, trials, nb * B)
+
+
+def megakernel_convergence(data_dir, epochs):
+    """20-epoch flagship convergence THROUGH the mega-kernel at the headline
+    (default) precision — the evidence that lets the mega-kernel carry the
+    published headline: final accuracy must match the fused-XLA trajectory
+    (TPU_DEFAULT_PRECISION_r02.json: 99.40%)."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    run = TrainingSession(
+        data_dir=data_dir, precision="default", fuse_mubatches=True,
+        megakernel=True,
+    )
+    losses, accs = run.train_run(epochs)
+    result = {
+        "precision": "default",
+        "epochs": epochs,
+        "per_epoch_val_accuracy": [round(float(a), 4) for a in accs],
+        "final_val_accuracy": round(float(accs[-1]), 4),
+        "first_loss": round(float(losses[0]), 4),
+        "final_loss": round(float(losses[-1]), 4),
+        "model_hash": run.model_hash(),
+    }
+    print(f"  megakernel convergence: {result}", flush=True)
+    return result
+
+
 def executor_backend_cells(nb, trials):
     """Pipeline-executor epoch on one chip (dp=pp=1 degenerate pipeline —
     the tick scan, stacked params and mailbox machinery run for real): XLA
@@ -317,8 +383,23 @@ def main():
     result["vs_baseline_fp32"] = round(best_fp32 / baseline, 2)
     checkpoint_result()
 
+    print("2c) mega-kernel vs fused-XLA pair (same-window, both precision "
+          "classes; the op-issue-roofline attack)...", flush=True)
+    mega, mega_unresolved = megakernel_cells(29 if args.quick else 116,
+                                             2 if args.quick else 3)
+    result["megakernel_cells"] = mega
+    if mega_unresolved:
+        result["megakernel_cells_unresolved"] = mega_unresolved
+    checkpoint_result()
+
     print("3) convergence (real dataset, per-epoch eval)...", flush=True)
     result["convergence"] = convergence_run(args.data_dir, 5 if args.quick else 20)
+    checkpoint_result()
+
+    print("3b) mega-kernel convergence (headline precision)...", flush=True)
+    result["megakernel_convergence"] = megakernel_convergence(
+        args.data_dir, 5 if args.quick else 20
+    )
     checkpoint_result()
 
     print("4) profiler trace...", flush=True)

@@ -77,6 +77,7 @@ class TrainingSession:
         tick_unroll=1,
         weight_decay=0.0,
         clip_norm=None,
+        megakernel=False,
     ):
         if global_batch_size % dp != 0:
             raise ValueError("global batch size must be divisible by dp")
@@ -100,6 +101,11 @@ class TrainingSession:
                 "fuse_mubatches applies to the sequential path only; in the "
                 "pipeline executor microbatches are semantic (they ARE the "
                 "pipeline's unit of work)"
+            )
+        if megakernel and not fuse_mubatches:
+            raise ValueError(
+                "megakernel runs the whole fused batch as one Pallas kernel; "
+                "it requires fuse_mubatches=True (sequential path)"
             )
         if virtual_stages < 1:
             raise ValueError("virtual_stages must be >= 1")
@@ -227,12 +233,12 @@ class TrainingSession:
             self._epoch_fn = trainer.make_train_epoch(
                 self.spec, opt, precision=self.precision,
                 fuse_mubatches=fuse_mubatches, unroll=scan_unroll,
-                clip_norm=clip_norm,
+                clip_norm=clip_norm, megakernel=megakernel,
             )
             self._predict = trainer.make_predict(self.spec, precision=self.precision)
             self._run_kwargs = dict(
                 precision=self.precision, fuse_mubatches=fuse_mubatches,
-                unroll=scan_unroll, clip_norm=clip_norm,
+                unroll=scan_unroll, clip_norm=clip_norm, megakernel=megakernel,
             )
             self._Xe = self._X.reshape(nb, self.M, self.B // self.M, -1)
             self._Ye = self._Y.reshape(nb, self.M, self.B // self.M, -1)

@@ -415,3 +415,144 @@ def flag_kernels_fit(mb, din, dout):
         _fwd_bytes(mb, din, dout) <= SINGLE_BLOCK_BUDGET_BYTES
         and _bwd_bytes(mb, din, dout) <= SINGLE_BLOCK_BUDGET_BYTES
     )
+
+
+# ---------------------------------------------------------------------------
+# Whole-training-step mega-kernel (sequential fused path)
+# ---------------------------------------------------------------------------
+#
+# Motivation (docs/performance.md roofline): the flagship epoch is op-issue
+# bound — ~40 small XLA ops per batch retiring at ~240 ns each, serialized by
+# SGD's step-to-step dependence. The model's ENTIRE working set (724 KB
+# params + ~1 MB activations/masks) fits VMEM, so the whole per-batch
+# computation — L-layer forward, grouped-softmax MSE head, backward, SGD
+# update — can be ONE kernel: one op per batch on the serial chain instead
+# of ~40, attacking the binding roofline directly. Float math is identical
+# to the fused XLA path (same dots at the same precision, same grouped
+# stability max, same 1e-7 softmax quirk, same update expression); verified
+# bit-for-bit in tests/test_pallas_ops.py.
+
+
+def _train_step_kernel(
+    x_ref, y_ref, *refs, L, relu_flags, group_rows, batch_size, lr, decay, precision
+):
+    w = [refs[i] for i in range(L)]
+    b = [refs[L + i] for i in range(L)]
+    out_w = [refs[2 * L + i] for i in range(L)]
+    out_b = [refs[3 * L + i] for i in range(L)]
+    loss_ref = refs[4 * L]
+
+    # ---- forward (activations/masks stay live in VMEM) ----
+    a = x_ref[:]
+    acts, masks = [], [None] * L
+    for l in range(L):
+        acts.append(a)
+        z = (
+            jnp.dot(
+                a, w[l][:].T, precision=precision,
+                preferred_element_type=jnp.float32,
+            )
+            + b[l][:]
+        )
+        if relu_flags[l]:
+            masks[l] = (z > 0.0).astype(jnp.float32)
+            a = jnp.maximum(z, 0.0)
+        else:
+            a = z
+
+    # ---- head: softmax with the reference's quirks (ops.softmax) ----
+    # stability max per consecutive group_rows-row group (the fused-microbatch
+    # semantics, ops._stability_max) via STATIC row slices — scalar max +
+    # broadcast per group, no 3-D reshapes (Mosaic-friendly)
+    z_head = a
+    rows = z_head.shape[0]
+    parts = []
+    for g0 in range(0, rows, group_rows):
+        blk = z_head[g0 : g0 + group_rows, :]
+        parts.append(jnp.full_like(blk, jnp.max(blk)))
+    m = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+    ze = jnp.exp(z_head - m)
+    p = ze / (ze.sum(axis=1, keepdims=True) + 1e-7)
+
+    y = y_ref[:]
+    loss_ref[0, 0] = jnp.sum((y - p) ** 2) / batch_size
+    # d(MSE)/dp then softmax VJP (ops.mse_loss_grad + ops.softmax_grad,
+    # same expression order for float identity)
+    gl = -2.0 * (y - p) / batch_size
+    gz = p * gl
+    g = gz - p * gz.sum(axis=-1, keepdims=True)
+
+    # ---- backward + fused SGD update ----
+    for l in reversed(range(L)):
+        ge = g * masks[l] if relu_flags[l] else g
+        dw = jnp.dot(
+            ge.T, acts[l], precision=precision, preferred_element_type=jnp.float32
+        )
+        db = jnp.sum(ge, axis=0, keepdims=True)  # b is stored (1, out)
+        out_w[l][:] = w[l][:] * decay - lr * dw
+        out_b[l][:] = b[l][:] * decay - lr * db
+        if l > 0:
+            g = jnp.dot(
+                ge, w[l][:], precision=precision,
+                preferred_element_type=jnp.float32,
+            )
+
+
+def fused_train_step_sgd(
+    stage_params, x, y, *, relu_flags, group_rows, batch_size, lr,
+    weight_decay=0.0, precision=None,
+):
+    """One SGD training batch as ONE kernel: ``(new_stage_params, loss)``.
+
+    ``stage_params``: the sequential path's single-stage param list
+    [{"W": (out,in), "b": (1,out)}, ...]; ``x``: (B, in_dim); ``y``: (B,
+    out_dim) one-hot. Semantics == trainer's fuse_mubatches batch_step with
+    a (possibly decaying) SGD optimizer: ``group_rows`` is the microbatch
+    row count feeding the grouped stability max, ``batch_size`` the GLOBAL
+    batch scaling the loss. Single block: every operand + activations must
+    fit VMEM (true for the flagship class; see train_step_kernel_fits).
+    """
+    from shallowspeed_tpu.optimizer import _decay_factor
+
+    L = len(stage_params)
+    ws = [sp["W"] for sp in stage_params]
+    bs = [jnp.reshape(sp["b"], (1, -1)) for sp in stage_params]
+    decay = _decay_factor(lr, weight_decay) if weight_decay else 1.0
+    kernel = functools.partial(
+        _train_step_kernel,
+        L=L,
+        relu_flags=tuple(relu_flags),
+        group_rows=group_rows,
+        batch_size=batch_size,
+        lr=lr,
+        decay=decay,
+        precision=precision,
+    )
+    out_shape = (
+        [jax.ShapeDtypeStruct(w.shape, jnp.float32) for w in ws]
+        + [jax.ShapeDtypeStruct(b.shape, jnp.float32) for b in bs]
+        + [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+    )
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=tuple(out_shape),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * (2 + 2 * L),
+        out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * (2 * L + 1)),
+        interpret=_interpret(),
+    )(x, y, *ws, *bs)
+    new_params = [
+        {"W": outs[l], "b": outs[L + l]} for l in range(L)
+    ]
+    return new_params, outs[2 * L][0, 0]
+
+
+def train_step_kernel_fits(batch_rows, sizes):
+    """Conservative VMEM feasibility check for the mega-kernel: params (x2
+    for the updated copies), activations + masks at ``batch_rows``, and the
+    input batch, against the single-block budget."""
+    widths = list(sizes)
+    params = sum(widths[i] * widths[i + 1] + widths[i + 1] for i in range(len(widths) - 1))
+    acts = batch_rows * sum(widths)  # layer inputs
+    masks = batch_rows * sum(widths[1:-1])
+    io = batch_rows * (widths[0] + widths[-1])
+    return 4 * (2 * params + acts + masks + io) <= SINGLE_BLOCK_BUDGET_BYTES

@@ -26,7 +26,10 @@ from shallowspeed_tpu import ops
 from shallowspeed_tpu.model import ModelSpec, model_backward, model_forward
 
 
-def _make_batch_step(spec: ModelSpec, opt, precision, fuse_mubatches=False, clip_norm=None):
+def _make_batch_step(
+    spec: ModelSpec, opt, precision, fuse_mubatches=False, clip_norm=None,
+    megakernel=False,
+):
     """The shared per-batch body: microbatch gradient accumulation + optimizer
     apply. Used by both the per-batch step and the epoch scan.
     ``clip_norm``: optional global-norm gradient clipping (over ALL params)
@@ -43,7 +46,48 @@ def _make_batch_step(spec: ModelSpec, opt, precision, fuse_mubatches=False, clip
     microbatch-count-times larger matmuls; the microbatch path exists for
     mechanism parity with the reference and for the pipeline executor, where
     microbatches are semantic.
+
+    ``megakernel=True`` (requires ``fuse_mubatches``, a plain/decaying SGD,
+    no clipping, a single-stage spec) runs the ENTIRE batch — forward,
+    head, backward, update — as ONE Pallas kernel
+    (pallas_ops.fused_train_step_sgd). Identical float math; exists because
+    the epoch is op-issue-latency bound (docs/performance.md roofline) and
+    one op per batch is the shortest possible serial chain.
     """
+    if megakernel:
+        from shallowspeed_tpu import pallas_ops
+        from shallowspeed_tpu.optimizer import SGD as _SGD
+
+        if not fuse_mubatches:
+            raise ValueError("megakernel requires fuse_mubatches=True")
+        if type(opt) is not _SGD:
+            raise ValueError("megakernel supports the (decaying) SGD optimizer only")
+        if clip_norm is not None:
+            raise ValueError("megakernel does not support clip_norm")
+        if spec.n_stages != 1 or not spec.stages[0].has_head:
+            raise ValueError("megakernel runs the single-stage sequential path only")
+        sspec = spec.stages[0]
+        if not pallas_ops.train_step_kernel_fits(
+            spec.global_batch_size, sspec.local_sizes
+        ):
+            raise ValueError("model + batch exceed the mega-kernel VMEM budget")
+
+        def mega_step(params, opt_state, xb, yb):
+            rows = xb.shape[1]
+            x = xb.reshape(-1, xb.shape[-1])
+            y = yb.reshape(-1, yb.shape[-1])
+            new_stage, loss = pallas_ops.fused_train_step_sgd(
+                params[0], x, y,
+                relu_flags=sspec.relu_flags,
+                group_rows=rows,
+                batch_size=spec.global_batch_size,
+                lr=opt.lr,
+                weight_decay=opt.weight_decay,
+                precision=precision,
+            )
+            return [new_stage], opt_state, loss
+
+        return mega_step
 
     def clipped(grads):
         if clip_norm is None:
@@ -93,12 +137,15 @@ def make_train_step(
     precision=ops.DEFAULT_PRECISION,
     fuse_mubatches=False,
     clip_norm=None,
+    megakernel=False,
 ):
     """Returns jitted ``step(params, opt_state, xb, yb) -> (params, opt_state)``.
 
     ``xb``: (M, mubatch, in_dim); ``yb``: (M, mubatch, out_dim) one-hot.
     """
-    batch_step = _make_batch_step(spec, opt, precision, fuse_mubatches, clip_norm)
+    batch_step = _make_batch_step(
+        spec, opt, precision, fuse_mubatches, clip_norm, megakernel
+    )
 
     def step(params, opt_state, xb, yb):
         params, opt_state, _ = batch_step(params, opt_state, xb, yb)
@@ -114,6 +161,7 @@ def make_train_epoch(
     fuse_mubatches=False,
     unroll=1,
     clip_norm=None,
+    megakernel=False,
 ):
     """Whole-epoch scan: ``epoch(params, opt_state, X, Y) -> (params,
     opt_state, mean_loss)`` with X: (num_batches, M, mubatch, in_dim). One
@@ -123,8 +171,12 @@ def make_train_epoch(
     ``unroll``: lax.scan unroll factor over batches — for this model each
     batch body is a handful of small matmuls, so unrolling amortizes the
     per-iteration loop overhead (a throughput knob; identical numerics).
+    ``megakernel``: run each batch as one Pallas kernel (see
+    _make_batch_step; identical numerics, shortest serial op chain).
     """
-    batch_step = _make_batch_step(spec, opt, precision, fuse_mubatches, clip_norm)
+    batch_step = _make_batch_step(
+        spec, opt, precision, fuse_mubatches, clip_norm, megakernel
+    )
     epoch_core = _make_epoch_core(batch_step, unroll)
     return jax.jit(epoch_core, donate_argnums=(0, 1))
 
@@ -155,6 +207,7 @@ def make_train_run(
     unroll=1,
     clip_norm=None,
     with_eval=True,
+    megakernel=False,
 ):
     """Whole-RUN scan: every epoch (and its validation accuracy) in ONE program.
 
@@ -174,7 +227,9 @@ def make_train_run(
     (one compile per value). vx: (n_val, in_dim); vy: (n_val, out_dim)
     one-hot.
     """
-    batch_step = _make_batch_step(spec, opt, precision, fuse_mubatches, clip_norm)
+    batch_step = _make_batch_step(
+        spec, opt, precision, fuse_mubatches, clip_norm, megakernel
+    )
     epoch_core = _make_epoch_core(batch_step, unroll)
 
     if with_eval:

@@ -136,3 +136,95 @@ class TestModelIntegration:
             np.testing.assert_allclose(
                 np.asarray(a["W"]), np.asarray(b["W"]), rtol=1e-5, atol=1e-7
             )
+
+
+class TestMegaKernel:
+    """The whole-training-step kernel (fused_train_step_sgd): one op per
+    batch — forward, grouped-softmax MSE head, backward, SGD update. The
+    bar is BIT-identity with the fused XLA path at both precision classes
+    (same dots, same grouped stability max, same update expression)."""
+
+    def _epoch_pair(self, sizes, B, M, nb, precision, lr=0.01, wd=0.0):
+        rng = np.random.RandomState(2)
+        X = jnp.asarray(rng.rand(nb, M, B // M, sizes[0]).astype(np.float32))
+        Y = jnp.asarray(
+            np.eye(sizes[-1], dtype=np.float32)[
+                rng.randint(0, sizes[-1], (nb, M, B // M))
+            ]
+        )
+        spec = Mo.make_model_spec(sizes, 1, B)
+        out = {}
+        for mk in (False, True):
+            params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+            epoch = trainer.make_train_epoch(
+                spec, SGD(lr, weight_decay=wd), precision=precision,
+                fuse_mubatches=True, megakernel=mk,
+            )
+            params, _, loss = epoch(params, (), X, Y)
+            out[mk] = (jax.device_get(params), float(loss))
+        return out
+
+    @pytest.mark.parametrize("precision", [None, jax.lax.Precision.HIGHEST])
+    def test_epoch_bit_identical_to_fused_xla(self, precision):
+        out = self._epoch_pair((20, 16, 12, 10), 32, 4, 3, precision)
+        assert out[False][1] == out[True][1]
+        for a, b in zip(out[False][0][0], out[True][0][0]):
+            np.testing.assert_array_equal(np.asarray(a["W"]), np.asarray(b["W"]))
+            np.testing.assert_array_equal(np.asarray(a["b"]), np.asarray(b["b"]))
+
+    def test_flagship_shape_with_weight_decay(self):
+        out = self._epoch_pair(
+            (784, 128, 127, 126, 125, 124, 123, 10), 128, 4, 2,
+            jax.lax.Precision.HIGHEST, wd=1e-4,
+        )
+        assert out[False][1] == out[True][1]
+        for a, b in zip(out[False][0][0], out[True][0][0]):
+            np.testing.assert_array_equal(np.asarray(a["W"]), np.asarray(b["W"]))
+
+    def test_fused_run_megakernel_matches(self):
+        """The whole-run program (epochs-outer scan + on-device eval) built
+        over the mega-kernel batch body reproduces the XLA run exactly."""
+        sizes, B, M = (20, 16, 12, 10), 32, 4
+        rng = np.random.RandomState(3)
+        X = jnp.asarray(rng.rand(2, M, B // M, sizes[0]).astype(np.float32))
+        Y = jnp.asarray(
+            np.eye(sizes[-1], dtype=np.float32)[rng.randint(0, sizes[-1], (2, M, B // M))]
+        )
+        vx = jnp.asarray(rng.rand(16, sizes[0]).astype(np.float32))
+        vy = jnp.asarray(np.eye(sizes[-1], dtype=np.float32)[rng.randint(0, sizes[-1], 16)])
+        spec = Mo.make_model_spec(sizes, 1, B)
+        res = {}
+        for mk in (False, True):
+            params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+            run = trainer.make_train_run(
+                spec, SGD(0.01), fuse_mubatches=True, megakernel=mk
+            )
+            params, _, losses, accs = run(params, (), X, Y, vx, vy, 3)
+            res[mk] = (np.asarray(losses), np.asarray(accs))
+        np.testing.assert_array_equal(res[False][0], res[True][0])
+        np.testing.assert_array_equal(res[False][1], res[True][1])
+
+    def test_megakernel_guards(self):
+        from shallowspeed_tpu.optimizer import Adam
+
+        spec = Mo.make_model_spec((20, 16, 12, 10), 1, 32)
+        with pytest.raises(ValueError, match="fuse_mubatches"):
+            trainer.make_train_epoch(spec, SGD(0.01), megakernel=True)
+        with pytest.raises(ValueError, match="SGD"):
+            trainer.make_train_epoch(
+                spec, Adam(0.01), fuse_mubatches=True, megakernel=True
+            )
+        with pytest.raises(ValueError, match="clip_norm"):
+            trainer.make_train_epoch(
+                spec, SGD(0.01), fuse_mubatches=True, clip_norm=1.0, megakernel=True
+            )
+        spec2 = Mo.make_model_spec((20, 16, 12, 10), 2, 32)
+        with pytest.raises(ValueError, match="single-stage"):
+            trainer.make_train_epoch(
+                spec2, SGD(0.01), fuse_mubatches=True, megakernel=True
+            )
+        with pytest.raises(ValueError, match="VMEM"):
+            huge = Mo.make_model_spec((4096, 4096, 10), 1, 2048)
+            trainer.make_train_epoch(
+                huge, SGD(0.01), fuse_mubatches=True, megakernel=True
+            )

@@ -190,6 +190,59 @@ def test_bench_watchdog_timeout_is_flagged(monkeypatch, tmp_path):
     assert saw_timeout
 
 
+def test_build_record_honesty_rules():
+    """Every labeling rule of the published bench record, unit-level:
+    observed-cpu tagging, plausibility + cross-check SUSPECT tags, and the
+    same_window pairing conditions."""
+    bench = _import_bench()
+    tpu = {"interleaved": True, "backend": "tpu"}
+    cpu = {"interleaved": True, "backend": "cpu"}
+    lone = {"interleaved": False, "backend": "tpu"}
+
+    def rec(results, meta, tag="", tunnel=True):
+        return bench.build_record(results, meta, 1000.0, tag, tunnel)
+
+    # clean chip pair -> untagged metric, same_window
+    r, w = rec({"default": 5e6, "highest": 3e6}, {"default": tpu, "highest": tpu})
+    assert r["metric"] == "mnist_mlp_train_samples_per_sec_per_chip" and not w
+    assert r["same_window"] and r["value_backend"] == "tpu"
+    assert r["vs_baseline"] == 5000.0
+
+    # child silently degraded to CPU while the tunnel env was active
+    r, w = rec({"default": 5e4}, {"default": cpu})
+    assert r["metric"].endswith("_CPU_FALLBACK_CHILD_BACKEND_DEGRADED") and w
+
+    # ... but with no tunnel env (plain CPU host) that's not a degradation
+    r, _ = rec({"default": 5e4}, {"default": cpu}, tunnel=False)
+    assert r["metric"] == "mnist_mlp_train_samples_per_sec_per_chip"
+
+    # an existing fallback tag is preserved, not double-tagged
+    r, _ = rec({"default": 5e4}, {"default": cpu}, tag="_CPU_FALLBACK_X")
+    assert r["metric"].endswith("_CPU_FALLBACK_X")
+
+    # implausible FLOP rate -> SUSPECT_TIMING (default ceiling 200 TFLOP/s)
+    too_fast = 300e12 / bench.flops_per_sample()
+    r, w = rec({"default": too_fast}, {"default": tpu})
+    assert r["metric"].endswith("_SUSPECT_TIMING") and "ceiling" in w[0]
+
+    # headline > 2x the whole-run cross-check -> SUSPECT_TIMING (once)
+    r, w = rec(
+        {"default": 5e6, "_crosscheck": 2e6}, {"default": tpu}
+    )
+    assert r["metric"].count("_SUSPECT_TIMING") == 1 and "cross-check" in w[0]
+
+    # a retry-measured lone cell breaks the same-window pairing
+    r, _ = rec({"default": 5e6, "highest": 3e6}, {"default": tpu, "highest": lone})
+    assert not r["same_window"]
+    # cross-backend pair too
+    r, _ = rec({"default": 5e6, "highest": 3e6}, {"default": tpu, "highest": cpu})
+    assert not r["same_window"]
+
+    # nothing measured
+    r, w = rec({}, {})
+    assert r is None and "no measurement" in w[0]
+
+
 def test_slope_timing_interleaved_same_window(monkeypatch):
     """slope_epoch_seconds_many must interleave configs WITHIN each trial
     (so a contention window hits all configs equally) and estimate each

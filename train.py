@@ -107,6 +107,13 @@ def main():
         "larger matmuls for the MXU",
     )
     ap.add_argument(
+        "--megakernel",
+        action="store_true",
+        help="with --fuse-mubatches (SGD only): run each training batch as "
+        "ONE Pallas kernel — forward, head, backward and update in a single "
+        "op (identical numerics; shortest possible serial op chain)",
+    )
+    ap.add_argument(
         "--weight-decay",
         type=float,
         default=0.0,
@@ -158,6 +165,7 @@ def main():
         data_dir=args.data_dir,
         resume=args.resume,
         fuse_mubatches=args.fuse_mubatches,
+        megakernel=args.megakernel,
         optimizer=args.optimizer,
         momentum=args.momentum,
         virtual_stages=args.virtual_stages,
