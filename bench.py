@@ -47,15 +47,22 @@ def _ensure_responsive_backend(probe_timeout_s=180, patience_s=None):
     chip's claim is held by a dead client. When the tunnel plugin is active
     (PALLAS_AXON_POOL_IPS — the only configuration where the hang exists),
     probe device init in a subprocess; on timeout or init failure, fall back
-    to the CPU platform. Returns a reason tag ('' = healthy) so the caller
-    can label the published metric honestly and distinguish a hung tunnel
-    from a backend that failed fast.
+    to the CPU platform. Returns ``(tag, diag)``: tag '' = healthy, else the
+    metric-name suffix labeling the failure mode; ``diag`` is a JSON-able
+    probe log (per-probe outcome + seconds) so an empty-chip round is
+    self-describing in the published record, not just on stderr.
 
     Wedges are transient (observed recovery: tens of minutes) and a tagged
     CPU number is worth far less than a late chip number, so an unresponsive
-    tunnel is re-probed until ``patience_s`` of wall clock is spent (default
-    1800 s, override with SHALLOWSPEED_BENCH_PROBE_BUDGET_S; 0 = single
-    probe). A backend that fails FAST (init error, not a hang) is not
+    tunnel is re-probed until ``patience_s`` of wall clock is spent. The
+    default is 600 s — deliberately well under the driver's window, because
+    the caller has ALREADY published a complete CPU-fallback record before
+    spending any patience here (round 3 burned a 1800 s default on probes
+    and the driver's timeout killed bench.py before it printed anything).
+    Override with SHALLOWSPEED_BENCH_PROBE_BUDGET_S (0 = single probe).
+    A retry is launched only when a FULL probe still fits the budget, so
+    total probe wall time cannot overshoot ``patience_s`` by more than the
+    final sleep. A backend that fails FAST (init error, not a hang) is not
     retried — the real run would die the same way.
 
     stdout goes to DEVNULL and stderr to a temp FILE (never a pipe): a tunnel
@@ -63,10 +70,12 @@ def _ensure_responsive_backend(probe_timeout_s=180, patience_s=None):
     open and make the probe itself hang in communicate(), while a file lets
     us still report the backend's last error line.
     """
+    diag = {"probes": [], "patience_s": None}
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
-        return ""  # no tunnel plugin, nothing to guard (and nothing to pay)
+        return "", diag  # no tunnel plugin, nothing to guard (and nothing to pay)
     if patience_s is None:
-        patience_s = float(os.environ.get("SHALLOWSPEED_BENCH_PROBE_BUDGET_S", "1800"))
+        patience_s = float(os.environ.get("SHALLOWSPEED_BENCH_PROBE_BUDGET_S", "600"))
+    diag["patience_s"] = patience_s
     # stderr goes to a FILE, not a pipe: a tunnel-helper grandchild surviving
     # the timeout kill would hold a pipe open and hang the probe itself
     import tempfile
@@ -75,6 +84,7 @@ def _ensure_responsive_backend(probe_timeout_s=180, patience_s=None):
     attempt = 0
     while True:
         attempt += 1
+        t_probe = time.monotonic()
         with tempfile.TemporaryFile() as errf:
             # start_new_session: a timed-out probe must not leak a tunnel-
             # helper grandchild — the tunnel is single-client, so a surviving
@@ -99,18 +109,26 @@ def _ensure_responsive_backend(probe_timeout_s=180, patience_s=None):
                 except (ProcessLookupError, PermissionError):
                     pass
                 proc.wait()
+            probe_s = round(time.monotonic() - t_probe, 1)
             if rc == 0:
-                return ""
+                diag["probes"].append({"outcome": "ok", "seconds": probe_s})
+                return "", diag
             if rc is None:
+                diag["probes"].append({"outcome": "timeout", "seconds": probe_s})
                 detail = f"unresponsive (> {probe_timeout_s}s to init)"
                 tag = "_CPU_FALLBACK_TUNNEL_UNRESPONSIVE"
-                if time.monotonic() < deadline:
+                # retry only when a FULL probe still fits the budget — a
+                # retry launched just before the deadline would overshoot
+                # patience_s by up to probe_timeout_s (ADVICE r03)
+                if deadline - time.monotonic() >= probe_timeout_s:
                     print(
                         f"bench: tunnel probe {attempt} {detail}; retrying "
                         f"({deadline - time.monotonic():.0f}s of patience left)",
                         file=sys.stderr,
                     )
-                    time.sleep(min(120, max(0, deadline - time.monotonic())))
+                    time.sleep(
+                        min(120, max(0, deadline - time.monotonic() - probe_timeout_s))
+                    )
                     continue
             else:
                 # e.g. "UNAVAILABLE: TPU backend setup/compile error" — the
@@ -119,15 +137,19 @@ def _ensure_responsive_backend(probe_timeout_s=180, patience_s=None):
                 errf.seek(0)
                 tail = errf.read().decode(errors="replace").strip().splitlines()
                 detail = f"failed to initialize ({tail[-1] if tail else 'no stderr'})"
+                diag["probes"].append(
+                    {"outcome": "init_failed", "seconds": probe_s, "error": detail}
+                )
                 tag = "_CPU_FALLBACK_BACKEND_INIT_FAILED"
         break
     print(f"bench: accelerator backend {detail}; falling back to CPU", file=sys.stderr)
+    diag["failure"] = detail
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    return tag
+    return tag, diag
 
 from shallowspeed_tpu.api import (  # the reference's canonical config
     FLAGSHIP_BATCH as B,
@@ -682,56 +704,166 @@ def _run_measurements(precisions, timeout_s, attempts=2, force_cpu=False):
     return results, saw_timeout, errors, meta
 
 
+def _emit(record, warnings):
+    for w in warnings:
+        print(f"bench: {w}", file=sys.stderr)
+    if record is not None:
+        print(json.dumps(record), flush=True)
+    return record is not None
+
+
 def main():
-    fallback_tag = _ensure_responsive_backend()
+    """Wedge-proof publication order (the round-3 lesson: BENCH_r03 was
+    EMPTY because probe patience outlived the driver's window before any
+    record was printed):
+
+      1. With the tunnel env active, measure everything on the host CPU
+         FIRST — the tunnel is never touched — and print a complete,
+         labeled preliminary record. Whatever happens after this line
+         (wedged probes, a mid-run tunnel hang, the driver's kill), a
+         parseable record exists on stdout.
+      2. Only then spend bounded probe patience on the tunnel (default
+         600 s, well under the driver window).
+      3. If the chip answers, measure there and print the upgraded record
+         as the LAST stdout line (the driver parses the last JSON line);
+         otherwise re-print the CPU record with the accurate failure tag.
+         Either way the final record carries the probe diagnostics in a
+         ``tunnel`` field, so an empty-chip round is self-describing.
+
+    Headline config: fused microbatches + DEFAULT matmul precision
+    (bf16-input, fp32-accumulate MXU passes). Convergence-equivalence of
+    this config to the fp32-HIGHEST reference recipe is chip-verified:
+    20-epoch flagship run reaches 99.40% val accuracy / 0.0168 final loss,
+    epoch-for-epoch matching the HIGHEST trajectory (99.39% / 0.0168) —
+    TPU_DEFAULT_PRECISION_r02.json, scripts/tpu_default_precision.py.
+    The fp32-HIGHEST number (the bitwise-NumPy-parity config) is also
+    measured and reported alongside.
+    """
+    tunnel_active = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
     baseline = numpy_baseline_sps()
-    # Headline config: fused microbatches + DEFAULT matmul precision
-    # (bf16-input, fp32-accumulate MXU passes). Convergence-equivalence of
-    # this config to the fp32-HIGHEST reference recipe is chip-verified:
-    # 20-epoch flagship run reaches 99.40% val accuracy / 0.0168 final loss,
-    # epoch-for-epoch matching the HIGHEST trajectory (99.39% / 0.0168) —
-    # TPU_DEFAULT_PRECISION_r02.json, scripts/tpu_default_precision.py.
-    # The fp32-HIGHEST number (the bitwise-NumPy-parity config) is also
-    # measured and reported alongside.
     precisions = ("default", "highest")
-    results, saw_timeout, errors, meta = _run_measurements(precisions, timeout_s=900)
-    if "default" not in results and not fallback_tag:
-        # the headline cell failed on the accelerator on every attempt: a
-        # degraded CPU number with an unmistakable tag beats recording
-        # nothing — and the tag says WHICH failure mode it was. A recorded
-        # in-measurement error for the headline cell (e.g. the slope
-        # protocol refusing untrustworthy timing) is the definitive cause
-        # and wins over a timeout seen on some other attempt.
-        fallback_tag = (
-            "_CPU_FALLBACK_TUNNEL_WEDGED_MIDRUN"
-            if saw_timeout and "default" not in errors
-            else "_CPU_FALLBACK_MEASUREMENT_FAILED"
+
+    if not tunnel_active:
+        # plain host run: no hang hazard to guard, nothing to pre-publish —
+        # but a failed headline cell still falls back to a forced-CPU
+        # re-measure so a tagged record beats no record
+        results, saw_timeout, errors, meta = _run_measurements(
+            precisions, timeout_s=900
         )
-        print(
-            f"bench: falling back to CPU for missing cells ({fallback_tag})",
-            file=sys.stderr,
+        tag = ""
+        if "default" not in results:
+            tag = (
+                "_CPU_FALLBACK_TUNNEL_WEDGED_MIDRUN"
+                if saw_timeout and "default" not in errors
+                else "_CPU_FALLBACK_MEASUREMENT_FAILED"
+            )
+            print(
+                f"bench: falling back to CPU for missing cells ({tag})",
+                file=sys.stderr,
+            )
+            missing = tuple(p for p in precisions if p not in results)
+            cpu_results, _, _, cpu_meta = _run_measurements(
+                missing, timeout_s=900, attempts=1, force_cpu=True
+            )
+            results.update(cpu_results)
+            meta.update(cpu_meta)
+        record, warnings = build_record(
+            results, meta, baseline, tag, tunnel_env_active=False
         )
-        missing = tuple(p for p in precisions if p not in results)
-        cpu_results, _, _, cpu_meta = _run_measurements(
-            missing, timeout_s=900, attempts=1, force_cpu=True
+        sys.exit(0 if _emit(record, warnings) else 1)
+
+    # -- phase 1: guaranteed publication (tunnel never touched) -------------
+    cpu_results, _, _, cpu_meta = _run_measurements(
+        precisions, timeout_s=900, attempts=1, force_cpu=True
+    )
+    prelim, warnings = build_record(
+        cpu_results,
+        cpu_meta,
+        baseline,
+        "_CPU_FALLBACK_TUNNEL_UNRESPONSIVE",
+        tunnel_env_active=True,
+        tunnel={
+            "state": "preliminary — printed before probing the tunnel; "
+            "authoritative only if no later record line follows (bench was "
+            "killed while waiting on the tunnel)"
+        },
+        preliminary=True,
+    )
+    _emit(prelim, warnings)
+
+    # -- phase 2: bounded tunnel patience ------------------------------------
+    fallback_tag, tunnel_diag = _ensure_responsive_backend()
+
+    # -- phase 3: chip measurement, else the CPU record with the true tag ----
+    results, meta = dict(cpu_results), dict(cpu_meta)
+    if not fallback_tag:
+        # interim re-emit before the (killable) chip measurement: if the
+        # driver's window expires DURING measurement — the tunnel's known
+        # wedge-mid-run mode — the last stdout line must not claim the
+        # tunnel was unresponsive when it answered the probe. Superseded
+        # by the final record below on every path that survives.
+        interim, iw = build_record(
+            cpu_results,
+            cpu_meta,
+            baseline,
+            "_CPU_FALLBACK_TUNNEL_WEDGED_MIDRUN",
+            tunnel_env_active=True,
+            tunnel={
+                **tunnel_diag,
+                "state": "interim — probe healthy, chip measurement in "
+                "progress; authoritative only if no later record line "
+                "follows (bench was killed mid-measurement)",
+            },
+            preliminary=True,
         )
-        results.update(cpu_results)
-        meta.update(cpu_meta)
+        _emit(interim, iw)
+        chip_results, saw_timeout, errors, chip_meta = _run_measurements(
+            precisions, timeout_s=900
+        )
+        if "default" in chip_results:
+            results, meta = chip_results, chip_meta
+            # fill a missing non-headline cell from phase 1 (provenance keeps
+            # it honest: value_fp32_backend='cpu', same_window=False). The
+            # CPU cross-check is NOT carried over — comparing a chip headline
+            # against a CPU wall-clock bound would be meaningless.
+            for p in precisions:
+                if p not in results and p in cpu_results:
+                    results[p] = cpu_results[p]
+                    meta[p] = cpu_meta[p]
+        else:
+            # probe said healthy but the measurement itself failed: a
+            # recorded in-measurement error for the headline cell (e.g. the
+            # slope protocol refusing untrustworthy timing) is the
+            # definitive cause and wins over a timeout on some attempt.
+            fallback_tag = (
+                "_CPU_FALLBACK_TUNNEL_WEDGED_MIDRUN"
+                if saw_timeout and "default" not in errors
+                else "_CPU_FALLBACK_MEASUREMENT_FAILED"
+            )
+            tunnel_diag["failure"] = (
+                "probe healthy but chip measurement produced no headline "
+                f"cell (saw_timeout={saw_timeout}, errors={errors})"
+            )
+            print(
+                f"bench: chip measurement failed after healthy probe "
+                f"({fallback_tag}); publishing the phase-1 CPU record",
+                file=sys.stderr,
+            )
     record, warnings = build_record(
         results,
         meta,
         baseline,
         fallback_tag,
-        tunnel_env_active=bool(os.environ.get("PALLAS_AXON_POOL_IPS")),
+        tunnel_env_active=True,
+        tunnel=tunnel_diag,
     )
-    for w in warnings:
-        print(f"bench: {w}", file=sys.stderr)
-    if record is None:
-        sys.exit(1)
-    print(json.dumps(record))
+    sys.exit(0 if _emit(record, warnings) else 1)
 
 
-def build_record(results, meta, baseline, fallback_tag, tunnel_env_active):
+def build_record(
+    results, meta, baseline, fallback_tag, tunnel_env_active,
+    tunnel=None, preliminary=False,
+):
     """Assemble the published one-line record from raw measurements — every
     honesty rule in one pure, unit-tested place (tests/test_tools.py):
 
@@ -746,7 +878,11 @@ def build_record(results, meta, baseline, fallback_tag, tunnel_env_active):
       of the protocol-independent wall-clock bound;
     - per-cell provenance fields (value_backend, same_window): a
       same_window=false pair's RATIO is untrustworthy even when both
-      values are.
+      values are;
+    - ``tunnel``: probe diagnostics (per-probe outcome/seconds, failure
+      mode) embedded in the record itself so a fallback round is
+      self-describing; ``preliminary``: marks the phase-1 record printed
+      before the tunnel was probed (superseded by any later record line).
 
     Returns ``(record_dict | None, warnings)``; None = nothing measured.
     """
@@ -817,6 +953,10 @@ def build_record(results, meta, baseline, fallback_tag, tunnel_env_active):
             == meta.get("highest", {}).get("backend")
         ),
     }
+    if tunnel:
+        record["tunnel"] = tunnel
+    if preliminary:
+        record["preliminary"] = True
     return record, warnings
 
 

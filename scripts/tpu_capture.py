@@ -134,6 +134,35 @@ def megakernel_cells(nb, trials):
     Y = jnp.asarray(
         np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, M, B // M))]
     )
+
+    # On-chip equality probe BEFORE timing (ADVICE r03): the kernels'
+    # bit-identity with fused XLA is interpreter-verified on CPU, but
+    # Mosaic's compiled dots/exp are not guaranteed bitwise-equal to XLA's
+    # lowering on hardware — measure the actual divergence of one 2-batch
+    # epoch from identical params and record it in the artifact.
+    eq_outs = {}
+    for mk in (False, True):
+        epoch = trainer.make_train_epoch(
+            spec, SGD(LR), precision=PRECISIONS["highest"],
+            fuse_mubatches=True, megakernel=mk,
+        )
+        params0 = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+        p, _, loss = epoch(params0, (), X[:2], Y[:2])
+        eq_outs[mk] = (jax.device_get(p), float(loss))
+    diffs = [
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(
+            jax.tree.leaves(eq_outs[False][0]), jax.tree.leaves(eq_outs[True][0])
+        )
+    ]
+    equality = {
+        "max_abs_param_diff": max(diffs),
+        "loss_abs_diff": abs(eq_outs[False][1] - eq_outs[True][1]),
+        "bitwise_equal": max(diffs) == 0.0
+        and eq_outs[False][1] == eq_outs[True][1],
+    }
+    print(f"  on-chip equality (mega vs xla, fp32): {equality}", flush=True)
+
     run_ks = {}
     for prec in ("default", "highest"):
         for mk in (False, True):
@@ -145,7 +174,8 @@ def megakernel_cells(nb, trials):
             key = f"fused+{prec}+{'mega' if mk else 'xla'}"
             run_ks[key] = bench.make_run_k(epoch, params, (), X, Y)
             print(f"  built {key}", file=sys.stderr, flush=True)
-    return _measure_salvaged(run_ks, trials, nb * B)
+    cells, unresolved = _measure_salvaged(run_ks, trials, nb * B)
+    return cells, unresolved, equality
 
 
 def megakernel_convergence(data_dir, epochs):
@@ -202,6 +232,33 @@ def executor_backend_cells(nb, trials):
     Y = jnp.asarray(
         np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, B))]
     )
+    # On-chip equality probe BEFORE timing (ADVICE r03): one pipeline step
+    # through each backend from identical stacked params — the flag kernels'
+    # bit-identity is interpreter-verified on CPU; on hardware Mosaic's
+    # lowering may differ from XLA's, so record the observed divergence.
+    eq_outs = {}
+    for kb in ("xla", "pallas"):
+        step = E.make_pipeline_step(
+            mesh, spec, prog, B // M, SGD(LR),
+            precision=PRECISIONS["highest"], kernel_backend=kb,
+        )
+        stacked0, flags0 = E.init_stacked(spec, mesh)
+        new_stacked, _, loss = step(stacked0, flags0, (), X[0], Y[0])
+        eq_outs[kb] = (jax.device_get(new_stacked), float(loss))
+    diffs = [
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(
+            jax.tree.leaves(eq_outs["xla"][0]), jax.tree.leaves(eq_outs["pallas"][0])
+        )
+    ]
+    equality = {
+        "max_abs_param_diff": max(diffs),
+        "loss_abs_diff": abs(eq_outs["xla"][1] - eq_outs["pallas"][1]),
+        "bitwise_equal": max(diffs) == 0.0
+        and eq_outs["xla"][1] == eq_outs["pallas"][1],
+    }
+    print(f"  on-chip equality (pallas vs xla executor, fp32): {equality}", flush=True)
+
     run_ks = {}
     for prec in ("default", "highest"):
         for kb in ("xla", "pallas"):
@@ -217,7 +274,32 @@ def executor_backend_cells(nb, trials):
             key = f"executor+{prec}+{kb}"
             run_ks[key] = bench.make_run_k(fn, stacked, (), X, Y)
             print(f"  built {key}", file=sys.stderr, flush=True)
-    return _measure_salvaged(run_ks, trials, nb * B)
+    cells, unresolved = _measure_salvaged(run_ks, trials, nb * B)
+    return cells, unresolved, equality
+
+
+def executor_backend_api_path(data_dir, epochs=2):
+    """The executor's Pallas backend through the PRODUCT surface on the chip:
+    two TrainingSessions (interleaved V=2 on one device — the API's route to
+    the tick executor on a single chip), kernel_backend xla vs pallas, same
+    seeds; train ``epochs`` epochs and compare loss trajectories + final
+    model hashes. This is the capture-side witness that the user-facing
+    ``kernel_backend`` flag runs the same training the direct executor
+    cells measure."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    out = {}
+    for kb in ("xla", "pallas"):
+        run = TrainingSession(
+            data_dir=data_dir, pp=1, schedule="interleaved", virtual_stages=2,
+            kernel_backend=kb,
+        )
+        losses = [round(run.train_epoch(), 6) for _ in range(epochs)]
+        out[kb] = {"losses": losses, "model_hash": run.model_hash()}
+    out["hashes_match"] = out["xla"]["model_hash"] == out["pallas"]["model_hash"]
+    out["losses_match"] = out["xla"]["losses"] == out["pallas"]["losses"]
+    print(f"  API-path executor backends: {out}", flush=True)
+    return out
 
 
 def convergence_run(data_dir, epochs):
@@ -315,10 +397,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--data-dir", default="/tmp/ssd_data")
     ap.add_argument("--quick", action="store_true", help="fewer reps/epochs")
-    ap.add_argument("--out", default=str(ROOT / "TPU_CAPTURE_r03.json"))
+    ap.add_argument("--out", default=str(ROOT / "TPU_CAPTURE_r04.json"))
     args = ap.parse_args()
 
-    tag = bench._ensure_responsive_backend()
+    tag, _probe_diag = bench._ensure_responsive_backend()
     if tag:
         print(f"tunnel not healthy ({tag}); aborting capture", file=sys.stderr)
         sys.exit(3)
@@ -385,9 +467,11 @@ def main():
 
     print("2c) mega-kernel vs fused-XLA pair (same-window, both precision "
           "classes; the op-issue-roofline attack)...", flush=True)
-    mega, mega_unresolved = megakernel_cells(29 if args.quick else 116,
-                                             2 if args.quick else 3)
+    mega, mega_unresolved, mega_eq = megakernel_cells(
+        29 if args.quick else 116, 2 if args.quick else 3
+    )
     result["megakernel_cells"] = mega
+    result["megakernel_onchip_equality"] = mega_eq
     if mega_unresolved:
         result["megakernel_cells_unresolved"] = mega_unresolved
     checkpoint_result()
@@ -402,12 +486,17 @@ def main():
     )
     checkpoint_result()
 
+    # per-round trace dirs: the committed round-2 trace in artifacts/tpu_trace
+    # is a pinned test fixture (test_trace_stats_reproduces_roofline_numbers)
+    # and must never be appended to by a later capture
     print("4) profiler trace...", flush=True)
-    result["trace"] = profile_one_epoch(args.data_dir, ROOT / "artifacts" / "tpu_trace")
+    result["trace"] = profile_one_epoch(
+        args.data_dir, ROOT / "artifacts" / "tpu_trace_r04"
+    )
     checkpoint_result()
     print("4b) headline-config (fused+default) trace...", flush=True)
     result["trace_headline"] = profile_headline_epoch(
-        ROOT / "artifacts" / "tpu_trace_headline"
+        ROOT / "artifacts" / "tpu_trace_headline_r04"
     )
     checkpoint_result()
 
@@ -437,12 +526,20 @@ def main():
 
     print("5c) pipeline-executor kernel backends (xla vs pallas flag "
           "kernels, dp=pp=1, same-window)...", flush=True)
-    exec_cells, exec_unresolved = executor_backend_cells(
+    exec_cells, exec_unresolved, exec_eq = executor_backend_cells(
         29 if args.quick else 116, 2
     )
     result["executor_kernel_backends"] = exec_cells
+    result["executor_onchip_equality"] = exec_eq
     if exec_unresolved:
         result["executor_kernel_backends_unresolved"] = exec_unresolved
+    checkpoint_result()
+
+    print("5d) executor backend through the API surface "
+          "(TrainingSession(kernel_backend=))...", flush=True)
+    result["executor_api_path"] = executor_backend_api_path(
+        args.data_dir, epochs=1 if args.quick else 2
+    )
     result["completed_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
     checkpoint_result()
     partial_path.rename(args.out)

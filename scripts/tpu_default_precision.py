@@ -67,7 +67,7 @@ def main():
     ap.add_argument("--out", default=str(ROOT / "TPU_DEFAULT_PRECISION_r02.json"))
     args = ap.parse_args()
 
-    tag = bench._ensure_responsive_backend()
+    tag, _probe_diag = bench._ensure_responsive_backend()
     if tag:
         print(f"tunnel not healthy ({tag}); aborting", file=sys.stderr)
         sys.exit(3)

@@ -78,6 +78,7 @@ class TrainingSession:
         weight_decay=0.0,
         clip_norm=None,
         megakernel=False,
+        kernel_backend="xla",
     ):
         if global_batch_size % dp != 0:
             raise ValueError("global batch size must be divisible by dp")
@@ -107,6 +108,10 @@ class TrainingSession:
                 "megakernel runs the whole fused batch as one Pallas kernel; "
                 "it requires fuse_mubatches=True (sequential path)"
             )
+        if kernel_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"kernel_backend must be 'xla' or 'pallas', got {kernel_backend!r}"
+            )
         if virtual_stages < 1:
             raise ValueError("virtual_stages must be >= 1")
         if virtual_stages > 1 and schedule != "interleaved":
@@ -118,6 +123,14 @@ class TrainingSession:
             raise ValueError("scan_unroll/tick_unroll must be >= 1")
         self.V = virtual_stages
         self._sequential = dp == 1 and pp == 1 and virtual_stages == 1
+        self._kernel_backend = kernel_backend
+        if kernel_backend == "pallas" and self._sequential:
+            raise ValueError(
+                "kernel_backend='pallas' selects the pipeline executor's "
+                "flag-operand kernels and needs a mesh layout (dp/pp > 1 or "
+                "virtual_stages > 1); on the sequential path use "
+                "megakernel=True or SHALLOWSPEED_PALLAS=1 instead"
+            )
         if tick_unroll > 1 and self._sequential:
             raise ValueError(
                 "tick_unroll unrolls the pipeline tick loop; the sequential "
@@ -281,14 +294,14 @@ class TrainingSession:
                 self.mesh, self.spec, prog, local_batch // mubatches, opt,
                 precision=self.precision, zero1=self._zero1,
                 unroll=scan_unroll, tick_unroll=tick_unroll,
-                clip_norm=clip_norm,
+                clip_norm=clip_norm, kernel_backend=kernel_backend,
             )
             self._prog = prog
             self._mubatch_local = local_batch // mubatches
             self._run_kwargs = dict(
                 precision=self.precision, unroll=scan_unroll,
                 tick_unroll=tick_unroll, zero1=self._zero1,
-                clip_norm=clip_norm,
+                clip_norm=clip_norm, kernel_backend=kernel_backend,
             )
             self._eval_step = None  # built lazily, sized to the val split
 
@@ -454,6 +467,7 @@ class TrainingSession:
             step = E.make_pipeline_step(
                 self.mesh, self.spec, self._lower_inference_prog(),
                 rows // self.dp, precision=self.precision,
+                kernel_backend=self._kernel_backend,
             )
             self._predict_cache[rows] = step
         return step

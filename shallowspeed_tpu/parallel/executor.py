@@ -465,23 +465,14 @@ def make_pipeline_step(
     ``kernel_backend``: "xla" (default) or "pallas" — the per-slot compute
     unit inside every tick. "pallas" uses the flag-operand fused kernels
     (pallas_ops.linear_flag_fwd/bwd; the traced relu flag is a kernel
-    operand, so one kernel serves every stage/chunk). Single-block only:
-    every slot's (mubatch, in, out) must fit the VMEM budget, validated
-    here at build time.
+    operand, so one kernel serves every stage/chunk). Slots within the
+    single-block VMEM budget run as one block; larger slots auto-dispatch
+    to the grid-tiled flag kernels (pallas_ops.flag_kernels_fit reports
+    the regime per slot).
     """
     if kernel_backend not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel_backend {kernel_backend!r}")
     dims = slot_shapes(spec)
-    if kernel_backend == "pallas":
-        from shallowspeed_tpu import pallas_ops
-
-        for o, i in dims:
-            if not pallas_ops.flag_kernels_fit(mubatch_size, i, o):
-                raise ValueError(
-                    f"kernel_backend='pallas': slot ({mubatch_size}, {i})x"
-                    f"({o}, {i}) exceeds the single-block VMEM budget; "
-                    "use the 'xla' backend for this shape"
-                )
     S_, L = spec.n_stages, len(dims)
     D_in, D_out = dims[0][1], dims[-1][0]
     W_rel = relay_width(spec)  # ppermute payload / mailbox width (<= D_in)

@@ -100,6 +100,25 @@ def test_mesh_cli_interleaved_zero1_momentum(tiny_data):
     assert re.search(r"final model hash: [0-9a-f]{40}", out)
 
 
+def test_mesh_cli_kernel_backend_pallas_matches_xla(tiny_data):
+    """The executor's Pallas backend is a product feature, not a test-only
+    artifact: the CLI flag must train bit-identically to the default XLA
+    backend (interpreter mode off-TPU — same contract as on hardware)."""
+    hashes = {}
+    for kb in ("xla", "pallas"):
+        out = _run(
+            [
+                "--dp", "2", "--pp", "2", "--schedule", "gpipe",
+                "--epochs", "1", "--global-batch-size", "32", "--mubatches", "2",
+                "--no-eval", "--kernel-backend", kb,
+            ],
+            tiny_data,
+            extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        )
+        hashes[kb] = re.search(r"final model hash: ([0-9a-f]{40})", out).group(1)
+    assert hashes["xla"] == hashes["pallas"]
+
+
 def test_cli_clip_and_decay_flags(tiny_data):
     out = _run(
         ["--epochs", "1", "--global-batch-size", "32", "--mubatches", "2",

@@ -111,6 +111,69 @@ class TestTiledKernels:
         )
 
 
+class TestTiledFlagKernels:
+    """Grid-tiled flag-operand variants (the executor's oversize-slot path):
+    ragged multi-tile grids in every dimension, BOTH flag values, against
+    the XLA expression. Tolerances, not bit-equality: a multi-tile
+    contraction reassociates the sum vs XLA's full dot."""
+
+    MB, DIN, DOUT, TILE = 300, 260, 200, 128  # 3x3x2 tiles, all ragged
+
+    @pytest.mark.parametrize("flag", [0, 1])
+    def test_tiled_flag_fwd_matches_xla(self, flag):
+        x, w, b = r(self.MB, self.DIN), r(self.DOUT, self.DIN), r(1, self.DOUT)
+        y, mask = pallas_ops.linear_flag_fwd_tiled(
+            x, w, b, jnp.int32(flag), tile=self.TILE
+        )
+        z = np.asarray(ops.linear(x, w, b))
+        expect = np.maximum(z, 0) if flag else z
+        np.testing.assert_allclose(y, expect, rtol=1e-5, atol=1e-4)
+        stable = np.abs(z) > 1e-4  # float noise near 0 may flip the mask
+        np.testing.assert_array_equal(
+            (np.asarray(mask) > 0)[stable], (z > 0)[stable]
+        )
+
+    @pytest.mark.parametrize("flag", [0, 1])
+    def test_tiled_flag_bwd_matches_xla(self, flag):
+        x, w = r(self.MB, self.DIN), r(self.DOUT, self.DIN)
+        g = r(self.MB, self.DOUT)
+        mask = (r(self.MB, self.DOUT) > 0).astype(jnp.float32)
+        dx, dw, db = pallas_ops.linear_flag_bwd_tiled(
+            g, mask, x, w, jnp.int32(flag), tile=self.TILE
+        )
+        ge = g * mask if flag else g
+        dx_r, dw_r, db_r = ops.linear_grad(ge, x, w)
+        np.testing.assert_allclose(dx, dx_r, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(dw, dw_r, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(db).reshape(-1), db_r, rtol=1e-4, atol=1e-4
+        )
+
+    def test_flag_dispatch_picks_tiled_beyond_budget(self, monkeypatch):
+        """The PUBLIC executor entry points route over-budget shapes to the
+        tiled flag kernels (this was a build-time rejection until r04)."""
+        monkeypatch.setattr(pallas_ops, "SINGLE_BLOCK_BUDGET_BYTES", 0)
+        monkeypatch.setattr(pallas_ops, "TILE", 128)
+        mb, din, dout = 37, 29, 23
+        x, w, b = r(mb, din), r(dout, din), r(1, dout)
+        for flag in (0, 1):
+            y, mask = pallas_ops.linear_flag_fwd(x, w, b, jnp.int32(flag))
+            z = np.asarray(ops.linear(x, w, b))
+            expect = np.maximum(z, 0) if flag else z
+            np.testing.assert_allclose(y, expect, rtol=1e-5, atol=1e-4)
+            g = r(mb, dout)
+            dx, dw, db = pallas_ops.linear_flag_bwd(
+                g, jnp.asarray(mask), x, w, jnp.int32(flag)
+            )
+            ge = g * jnp.asarray(mask) if flag else g
+            dx_r, dw_r, db_r = ops.linear_grad(ge, x, w)
+            np.testing.assert_allclose(dx, dx_r, rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(dw, dw_r, rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(
+                np.asarray(db).reshape(-1), db_r, rtol=1e-4, atol=1e-4
+            )
+
+
 class TestModelIntegration:
     def test_training_identical_with_pallas_backend(self):
         SIZES, B, M = (20, 16, 12, 10), 32, 4

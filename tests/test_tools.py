@@ -57,9 +57,15 @@ def test_trace_stats_reproduces_roofline_numbers():
         import trace_stats
     finally:
         sys.path.remove(scripts_dir)
-    traces = trace_stats.find_traces(ROOT / "artifacts" / "tpu_trace")
-    assert traces, "committed chip trace missing"
-    s = trace_stats.summarize(traces[0])
+    # pinned to the specific committed round-2 trace file (not a directory
+    # glob): new captures write per-round dirs (artifacts/tpu_trace_r<N>),
+    # so future chip runs can never silently re-target this assertion
+    frozen = (
+        ROOT / "artifacts" / "tpu_trace" / "plugins" / "profile"
+        / "2026_07_29_10_39_10" / "runsc.trace.json.gz"
+    )
+    assert frozen.is_file(), "committed chip trace missing"
+    s = trace_stats.summarize(frozen)
     assert s["device_ops"] == 63238
     assert 230 <= s["ns_per_op_issued"] <= 250
     assert 2.5 <= s["unit_overlap"] <= 3.5
@@ -199,8 +205,8 @@ def test_build_record_honesty_rules():
     cpu = {"interleaved": True, "backend": "cpu"}
     lone = {"interleaved": False, "backend": "tpu"}
 
-    def rec(results, meta, tag="", tunnel=True):
-        return bench.build_record(results, meta, 1000.0, tag, tunnel)
+    def rec(results, meta, tag="", env_active=True, **kw):
+        return bench.build_record(results, meta, 1000.0, tag, env_active, **kw)
 
     # clean chip pair -> untagged metric, same_window
     r, w = rec({"default": 5e6, "highest": 3e6}, {"default": tpu, "highest": tpu})
@@ -213,7 +219,7 @@ def test_build_record_honesty_rules():
     assert r["metric"].endswith("_CPU_FALLBACK_CHILD_BACKEND_DEGRADED") and w
 
     # ... but with no tunnel env (plain CPU host) that's not a degradation
-    r, _ = rec({"default": 5e4}, {"default": cpu}, tunnel=False)
+    r, _ = rec({"default": 5e4}, {"default": cpu}, env_active=False)
     assert r["metric"] == "mnist_mlp_train_samples_per_sec_per_chip"
 
     # an existing fallback tag is preserved, not double-tagged
@@ -241,6 +247,163 @@ def test_build_record_honesty_rules():
     # nothing measured
     r, w = rec({}, {})
     assert r is None and "no measurement" in w[0]
+
+    # tunnel diagnostics ride in the record itself (round-3 lesson: an
+    # empty-chip round must be self-describing); preliminary marks the
+    # phase-1 record printed before the tunnel was probed
+    diag = {"probes": [{"outcome": "timeout", "seconds": 180.0}],
+            "patience_s": 600.0, "failure": "unresponsive"}
+    r, _ = rec({"default": 5e4}, {"default": cpu},
+               tag="_CPU_FALLBACK_TUNNEL_UNRESPONSIVE", tunnel=diag,
+               preliminary=True)
+    assert r["tunnel"] == diag and r["preliminary"] is True
+    # a non-preliminary record carries no preliminary key at all
+    r, _ = rec({"default": 5e6}, {"default": tpu}, tunnel={"probes": []})
+    assert "preliminary" not in r and r["tunnel"] == {"probes": []}
+    # and with no diagnostics passed, no tunnel key
+    r, _ = rec({"default": 5e6}, {"default": tpu})
+    assert "tunnel" not in r
+
+
+def test_bench_publishes_before_spending_tunnel_patience(monkeypatch, capsys):
+    """The round-3 regression, bounded out: with the tunnel env active,
+    bench.main must print a complete preliminary record BEFORE the first
+    probe is launched (so a driver kill during probe patience can never
+    again produce an empty BENCH record), and the final line must carry the
+    accurate failure tag plus the probe diagnostics."""
+    import json as _json
+
+    import pytest
+
+    bench = _import_bench()
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setattr(bench, "numpy_baseline_sps", lambda: 1000.0)
+    calls = []
+
+    def fake_measure(precisions, timeout_s, attempts=2, force_cpu=False):
+        calls.append("measure_cpu" if force_cpu else "measure_tunnel")
+        return (
+            {p: 5e4 for p in precisions},
+            False,
+            {},
+            {p: {"interleaved": True, "backend": "cpu"} for p in precisions},
+        )
+
+    monkeypatch.setattr(bench, "_run_measurements", fake_measure)
+    stdout_at_probe = {}
+
+    def fake_probe(*a, **k):
+        calls.append("probe")
+        stdout_at_probe["out"] = capsys.readouterr().out  # consumed, re-joined below
+        return "_CPU_FALLBACK_TUNNEL_UNRESPONSIVE", {
+            "probes": [{"outcome": "timeout", "seconds": 180.0}],
+            "patience_s": 600.0,
+            "failure": "unresponsive",
+        }
+
+    monkeypatch.setattr(bench, "_ensure_responsive_backend", fake_probe)
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    # the CPU measurement ran before the probe, and no tunnel measurement ran
+    assert calls == ["measure_cpu", "probe"]
+    # a complete preliminary record was already on stdout when probing began
+    pre_lines = [
+        _json.loads(ln)
+        for ln in stdout_at_probe["out"].splitlines()
+        if ln.startswith("{")
+    ]
+    assert len(pre_lines) == 1 and pre_lines[0]["preliminary"] is True
+    assert pre_lines[0]["value"] == 5e4
+    # the final (last) line is the authoritative record with diagnostics
+    post_lines = [
+        _json.loads(ln)
+        for ln in capsys.readouterr().out.splitlines()
+        if ln.startswith("{")
+    ]
+    final = post_lines[-1]
+    assert "preliminary" not in final
+    assert final["metric"].endswith("_CPU_FALLBACK_TUNNEL_UNRESPONSIVE")
+    assert final["tunnel"]["probes"][0]["outcome"] == "timeout"
+
+
+def test_bench_healthy_probe_upgrades_to_chip_record(monkeypatch, capsys):
+    """Healthy-tunnel path: phase 1 publishes the CPU preliminary, a healthy
+    probe re-emits an interim (accurately tagged for the kill-during-
+    measurement window), and the LAST line is the untagged chip record."""
+    import json as _json
+
+    import pytest
+
+    bench = _import_bench()
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setattr(bench, "numpy_baseline_sps", lambda: 1000.0)
+
+    def fake_measure(precisions, timeout_s, attempts=2, force_cpu=False):
+        backend, sps = ("cpu", 5e4) if force_cpu else ("tpu", 5e6)
+        return (
+            {p: sps for p in precisions},
+            False,
+            {},
+            {p: {"interleaved": True, "backend": backend} for p in precisions},
+        )
+
+    monkeypatch.setattr(bench, "_run_measurements", fake_measure)
+    monkeypatch.setattr(
+        bench,
+        "_ensure_responsive_backend",
+        lambda *a, **k: ("", {"probes": [{"outcome": "ok", "seconds": 21.0}],
+                              "patience_s": 600.0}),
+    )
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 0
+    lines = [
+        _json.loads(ln)
+        for ln in capsys.readouterr().out.splitlines()
+        if ln.startswith("{")
+    ]
+    assert len(lines) == 3  # preliminary, interim, final
+    assert lines[0]["preliminary"] and "UNRESPONSIVE" in lines[0]["metric"]
+    assert lines[1]["preliminary"] and "WEDGED_MIDRUN" in lines[1]["metric"]
+    assert lines[1]["tunnel"]["probes"][0]["outcome"] == "ok"
+    final = lines[-1]
+    assert final["metric"] == "mnist_mlp_train_samples_per_sec_per_chip"
+    assert final["value"] == 5e6 and final["value_backend"] == "tpu"
+    assert "preliminary" not in final
+
+
+def test_probe_patience_respects_budget(monkeypatch):
+    """The probe retry loop must never overshoot its patience budget by a
+    whole probe (ADVICE r03): a retry is launched only when a full
+    probe_timeout_s still fits before the deadline. Round 3's regression —
+    probe patience outliving the driver window — is bounded out here."""
+    import subprocess as sp
+
+    bench = _import_bench()
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    fake = {"t": 0.0}
+    monkeypatch.setattr(bench.time, "monotonic", lambda: fake["t"])
+    monkeypatch.setattr(
+        bench.time, "sleep", lambda s: fake.__setitem__("t", fake["t"] + s)
+    )
+
+    class WedgedProc:  # every probe hangs until its timeout
+        pid = 99999
+
+        def wait(self, timeout=None):
+            if timeout is not None:
+                fake["t"] += timeout
+                raise sp.TimeoutExpired("probe", timeout)
+            return -9  # post-kill reap
+
+    monkeypatch.setattr(bench.subprocess, "Popen", lambda *a, **k: WedgedProc())
+    monkeypatch.setattr(bench.os, "killpg", lambda *a, **k: None)
+    tag, diag = bench._ensure_responsive_backend(probe_timeout_s=180, patience_s=600)
+    assert tag == "_CPU_FALLBACK_TUNNEL_UNRESPONSIVE"
+    assert fake["t"] <= 600  # never overshoots the documented budget
+    assert [p["outcome"] for p in diag["probes"]] == ["timeout", "timeout"]
+    assert diag["patience_s"] == 600 and "unresponsive" in diag["failure"]
 
 
 def test_slope_timing_interleaved_same_window(monkeypatch):

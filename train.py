@@ -148,6 +148,15 @@ def main():
         help="matmul precision: 'highest' = fp32 parity with the NumPy "
         "reference; 'default' = let the MXU use fast (bf16-input) passes",
     )
+    ap.add_argument(
+        "--kernel-backend",
+        choices=["xla", "pallas"],
+        default="xla",
+        help="mesh layouts (--dp/--pp > 1): per-slot compute unit inside "
+        "every pipeline tick — 'pallas' runs each slot as one fused "
+        "flag-operand Pallas kernel (same math; see docs/performance.md). "
+        "Sequential path: use --megakernel or SHALLOWSPEED_PALLAS=1",
+    )
     args = ap.parse_args()
 
     import jax
@@ -174,6 +183,7 @@ def main():
         tick_unroll=args.tick_unroll,
         weight_decay=args.weight_decay,
         clip_norm=args.clip_norm,
+        kernel_backend=args.kernel_backend,
     )
     if args.dp == 1 and args.pp == 1 and args.virtual_stages == 1:
         layout = "sequential"
