@@ -831,6 +831,12 @@ def main():
                     results[p] = cpu_results[p]
                     meta[p] = cpu_meta[p]
         else:
+            # keep any cells the chip DID measure (per-cell provenance marks
+            # the mixed backends); only the cells the chip failed stay CPU
+            for p in precisions:
+                if p in chip_results:
+                    results[p] = chip_results[p]
+                    meta[p] = chip_meta[p]
             # probe said healthy but the measurement itself failed: a
             # recorded in-measurement error for the headline cell (e.g. the
             # slope protocol refusing untrustworthy timing) is the

@@ -26,14 +26,22 @@ def _one_hot(y, n_classes=10):
 
 
 def _split(x, y, seed=42, test_frac=0.15):
-    """85/15 split with a fixed seed (reference uses sklearn's
-    train_test_split(random_state=42); we only need determinism, not its exact
-    permutation)."""
-    rng = np.random.RandomState(seed)
-    idx = rng.permutation(len(x))
-    n_val = int(round(len(x) * test_frac))
-    val, train = idx[:n_val], idx[n_val:]
-    return x[train], x[val], y[train], y[val]
+    """85/15 split, sample-for-sample the REFERENCE's split when sklearn is
+    present: train_test_split(test_size=0.15, random_state=42) — the exact
+    call in /root/reference/download_dataset.py:16-18 — so cross-repo
+    accuracy comparisons share identical validation membership. NumPy
+    fallback (deterministic, but its own permutation) when sklearn is
+    unavailable."""
+    try:
+        from sklearn.model_selection import train_test_split
+
+        return train_test_split(x, y, test_size=test_frac, random_state=seed)
+    except ImportError:
+        rng = np.random.RandomState(seed)
+        idx = rng.permutation(len(x))
+        n_val = int(round(len(x) * test_frac))
+        val, train = idx[:n_val], idx[n_val:]
+        return x[train], x[val], y[train], y[val]
 
 
 def _load_openml():

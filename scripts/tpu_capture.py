@@ -63,6 +63,26 @@ def _measure_salvaged(run_ks, trials, samples_per_epoch):
     return out, {name: str(err) for name, err in failures.items()}
 
 
+def _equality_record(outcome_a, outcome_b):
+    """On-chip equality verdict from two ``(params_pytree, loss)`` outcomes
+    of the same training step through two backends (ADVICE r03: measure the
+    hardware divergence before timing instead of assuming the interpreter's
+    bit-identity): per-leaf max-abs param diff, loss diff, bitwise flag."""
+    import jax
+
+    params_a, loss_a = outcome_a
+    params_b, loss_b = outcome_b
+    diffs = [
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(jax.tree.leaves(params_a), jax.tree.leaves(params_b))
+    ]
+    return {
+        "max_abs_param_diff": max(diffs),
+        "loss_abs_diff": abs(loss_a - loss_b),
+        "bitwise_equal": max(diffs) == 0.0 and loss_a == loss_b,
+    }
+
+
 def headline_sweep(unrolls, trials, precision="highest"):
     """Scan-unroll sweep of the fused sequential epoch, all unroll variants'
     trials interleaved (bench.slope_epoch_seconds_many) so the sweep is a
@@ -149,18 +169,7 @@ def megakernel_cells(nb, trials):
         params0 = jax.tree.map(jnp.asarray, Mo.init_model(spec))
         p, _, loss = epoch(params0, (), X[:2], Y[:2])
         eq_outs[mk] = (jax.device_get(p), float(loss))
-    diffs = [
-        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
-        for a, b in zip(
-            jax.tree.leaves(eq_outs[False][0]), jax.tree.leaves(eq_outs[True][0])
-        )
-    ]
-    equality = {
-        "max_abs_param_diff": max(diffs),
-        "loss_abs_diff": abs(eq_outs[False][1] - eq_outs[True][1]),
-        "bitwise_equal": max(diffs) == 0.0
-        and eq_outs[False][1] == eq_outs[True][1],
-    }
+    equality = _equality_record(eq_outs[False], eq_outs[True])
     print(f"  on-chip equality (mega vs xla, fp32): {equality}", flush=True)
 
     run_ks = {}
@@ -245,18 +254,7 @@ def executor_backend_cells(nb, trials):
         stacked0, flags0 = E.init_stacked(spec, mesh)
         new_stacked, _, loss = step(stacked0, flags0, (), X[0], Y[0])
         eq_outs[kb] = (jax.device_get(new_stacked), float(loss))
-    diffs = [
-        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
-        for a, b in zip(
-            jax.tree.leaves(eq_outs["xla"][0]), jax.tree.leaves(eq_outs["pallas"][0])
-        )
-    ]
-    equality = {
-        "max_abs_param_diff": max(diffs),
-        "loss_abs_diff": abs(eq_outs["xla"][1] - eq_outs["pallas"][1]),
-        "bitwise_equal": max(diffs) == 0.0
-        and eq_outs["xla"][1] == eq_outs["pallas"][1],
-    }
+    equality = _equality_record(eq_outs["xla"], eq_outs["pallas"])
     print(f"  on-chip equality (pallas vs xla executor, fp32): {equality}", flush=True)
 
     run_ks = {}

@@ -43,6 +43,7 @@ def _run(args, data_dir, extra_env=None):
         text=True,
         timeout=540,
         cwd=ROOT,
+        env=env,
     )
     assert r.returncode == 0, r.stderr[-2000:]
     return r.stdout

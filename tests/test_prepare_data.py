@@ -51,3 +51,20 @@ def test_split_is_deterministic_and_disjoint():
     assert len(a[0]) + len(a[1]) == 100
     merged = np.sort(np.concatenate([a[0], a[1]]).reshape(-1))
     np.testing.assert_array_equal(merged, np.arange(100, dtype=np.float32))
+
+
+def test_split_matches_reference_sklearn_permutation():
+    """With sklearn present (it is, in this image), _split must reproduce the
+    REFERENCE's exact validation membership: train_test_split(test_size=0.15,
+    random_state=42) — /root/reference/download_dataset.py:16-18 — so
+    cross-repo accuracy comparisons share sample-for-sample val sets."""
+    from sklearn.model_selection import train_test_split
+
+    x = np.arange(200, dtype=np.float32).reshape(200, 1)
+    y = np.eye(10, dtype=np.float32)[np.arange(200) % 10]
+    xt, xv, yt, yv = prepare_data._split(x, y)
+    xt_r, xv_r, yt_r, yv_r = train_test_split(x, y, test_size=0.15, random_state=42)
+    np.testing.assert_array_equal(xt, xt_r)
+    np.testing.assert_array_equal(xv, xv_r)
+    np.testing.assert_array_equal(yt, yt_r)
+    np.testing.assert_array_equal(yv, yv_r)
