@@ -453,7 +453,7 @@ def _headline_data():
     return spec, params, X, Y
 
 
-def _jax_epoch_setup(precision, unroll=None, megakernel=None):
+def _jax_epoch_setup(precision, unroll=None, megakernel=None, epoch_kernel=None):
     """Build the headline measurement setup (fused sequential epoch) at the
     named matmul precision: returns ``(epoch_fn, params, X, Y)``."""
     from shallowspeed_tpu import trainer
@@ -465,16 +465,19 @@ def _jax_epoch_setup(precision, unroll=None, megakernel=None):
     # forward/backward per step — the TPU-shaped way to run the sequential
     # path. unroll: batch-scan unroll factor (bit-identical numerics); the
     # default can be overridden with the value scripts/tpu_capture.py measures
-    # best on the chip. megakernel: the whole batch as ONE Pallas kernel
-    # (bit-identical math, shortest serial op chain — see
-    # docs/performance.md roofline); opt-in via env until chip-proven.
+    # best on the chip. megakernel: the whole batch as ONE Pallas kernel;
+    # epoch_kernel: the whole EPOCH as one kernel (bit-identical math,
+    # shortest possible serial op chain — see docs/performance.md roofline);
+    # both opt-in via env until chip-proven.
     if unroll is None:
         unroll = int(os.environ.get("SHALLOWSPEED_BENCH_UNROLL", "1"))
     if megakernel is None:
         megakernel = os.environ.get("SHALLOWSPEED_BENCH_MEGAKERNEL", "0") == "1"
+    if epoch_kernel is None:
+        epoch_kernel = os.environ.get("SHALLOWSPEED_BENCH_EPOCH_KERNEL", "0") == "1"
     epoch = trainer.make_train_epoch(
         spec, SGD(LR), precision=PRECISIONS[precision], fuse_mubatches=True,
-        unroll=unroll, megakernel=megakernel,
+        unroll=unroll, megakernel=megakernel, epoch_kernel=epoch_kernel,
     )
     return epoch, params, X, Y
 

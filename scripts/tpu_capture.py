@@ -128,12 +128,14 @@ def headline_sweep(unrolls, trials, precision="highest"):
 
 
 def megakernel_cells(nb, trials):
-    """Same-window pair at both precision classes: the fused XLA epoch vs
-    the whole-batch mega-kernel epoch (pallas_ops.fused_train_step_sgd —
-    forward+head+backward+update as ONE op per batch). The roofline says
-    the epoch is op-issue bound, so this is the direct attack: interleaved
-    trials make the xla/mega ratio a genuine contention-window-free
-    comparison. Numerics are bit-identical by construction (tested)."""
+    """Same-window triple at both precision classes: fused XLA epoch vs the
+    whole-batch mega-kernel (one op per batch, pallas_ops.fused_train_step_
+    sgd) vs the whole-EPOCH kernel (one op per epoch, pallas_ops.fused_
+    train_epoch_sgd). The roofline says the epoch is op-issue bound, so
+    these are the direct attacks at two strengths; interleaved trials make
+    every ratio a contention-window-free comparison. Numerics are
+    interpreter-bit-identical (tested); the on-chip divergence is measured
+    first and recorded."""
     import jax
     import jax.numpy as jnp
 
@@ -160,46 +162,56 @@ def megakernel_cells(nb, trials):
     # Mosaic's compiled dots/exp are not guaranteed bitwise-equal to XLA's
     # lowering on hardware — measure the actual divergence of one 2-batch
     # epoch from identical params and record it in the artifact.
+    VARIANTS = {
+        "xla": {},
+        "mega": {"megakernel": True},
+        "epoch": {"epoch_kernel": True},
+    }
     eq_outs = {}
-    for mk in (False, True):
+    for name, kw in VARIANTS.items():
         epoch = trainer.make_train_epoch(
             spec, SGD(LR), precision=PRECISIONS["highest"],
-            fuse_mubatches=True, megakernel=mk,
+            fuse_mubatches=True, **kw,
         )
         params0 = jax.tree.map(jnp.asarray, Mo.init_model(spec))
         p, _, loss = epoch(params0, (), X[:2], Y[:2])
-        eq_outs[mk] = (jax.device_get(p), float(loss))
-    equality = _equality_record(eq_outs[False], eq_outs[True])
-    print(f"  on-chip equality (mega vs xla, fp32): {equality}", flush=True)
+        eq_outs[name] = (jax.device_get(p), float(loss))
+    equality = {
+        name: _equality_record(eq_outs["xla"], eq_outs[name])
+        for name in ("mega", "epoch")
+    }
+    print(f"  on-chip equality vs fused-xla (fp32): {equality}", flush=True)
 
     run_ks = {}
     for prec in ("default", "highest"):
-        for mk in (False, True):
+        for name, kw in VARIANTS.items():
             epoch = trainer.make_train_epoch(
                 spec, SGD(LR), precision=PRECISIONS[prec],
-                fuse_mubatches=True, megakernel=mk,
+                fuse_mubatches=True, **kw,
             )
             params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
-            key = f"fused+{prec}+{'mega' if mk else 'xla'}"
+            key = f"fused+{prec}+{name}"
             run_ks[key] = bench.make_run_k(epoch, params, (), X, Y)
             print(f"  built {key}", file=sys.stderr, flush=True)
     cells, unresolved = _measure_salvaged(run_ks, trials, nb * B)
     return cells, unresolved, equality
 
 
-def megakernel_convergence(data_dir, epochs):
-    """20-epoch flagship convergence THROUGH the mega-kernel at the headline
-    (default) precision — the evidence that lets the mega-kernel carry the
+def megakernel_convergence(data_dir, epochs, variant="megakernel"):
+    """20-epoch flagship convergence THROUGH the mega-kernel (or the
+    whole-epoch kernel, ``variant='epoch_kernel'``) at the headline
+    (default) precision — the evidence that lets the kernel carry the
     published headline: final accuracy must match the fused-XLA trajectory
     (TPU_DEFAULT_PRECISION_r02.json: 99.40%)."""
     from shallowspeed_tpu.api import TrainingSession
 
     run = TrainingSession(
         data_dir=data_dir, precision="default", fuse_mubatches=True,
-        megakernel=True,
+        **{variant: True},
     )
     losses, accs = run.train_run(epochs)
     result = {
+        "variant": variant,
         "precision": "default",
         "epochs": epochs,
         "per_epoch_val_accuracy": [round(float(a), 4) for a in accs],
@@ -463,8 +475,8 @@ def main():
     result["vs_baseline_fp32"] = round(best_fp32 / baseline, 2)
     checkpoint_result()
 
-    print("2c) mega-kernel vs fused-XLA pair (same-window, both precision "
-          "classes; the op-issue-roofline attack)...", flush=True)
+    print("2c) fused-XLA vs mega-kernel vs epoch-kernel (same-window, both "
+          "precision classes; the op-issue-roofline attacks)...", flush=True)
     mega, mega_unresolved, mega_eq = megakernel_cells(
         29 if args.quick else 116, 2 if args.quick else 3
     )
@@ -481,6 +493,12 @@ def main():
     print("3b) mega-kernel convergence (headline precision)...", flush=True)
     result["megakernel_convergence"] = megakernel_convergence(
         args.data_dir, 5 if args.quick else 20
+    )
+    checkpoint_result()
+
+    print("3c) epoch-kernel convergence (headline precision)...", flush=True)
+    result["epoch_kernel_convergence"] = megakernel_convergence(
+        args.data_dir, 5 if args.quick else 20, variant="epoch_kernel"
     )
     checkpoint_result()
 

@@ -78,6 +78,7 @@ class TrainingSession:
         weight_decay=0.0,
         clip_norm=None,
         megakernel=False,
+        epoch_kernel=False,
         kernel_backend="xla",
     ):
         if global_batch_size % dp != 0:
@@ -106,6 +107,11 @@ class TrainingSession:
         if megakernel and not fuse_mubatches:
             raise ValueError(
                 "megakernel runs the whole fused batch as one Pallas kernel; "
+                "it requires fuse_mubatches=True (sequential path)"
+            )
+        if epoch_kernel and not fuse_mubatches:
+            raise ValueError(
+                "epoch_kernel runs the whole epoch as one Pallas kernel; "
                 "it requires fuse_mubatches=True (sequential path)"
             )
         if kernel_backend not in ("xla", "pallas"):
@@ -247,11 +253,13 @@ class TrainingSession:
                 self.spec, opt, precision=self.precision,
                 fuse_mubatches=fuse_mubatches, unroll=scan_unroll,
                 clip_norm=clip_norm, megakernel=megakernel,
+                epoch_kernel=epoch_kernel,
             )
             self._predict = trainer.make_predict(self.spec, precision=self.precision)
             self._run_kwargs = dict(
                 precision=self.precision, fuse_mubatches=fuse_mubatches,
                 unroll=scan_unroll, clip_norm=clip_norm, megakernel=megakernel,
+                epoch_kernel=epoch_kernel,
             )
             self._Xe = self._X.reshape(nb, self.M, self.B // self.M, -1)
             self._Ye = self._Y.reshape(nb, self.M, self.B // self.M, -1)

@@ -472,26 +472,28 @@ def flag_kernels_fit(mb, din, dout):
 # timing instead of assuming zero.
 
 
-def _train_step_kernel(
-    x_ref, y_ref, *refs, L, relu_flags, group_rows, batch_size, lr, decay, precision
+def _sgd_batch_math(
+    x, y, ws, bs, *, relu_flags, group_rows, batch_size, lr, decay, precision
 ):
-    w = [refs[i] for i in range(L)]
-    b = [refs[L + i] for i in range(L)]
-    out_w = [refs[2 * L + i] for i in range(L)]
-    out_b = [refs[3 * L + i] for i in range(L)]
-    loss_ref = refs[4 * L]
+    """The per-batch training math shared by the step and epoch kernels,
+    on param VALUES (already read from refs): L-layer forward with live
+    activations/masks, the reference-quirk softmax-MSE head, backward, and
+    the (decaying) SGD update. Returns ``(new_ws, new_bs, loss)``. ONE
+    definition so the bit-identity contract (fused XLA == step kernel ==
+    epoch kernel) cannot drift between the two kernels."""
+    L = len(ws)
 
     # ---- forward (activations/masks stay live in VMEM) ----
-    a = x_ref[:]
+    a = x
     acts, masks = [], [None] * L
     for l in range(L):
         acts.append(a)
         z = (
             jnp.dot(
-                a, w[l][:].T, precision=precision,
+                a, ws[l].T, precision=precision,
                 preferred_element_type=jnp.float32,
             )
-            + b[l][:]
+            + bs[l]
         )
         if relu_flags[l]:
             masks[l] = (z > 0.0).astype(jnp.float32)
@@ -513,28 +515,49 @@ def _train_step_kernel(
     ze = jnp.exp(z_head - m)
     p = ze / (ze.sum(axis=1, keepdims=True) + 1e-7)
 
-    y = y_ref[:]
-    loss_ref[0, 0] = jnp.sum((y - p) ** 2) / batch_size
+    loss = jnp.sum((y - p) ** 2) / batch_size
     # d(MSE)/dp then softmax VJP (ops.mse_loss_grad + ops.softmax_grad,
     # same expression order for float identity)
     gl = -2.0 * (y - p) / batch_size
     gz = p * gl
     g = gz - p * gz.sum(axis=-1, keepdims=True)
 
-    # ---- backward + fused SGD update ----
+    # ---- backward + fused SGD update (dx from PRE-update weights) ----
+    new_ws, new_bs = [None] * L, [None] * L
     for l in reversed(range(L)):
         ge = g * masks[l] if relu_flags[l] else g
         dw = jnp.dot(
             ge.T, acts[l], precision=precision, preferred_element_type=jnp.float32
         )
         db = jnp.sum(ge, axis=0, keepdims=True)  # b is stored (1, out)
-        out_w[l][:] = w[l][:] * decay - lr * dw
-        out_b[l][:] = b[l][:] * decay - lr * db
+        new_ws[l] = ws[l] * decay - lr * dw
+        new_bs[l] = bs[l] * decay - lr * db
         if l > 0:
             g = jnp.dot(
-                ge, w[l][:], precision=precision,
+                ge, ws[l], precision=precision,
                 preferred_element_type=jnp.float32,
             )
+    return new_ws, new_bs, loss
+
+
+def _train_step_kernel(
+    x_ref, y_ref, *refs, L, relu_flags, group_rows, batch_size, lr, decay, precision
+):
+    w = [refs[i] for i in range(L)]
+    b = [refs[L + i] for i in range(L)]
+    out_w = [refs[2 * L + i] for i in range(L)]
+    out_b = [refs[3 * L + i] for i in range(L)]
+    loss_ref = refs[4 * L]
+
+    new_ws, new_bs, loss = _sgd_batch_math(
+        x_ref[:], y_ref[:], [wi[:] for wi in w], [bi[:] for bi in b],
+        relu_flags=relu_flags, group_rows=group_rows, batch_size=batch_size,
+        lr=lr, decay=decay, precision=precision,
+    )
+    for l in range(L):
+        out_w[l][:] = new_ws[l]
+        out_b[l][:] = new_bs[l]
+    loss_ref[0, 0] = loss
 
 
 def fused_train_step_sgd(
@@ -585,13 +608,146 @@ def fused_train_step_sgd(
     return new_params, outs[2 * L][0, 0]
 
 
+# ---------------------------------------------------------------------------
+# Whole-EPOCH mega-kernel: the batch dimension as the Pallas grid
+# ---------------------------------------------------------------------------
+#
+# The step mega-kernel collapses ~40 XLA ops per batch into 1, but an epoch
+# is still a lax.scan issuing one kernel per batch (~464 serial dispatches
+# for the flagship dataset) — each paying the measured ~240 ns op-issue
+# floor plus scan bookkeeping. Here the GRID is the batch dimension: TPU
+# grid steps execute sequentially, so the params live in the revisited
+# output blocks (constant index maps keep them VMEM-resident across the
+# whole grid; x/y stream in per-batch with Pallas's automatic double
+# buffering) and the ENTIRE epoch is ONE kernel launch. Expressions are
+# identical to the step kernel per batch and the loss-mean accumulation
+# matches the epoch scan's order, so the result is bit-identical to the
+# scan-of-megakernel path (interpreter-verified; on-chip equality measured
+# by capture phase 2c).
+
+
+def _train_epoch_kernel(
+    x_ref, y_ref, *refs, L, relu_flags, group_rows, batch_size, lr, decay,
+    precision,
+):
+    w_in = [refs[i] for i in range(L)]
+    b_in = [refs[L + i] for i in range(L)]
+    out_w = [refs[2 * L + i] for i in range(L)]
+    out_b = [refs[3 * L + i] for i in range(L)]
+    loss_ref = refs[4 * L]
+    b_idx = pl.program_id(0)
+    nb = pl.num_programs(0)
+
+    @pl.when(b_idx == 0)
+    def _init():
+        for l in range(L):
+            out_w[l][:] = w_in[l][:]
+            out_b[l][:] = b_in[l][:]
+        loss_ref[0, 0] = 0.0
+
+    # current params live in the revisited out_* blocks; the batch math is
+    # THE shared definition (_sgd_batch_math), so expressions stay identical
+    # to the step kernel by construction
+    new_ws, new_bs, loss = _sgd_batch_math(
+        x_ref[:], y_ref[:], [out_w[l][:] for l in range(L)],
+        [out_b[l][:] for l in range(L)],
+        relu_flags=relu_flags, group_rows=group_rows, batch_size=batch_size,
+        lr=lr, decay=decay, precision=precision,
+    )
+    for l in range(L):
+        out_w[l][:] = new_ws[l]
+        out_b[l][:] = new_bs[l]
+    loss_ref[0, 0] += loss
+
+    @pl.when(b_idx == nb - 1)
+    def _final():
+        loss_ref[0, 0] = loss_ref[0, 0] / nb
+
+
+def fused_train_epoch_sgd(
+    stage_params, X, Y, *, relu_flags, group_rows, batch_size, lr,
+    weight_decay=0.0, precision=None,
+):
+    """One SGD training EPOCH as ONE kernel: ``(new_stage_params, mean_loss)``.
+
+    ``X``: (num_batches, B, in_dim); ``Y``: (num_batches, B, out_dim)
+    one-hot. Semantics == lax.scan of fused_train_step_sgd over the batch
+    axis (same per-batch expressions, same loss-sum-then-divide order) with
+    zero per-batch dispatches: the grid is the batch axis, params ride the
+    revisited output blocks. VMEM feasibility == the step kernel's
+    (train_step_kernel_fits) plus the streamed (B, in_dim) x/y blocks.
+    """
+    from shallowspeed_tpu.optimizer import _decay_factor
+
+    L = len(stage_params)
+    nb, B_, din = X.shape
+    dout = Y.shape[-1]
+    ws = [sp["W"] for sp in stage_params]
+    bs = [jnp.reshape(sp["b"], (1, -1)) for sp in stage_params]
+    decay = _decay_factor(lr, weight_decay) if weight_decay else 1.0
+    kernel = functools.partial(
+        _train_epoch_kernel,
+        L=L,
+        relu_flags=tuple(relu_flags),
+        group_rows=group_rows,
+        batch_size=batch_size,
+        lr=lr,
+        decay=decay,
+        precision=precision,
+    )
+    X2 = jnp.reshape(X, (nb * B_, din))
+    Y2 = jnp.reshape(Y, (nb * B_, dout))
+    const = lambda shape: pl.BlockSpec(shape, lambda b: tuple(0 for _ in shape), memory_space=pltpu.VMEM)  # noqa: E731
+    out_shape = (
+        [jax.ShapeDtypeStruct(w.shape, jnp.float32) for w in ws]
+        + [jax.ShapeDtypeStruct(b.shape, jnp.float32) for b in bs]
+        + [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        out_shape=tuple(out_shape),
+        in_specs=[
+            pl.BlockSpec((B_, din), lambda b: (b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((B_, dout), lambda b: (b, 0), memory_space=pltpu.VMEM),
+        ]
+        + [const(w.shape) for w in ws]
+        + [const(b.shape) for b in bs],
+        out_specs=tuple(
+            [const(w.shape) for w in ws]
+            + [const(b.shape) for b in bs]
+            + [const((1, 1))]
+        ),
+        interpret=_interpret(),
+    )(X2, Y2, *ws, *bs)
+    new_params = [{"W": outs[l], "b": outs[L + l]} for l in range(L)]
+    return new_params, outs[2 * L][0, 0]
+
+
 def train_step_kernel_fits(batch_rows, sizes):
     """Conservative VMEM feasibility check for the mega-kernel: params (x2
     for the updated copies), activations + masks at ``batch_rows``, and the
     input batch, against the single-block budget."""
+    return _kernel_bytes(batch_rows, sizes) <= SINGLE_BLOCK_BUDGET_BYTES
+
+
+def train_epoch_kernel_fits(batch_rows, sizes):
+    """VMEM feasibility for the whole-EPOCH kernel: the step kernel's
+    working set PLUS a second copy of the streamed x/y blocks — Pallas
+    double-buffers the per-grid-step input fetches, so two batches' worth
+    of x/y can be resident at once."""
+    widths = list(sizes)
+    stream_extra = 4 * batch_rows * (widths[0] + widths[-1])
+    return (
+        _kernel_bytes(batch_rows, sizes) + stream_extra
+        <= SINGLE_BLOCK_BUDGET_BYTES
+    )
+
+
+def _kernel_bytes(batch_rows, sizes):
     widths = list(sizes)
     params = sum(widths[i] * widths[i + 1] + widths[i + 1] for i in range(len(widths) - 1))
     acts = batch_rows * sum(widths)  # layer inputs
     masks = batch_rows * sum(widths[1:-1])
     io = batch_rows * (widths[0] + widths[-1])
-    return 4 * (2 * params + acts + masks + io) <= SINGLE_BLOCK_BUDGET_BYTES
+    return 4 * (2 * params + acts + masks + io)

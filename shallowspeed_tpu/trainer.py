@@ -55,22 +55,8 @@ def _make_batch_step(
     one op per batch is the shortest possible serial chain.
     """
     if megakernel:
+        sspec = _validate_megakernel(spec, opt, fuse_mubatches, clip_norm)
         from shallowspeed_tpu import pallas_ops
-        from shallowspeed_tpu.optimizer import SGD as _SGD
-
-        if not fuse_mubatches:
-            raise ValueError("megakernel requires fuse_mubatches=True")
-        if type(opt) is not _SGD:
-            raise ValueError("megakernel supports the (decaying) SGD optimizer only")
-        if clip_norm is not None:
-            raise ValueError("megakernel does not support clip_norm")
-        if spec.n_stages != 1 or not spec.stages[0].has_head:
-            raise ValueError("megakernel runs the single-stage sequential path only")
-        sspec = spec.stages[0]
-        if not pallas_ops.train_step_kernel_fits(
-            spec.global_batch_size, sspec.local_sizes
-        ):
-            raise ValueError("model + batch exceed the mega-kernel VMEM budget")
 
         def mega_step(params, opt_state, xb, yb):
             rows = xb.shape[1]
@@ -131,6 +117,64 @@ def _make_batch_step(
     return batch_step
 
 
+def _validate_megakernel(spec, opt, fuse_mubatches, clip_norm, name="megakernel"):
+    """The mega-kernel constraint set, shared by the per-batch and whole-epoch
+    variants: fused microbatches, (decaying) SGD, no clipping, single stage,
+    within the variant's VMEM budget (the epoch kernel additionally holds
+    the double-buffered streamed x/y blocks). Returns the single stage's
+    spec."""
+    from shallowspeed_tpu import pallas_ops
+    from shallowspeed_tpu.optimizer import SGD as _SGD
+
+    if not fuse_mubatches:
+        raise ValueError(f"{name} requires fuse_mubatches=True")
+    if type(opt) is not _SGD:
+        raise ValueError(f"{name} supports the (decaying) SGD optimizer only")
+    if clip_norm is not None:
+        raise ValueError(f"{name} does not support clip_norm")
+    if spec.n_stages != 1 or not spec.stages[0].has_head:
+        raise ValueError(f"{name} runs the single-stage sequential path only")
+    sspec = spec.stages[0]
+    fits = (
+        pallas_ops.train_epoch_kernel_fits
+        if name == "epoch_kernel"
+        else pallas_ops.train_step_kernel_fits
+    )
+    if not fits(spec.global_batch_size, sspec.local_sizes):
+        raise ValueError(f"model + batch exceed the {name} VMEM budget")
+    return sspec
+
+
+def _make_epoch_kernel_core(spec, opt, precision, fuse_mubatches, clip_norm):
+    """Whole-epoch mega-kernel core (pallas_ops.fused_train_epoch_sgd): the
+    batch axis becomes the Pallas grid, params stay VMEM-resident across the
+    epoch, and the per-epoch serial op chain drops from one kernel per batch
+    to ONE kernel total. Same signature as _make_epoch_core's result; batch
+    expressions and loss-mean order are bit-identical to scanning the
+    per-batch mega-kernel (tested)."""
+    sspec = _validate_megakernel(
+        spec, opt, fuse_mubatches, clip_norm, name="epoch_kernel"
+    )
+    from shallowspeed_tpu import pallas_ops
+
+    def epoch_core(params, opt_state, X, Y):
+        nb, M_, mb, din = X.shape
+        x = X.reshape(nb, M_ * mb, din)
+        y = Y.reshape(nb, M_ * mb, Y.shape[-1])
+        new_stage, mean_loss = pallas_ops.fused_train_epoch_sgd(
+            params[0], x, y,
+            relu_flags=sspec.relu_flags,
+            group_rows=mb,
+            batch_size=spec.global_batch_size,
+            lr=opt.lr,
+            weight_decay=opt.weight_decay,
+            precision=precision,
+        )
+        return [new_stage], opt_state, mean_loss
+
+    return epoch_core
+
+
 def make_train_step(
     spec: ModelSpec,
     opt,
@@ -162,6 +206,7 @@ def make_train_epoch(
     unroll=1,
     clip_norm=None,
     megakernel=False,
+    epoch_kernel=False,
 ):
     """Whole-epoch scan: ``epoch(params, opt_state, X, Y) -> (params,
     opt_state, mean_loss)`` with X: (num_batches, M, mubatch, in_dim). One
@@ -172,12 +217,22 @@ def make_train_epoch(
     batch body is a handful of small matmuls, so unrolling amortizes the
     per-iteration loop overhead (a throughput knob; identical numerics).
     ``megakernel``: run each batch as one Pallas kernel (see
-    _make_batch_step; identical numerics, shortest serial op chain).
+    _make_batch_step; identical numerics, shortest serial op chain per
+    batch). ``epoch_kernel``: run the ENTIRE epoch as one Pallas kernel
+    (the batch axis is the kernel grid, params stay VMEM-resident — see
+    _make_epoch_kernel_core; identical numerics, one op per epoch).
     """
-    batch_step = _make_batch_step(
-        spec, opt, precision, fuse_mubatches, clip_norm, megakernel
-    )
-    epoch_core = _make_epoch_core(batch_step, unroll)
+    if epoch_kernel:
+        if megakernel:
+            raise ValueError("megakernel and epoch_kernel are exclusive")
+        epoch_core = _make_epoch_kernel_core(
+            spec, opt, precision, fuse_mubatches, clip_norm
+        )
+    else:
+        batch_step = _make_batch_step(
+            spec, opt, precision, fuse_mubatches, clip_norm, megakernel
+        )
+        epoch_core = _make_epoch_core(batch_step, unroll)
     return jax.jit(epoch_core, donate_argnums=(0, 1))
 
 
@@ -208,6 +263,7 @@ def make_train_run(
     clip_norm=None,
     with_eval=True,
     megakernel=False,
+    epoch_kernel=False,
 ):
     """Whole-RUN scan: every epoch (and its validation accuracy) in ONE program.
 
@@ -227,10 +283,17 @@ def make_train_run(
     (one compile per value). vx: (n_val, in_dim); vy: (n_val, out_dim)
     one-hot.
     """
-    batch_step = _make_batch_step(
-        spec, opt, precision, fuse_mubatches, clip_norm, megakernel
-    )
-    epoch_core = _make_epoch_core(batch_step, unroll)
+    if epoch_kernel:
+        if megakernel:
+            raise ValueError("megakernel and epoch_kernel are exclusive")
+        epoch_core = _make_epoch_kernel_core(
+            spec, opt, precision, fuse_mubatches, clip_norm
+        )
+    else:
+        batch_step = _make_batch_step(
+            spec, opt, precision, fuse_mubatches, clip_norm, megakernel
+        )
+        epoch_core = _make_epoch_core(batch_step, unroll)
 
     if with_eval:
 

@@ -307,3 +307,16 @@ def test_kernel_backend_validation(data_dir):
     # SHALLOWSPEED_PALLAS); the executor backend needs a mesh
     with pytest.raises(ValueError, match="mesh layout"):
         _session(data_dir, kernel_backend="pallas")
+
+
+def test_epoch_kernel_matches_fused_via_api(data_dir):
+    """TrainingSession(epoch_kernel=True): the whole-epoch Pallas kernel
+    through the product surface trains bit-identically to the fused XLA
+    path (and its epoch losses match)."""
+    runs = {}
+    for kw in ({}, {"epoch_kernel": True}):
+        run = _session(data_dir, fuse_mubatches=True, **kw)
+        losses = [run.train_epoch() for _ in range(2)]
+        runs[bool(kw)] = (losses, run.model_hash())
+    assert runs[False][0] == runs[True][0]
+    assert runs[False][1] == runs[True][1]

@@ -68,6 +68,22 @@ def test_sequential_cli_fused(tiny_data):
     assert re.search(r"final model hash: [0-9a-f]{40}", out)
 
 
+def test_sequential_cli_epoch_kernel_matches_fused(tiny_data):
+    """--epoch-kernel (whole epoch as one Pallas kernel) trains to the same
+    model hash as the fused XLA path through the real CLI."""
+    hashes = {}
+    for extra in ([], ["--epoch-kernel"]):
+        out = _run(
+            ["--epochs", "1", "--global-batch-size", "32", "--mubatches", "2",
+             "--no-eval", "--fuse-mubatches", *extra],
+            tiny_data,
+        )
+        hashes[bool(extra)] = re.search(
+            r"final model hash: ([0-9a-f]{40})", out
+        ).group(1)
+    assert hashes[False] == hashes[True]
+
+
 def test_mesh_cli_dp2_pp2(tiny_data):
     out = _run(
         [

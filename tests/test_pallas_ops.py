@@ -291,3 +291,86 @@ class TestMegaKernel:
             trainer.make_train_epoch(
                 huge, SGD(0.01), fuse_mubatches=True, megakernel=True
             )
+
+
+class TestEpochKernel:
+    """The whole-EPOCH kernel (fused_train_epoch_sgd): the batch axis is the
+    Pallas grid, params ride the revisited output blocks — one device op per
+    epoch. The bar is BIT-identity with the fused XLA epoch (and hence the
+    per-batch mega-kernel) at both precision classes."""
+
+    def _epoch_triple(self, sizes, B, M, nb, precision, lr=0.01, wd=0.0):
+        rng = np.random.RandomState(2)
+        X = jnp.asarray(rng.rand(nb, M, B // M, sizes[0]).astype(np.float32))
+        Y = jnp.asarray(
+            np.eye(sizes[-1], dtype=np.float32)[
+                rng.randint(0, sizes[-1], (nb, M, B // M))
+            ]
+        )
+        spec = Mo.make_model_spec(sizes, 1, B)
+        out = {}
+        for name, kw in {
+            "xla": {},
+            "mega": {"megakernel": True},
+            "epoch": {"epoch_kernel": True},
+        }.items():
+            params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+            epoch = trainer.make_train_epoch(
+                spec, SGD(lr, weight_decay=wd), precision=precision,
+                fuse_mubatches=True, **kw,
+            )
+            params, _, loss = epoch(params, (), X, Y)
+            out[name] = (jax.device_get(params), float(loss))
+        return out
+
+    @pytest.mark.parametrize("precision", [None, jax.lax.Precision.HIGHEST])
+    def test_epoch_kernel_bit_identical(self, precision):
+        out = self._epoch_triple((20, 16, 12, 10), 32, 4, 3, precision)
+        for other in ("mega", "epoch"):
+            assert out["xla"][1] == out[other][1]
+            for a, b in zip(out["xla"][0][0], out[other][0][0]):
+                np.testing.assert_array_equal(np.asarray(a["W"]), np.asarray(b["W"]))
+                np.testing.assert_array_equal(np.asarray(a["b"]), np.asarray(b["b"]))
+
+    def test_flagship_shape_with_weight_decay(self):
+        out = self._epoch_triple(
+            (784, 128, 127, 126, 125, 124, 123, 10), 128, 4, 2,
+            jax.lax.Precision.HIGHEST, wd=1e-4,
+        )
+        assert out["xla"][1] == out["epoch"][1]
+        for a, b in zip(out["xla"][0][0], out["epoch"][0][0]):
+            np.testing.assert_array_equal(np.asarray(a["W"]), np.asarray(b["W"]))
+
+    def test_fused_run_epoch_kernel_matches(self):
+        """The whole-run program (epochs-outer scan + on-device eval) built
+        over the epoch-kernel core reproduces the XLA run exactly — 20
+        epochs become ~20 device ops plus eval."""
+        sizes, B, M = (20, 16, 12, 10), 32, 4
+        rng = np.random.RandomState(3)
+        X = jnp.asarray(rng.rand(2, M, B // M, sizes[0]).astype(np.float32))
+        Y = jnp.asarray(
+            np.eye(sizes[-1], dtype=np.float32)[rng.randint(0, sizes[-1], (2, M, B // M))]
+        )
+        vx = jnp.asarray(rng.rand(16, sizes[0]).astype(np.float32))
+        vy = jnp.asarray(np.eye(sizes[-1], dtype=np.float32)[rng.randint(0, sizes[-1], 16)])
+        spec = Mo.make_model_spec(sizes, 1, B)
+        res = {}
+        for ek in (False, True):
+            params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+            run = trainer.make_train_run(
+                spec, SGD(0.01), fuse_mubatches=True, epoch_kernel=ek
+            )
+            params, _, losses, accs = run(params, (), X, Y, vx, vy, 3)
+            res[ek] = (np.asarray(losses), np.asarray(accs))
+        np.testing.assert_array_equal(res[False][0], res[True][0])
+        np.testing.assert_array_equal(res[False][1], res[True][1])
+
+    def test_epoch_kernel_guards(self):
+        spec = Mo.make_model_spec((20, 16, 12, 10), 1, 32)
+        with pytest.raises(ValueError, match="fuse_mubatches"):
+            trainer.make_train_epoch(spec, SGD(0.01), epoch_kernel=True)
+        with pytest.raises(ValueError, match="exclusive"):
+            trainer.make_train_epoch(
+                spec, SGD(0.01), fuse_mubatches=True, megakernel=True,
+                epoch_kernel=True,
+            )

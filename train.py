@@ -114,6 +114,14 @@ def main():
         "op (identical numerics; shortest possible serial op chain)",
     )
     ap.add_argument(
+        "--epoch-kernel",
+        action="store_true",
+        help="with --fuse-mubatches (SGD only): run each ENTIRE epoch as "
+        "one Pallas kernel — the batch axis is the kernel grid and the "
+        "params stay VMEM-resident across the epoch (identical numerics; "
+        "one device op per epoch instead of one per batch)",
+    )
+    ap.add_argument(
         "--weight-decay",
         type=float,
         default=0.0,
@@ -175,6 +183,7 @@ def main():
         resume=args.resume,
         fuse_mubatches=args.fuse_mubatches,
         megakernel=args.megakernel,
+        epoch_kernel=args.epoch_kernel,
         optimizer=args.optimizer,
         momentum=args.momentum,
         virtual_stages=args.virtual_stages,
