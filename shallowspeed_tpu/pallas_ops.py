@@ -472,15 +472,16 @@ def flag_kernels_fit(mb, din, dout):
 # timing instead of assuming zero.
 
 
-def _sgd_batch_math(
-    x, y, ws, bs, *, relu_flags, group_rows, batch_size, lr, decay, precision
+def _batch_grads(
+    x, y, ws, bs, *, relu_flags, group_rows, batch_size, precision
 ):
-    """The per-batch training math shared by the step and epoch kernels,
-    on param VALUES (already read from refs): L-layer forward with live
-    activations/masks, the reference-quirk softmax-MSE head, backward, and
-    the (decaying) SGD update. Returns ``(new_ws, new_bs, loss)``. ONE
+    """The per-batch gradient math shared by every training kernel, on param
+    VALUES (already read from refs): L-layer forward with live
+    activations/masks, the reference-quirk softmax-MSE head, backward.
+    Returns ``(dws, dbs, loss)`` — gradient SUMS over the batch (the loss
+    is pre-scaled by the global batch size, the reference's ledger). ONE
     definition so the bit-identity contract (fused XLA == step kernel ==
-    epoch kernel) cannot drift between the two kernels."""
+    epoch kernel, any optimizer variant) cannot drift between kernels."""
     L = len(ws)
 
     # ---- forward (activations/masks stay live in VMEM) ----
@@ -522,42 +523,190 @@ def _sgd_batch_math(
     gz = p * gl
     g = gz - p * gz.sum(axis=-1, keepdims=True)
 
-    # ---- backward + fused SGD update (dx from PRE-update weights) ----
-    new_ws, new_bs = [None] * L, [None] * L
+    # ---- backward (dx from the PRE-update weights) ----
+    dws, dbs = [None] * L, [None] * L
     for l in reversed(range(L)):
         ge = g * masks[l] if relu_flags[l] else g
-        dw = jnp.dot(
+        dws[l] = jnp.dot(
             ge.T, acts[l], precision=precision, preferred_element_type=jnp.float32
         )
-        db = jnp.sum(ge, axis=0, keepdims=True)  # b is stored (1, out)
-        new_ws[l] = ws[l] * decay - lr * dw
-        new_bs[l] = bs[l] * decay - lr * db
+        dbs[l] = jnp.sum(ge, axis=0, keepdims=True)  # b is stored (1, out)
         if l > 0:
             g = jnp.dot(
                 ge, ws[l], precision=precision,
                 preferred_element_type=jnp.float32,
             )
+    return dws, dbs, loss
+
+
+def _sgd_batch_math(
+    x, y, ws, bs, *, relu_flags, group_rows, batch_size, lr, decay, precision
+):
+    """_batch_grads + the (decaying) SGD update: ``(new_ws, new_bs, loss)``.
+    Same elementwise update expression as optimizer.SGD.apply."""
+    dws, dbs, loss = _batch_grads(
+        x, y, ws, bs, relu_flags=relu_flags, group_rows=group_rows,
+        batch_size=batch_size, precision=precision,
+    )
+    L = len(ws)
+    new_ws = [ws[l] * decay - lr * dws[l] for l in range(L)]
+    new_bs = [bs[l] * decay - lr * dbs[l] for l in range(L)]
     return new_ws, new_bs, loss
 
 
-def _train_step_kernel(
-    x_ref, y_ref, *refs, L, relu_flags, group_rows, batch_size, lr, decay, precision
+def _momentum_batch_math(
+    x, y, ws, bs, vws, vbs, *, relu_flags, group_rows, batch_size, lr, mu,
+    decay, precision,
 ):
-    w = [refs[i] for i in range(L)]
-    b = [refs[L + i] for i in range(L)]
-    out_w = [refs[2 * L + i] for i in range(L)]
-    out_b = [refs[3 * L + i] for i in range(L)]
-    loss_ref = refs[4 * L]
-
-    new_ws, new_bs, loss = _sgd_batch_math(
-        x_ref[:], y_ref[:], [wi[:] for wi in w], [bi[:] for bi in b],
-        relu_flags=relu_flags, group_rows=group_rows, batch_size=batch_size,
-        lr=lr, decay=decay, precision=precision,
+    """_batch_grads + the heavy-ball update (optimizer.MomentumSGD.apply:
+    ``v <- mu*v + g; p <- decay(p) - lr*v``): returns ``(new_ws, new_bs,
+    new_vws, new_vbs, loss)``."""
+    dws, dbs, loss = _batch_grads(
+        x, y, ws, bs, relu_flags=relu_flags, group_rows=group_rows,
+        batch_size=batch_size, precision=precision,
     )
-    for l in range(L):
-        out_w[l][:] = new_ws[l]
-        out_b[l][:] = new_bs[l]
-    loss_ref[0, 0] = loss
+    L = len(ws)
+    new_vws = [mu * vws[l] + dws[l] for l in range(L)]
+    new_vbs = [mu * vbs[l] + dbs[l] for l in range(L)]
+    new_ws = [ws[l] * decay - lr * new_vws[l] for l in range(L)]
+    new_bs = [bs[l] * decay - lr * new_vbs[l] for l in range(L)]
+    return new_ws, new_bs, new_vws, new_vbs, loss
+
+
+def _train_kernel_body(
+    x_ref, y_ref, *refs, L, relu_flags, group_rows, batch_size, lr, mu, decay,
+    precision, epoch_mode,
+):
+    """THE training kernel body — all four public variants compile from this
+    one definition so the plumbing cannot drift:
+
+    - ``mu``: None = (decaying) SGD; a float = heavy-ball momentum (the
+      operand list then carries velocity mirrors after the params).
+    - ``epoch_mode``: False = one batch per launch (refs are plain in/out);
+      True = the grid is the batch axis — inputs seed the REVISITED output
+      blocks at grid step 0, which then hold the live params (+ velocity)
+      in VMEM for the whole epoch, and the loss block accumulates the
+      per-batch losses before a final divide (matching the epoch scan's
+      sum-then-divide order exactly).
+
+    Operand layout: ``[x, y] + ins + outs + [loss]`` where ``ins``/``outs``
+    are ``w*L + b*L`` (+ ``vw*L + vb*L`` with momentum).
+    """
+    n = (2 if mu is None else 4) * L
+    ins = refs[:n]
+    outs = refs[n : 2 * n]
+    loss_ref = refs[2 * n]
+
+    if epoch_mode:
+        b_idx = pl.program_id(0)
+        nb = pl.num_programs(0)
+
+        @pl.when(b_idx == 0)
+        def _init():
+            for i in range(n):
+                outs[i][:] = ins[i][:]
+            loss_ref[0, 0] = 0.0
+
+        src = outs  # current state lives in the revisited output blocks
+    else:
+        src = ins
+
+    ws = [src[i][:] for i in range(L)]
+    bs = [src[L + i][:] for i in range(L)]
+    if mu is None:
+        new_ws, new_bs, loss = _sgd_batch_math(
+            x_ref[:], y_ref[:], ws, bs,
+            relu_flags=relu_flags, group_rows=group_rows,
+            batch_size=batch_size, lr=lr, decay=decay, precision=precision,
+        )
+        new_vals = new_ws + new_bs
+    else:
+        vws = [src[2 * L + i][:] for i in range(L)]
+        vbs = [src[3 * L + i][:] for i in range(L)]
+        new_ws, new_bs, new_vws, new_vbs, loss = _momentum_batch_math(
+            x_ref[:], y_ref[:], ws, bs, vws, vbs,
+            relu_flags=relu_flags, group_rows=group_rows,
+            batch_size=batch_size, lr=lr, mu=mu, decay=decay,
+            precision=precision,
+        )
+        new_vals = new_ws + new_bs + new_vws + new_vbs
+    for i, v in enumerate(new_vals):
+        outs[i][:] = v
+
+    if epoch_mode:
+        loss_ref[0, 0] += loss
+
+        @pl.when(b_idx == nb - 1)
+        def _final():
+            loss_ref[0, 0] = loss_ref[0, 0] / nb
+
+    else:
+        loss_ref[0, 0] = loss
+
+
+def _fused_train_call(
+    stage_params, velocity, x, y, *, epoch_mode, relu_flags, group_rows,
+    batch_size, lr, momentum, weight_decay, precision,
+):
+    """The one pallas_call builder behind every fused-training variant:
+    assembles the flat operand list, the (optional) batch-axis grid with
+    constant-index param blocks, and unpacks the outputs. Returns
+    ``(new_stage_params, new_velocity_or_None, loss)``."""
+    from shallowspeed_tpu.optimizer import _decay_factor
+
+    L = len(stage_params)
+    flat = [sp["W"] for sp in stage_params] + [
+        jnp.reshape(sp["b"], (1, -1)) for sp in stage_params
+    ]
+    if velocity is not None:
+        flat += [v["W"] for v in velocity] + [
+            jnp.reshape(v["b"], (1, -1)) for v in velocity
+        ]
+    decay = _decay_factor(lr, weight_decay) if weight_decay else 1.0
+    kernel = functools.partial(
+        _train_kernel_body,
+        L=L, relu_flags=tuple(relu_flags), group_rows=group_rows,
+        batch_size=batch_size, lr=lr, mu=momentum, decay=decay,
+        precision=precision, epoch_mode=epoch_mode,
+    )
+    out_shape = tuple(
+        [jax.ShapeDtypeStruct(a.shape, jnp.float32) for a in flat]
+        + [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+    )
+    if epoch_mode:
+        nb, B_, din = x.shape
+        dout = y.shape[-1]
+        x = jnp.reshape(x, (nb * B_, din))
+        y = jnp.reshape(y, (nb * B_, dout))
+        const = lambda shape: pl.BlockSpec(  # noqa: E731
+            shape, lambda b: tuple(0 for _ in shape), memory_space=pltpu.VMEM
+        )
+        call_kwargs = dict(
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec((B_, din), lambda b: (b, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B_, dout), lambda b: (b, 0), memory_space=pltpu.VMEM),
+            ]
+            + [const(a.shape) for a in flat],
+            out_specs=tuple([const(a.shape) for a in flat] + [const((1, 1))]),
+        )
+    else:
+        call_kwargs = dict(
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * (2 + len(flat)),
+            out_specs=tuple(
+                [pl.BlockSpec(memory_space=pltpu.VMEM)] * (len(flat) + 1)
+            ),
+        )
+    outs = pl.pallas_call(
+        kernel, out_shape=out_shape, interpret=_interpret(), **call_kwargs
+    )(x, y, *flat)
+    new_params = [{"W": outs[l], "b": outs[L + l]} for l in range(L)]
+    new_vel = (
+        None
+        if velocity is None
+        else [{"W": outs[2 * L + l], "b": outs[3 * L + l]} for l in range(L)]
+    )
+    return new_params, new_vel, outs[len(flat)][0, 0]
 
 
 def fused_train_step_sgd(
@@ -574,38 +723,27 @@ def fused_train_step_sgd(
     batch scaling the loss. Single block: every operand + activations must
     fit VMEM (true for the flagship class; see train_step_kernel_fits).
     """
-    from shallowspeed_tpu.optimizer import _decay_factor
+    new_params, _, loss = _fused_train_call(
+        stage_params, None, x, y, epoch_mode=False, relu_flags=relu_flags,
+        group_rows=group_rows, batch_size=batch_size, lr=lr, momentum=None,
+        weight_decay=weight_decay, precision=precision,
+    )
+    return new_params, loss
 
-    L = len(stage_params)
-    ws = [sp["W"] for sp in stage_params]
-    bs = [jnp.reshape(sp["b"], (1, -1)) for sp in stage_params]
-    decay = _decay_factor(lr, weight_decay) if weight_decay else 1.0
-    kernel = functools.partial(
-        _train_step_kernel,
-        L=L,
-        relu_flags=tuple(relu_flags),
-        group_rows=group_rows,
-        batch_size=batch_size,
-        lr=lr,
-        decay=decay,
-        precision=precision,
+
+def fused_train_step_momentum(
+    stage_params, velocity, x, y, *, relu_flags, group_rows, batch_size, lr,
+    momentum, weight_decay=0.0, precision=None,
+):
+    """One heavy-ball training batch as ONE kernel:
+    ``(new_stage_params, new_velocity, loss)``. Semantics ==
+    fused_train_step_sgd with optimizer.MomentumSGD's update; ``velocity``
+    mirrors ``stage_params`` ([{"W", "b"}, ...])."""
+    return _fused_train_call(
+        stage_params, velocity, x, y, epoch_mode=False, relu_flags=relu_flags,
+        group_rows=group_rows, batch_size=batch_size, lr=lr, momentum=momentum,
+        weight_decay=weight_decay, precision=precision,
     )
-    out_shape = (
-        [jax.ShapeDtypeStruct(w.shape, jnp.float32) for w in ws]
-        + [jax.ShapeDtypeStruct(b.shape, jnp.float32) for b in bs]
-        + [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
-    )
-    outs = pl.pallas_call(
-        kernel,
-        out_shape=tuple(out_shape),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * (2 + 2 * L),
-        out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * (2 * L + 1)),
-        interpret=_interpret(),
-    )(x, y, *ws, *bs)
-    new_params = [
-        {"W": outs[l], "b": outs[L + l]} for l in range(L)
-    ]
-    return new_params, outs[2 * L][0, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -615,53 +753,15 @@ def fused_train_step_sgd(
 # The step mega-kernel collapses ~40 XLA ops per batch into 1, but an epoch
 # is still a lax.scan issuing one kernel per batch (~464 serial dispatches
 # for the flagship dataset) — each paying the measured ~240 ns op-issue
-# floor plus scan bookkeeping. Here the GRID is the batch dimension: TPU
-# grid steps execute sequentially, so the params live in the revisited
-# output blocks (constant index maps keep them VMEM-resident across the
-# whole grid; x/y stream in per-batch with Pallas's automatic double
-# buffering) and the ENTIRE epoch is ONE kernel launch. Expressions are
-# identical to the step kernel per batch and the loss-mean accumulation
-# matches the epoch scan's order, so the result is bit-identical to the
-# scan-of-megakernel path (interpreter-verified; on-chip equality measured
-# by capture phase 2c).
-
-
-def _train_epoch_kernel(
-    x_ref, y_ref, *refs, L, relu_flags, group_rows, batch_size, lr, decay,
-    precision,
-):
-    w_in = [refs[i] for i in range(L)]
-    b_in = [refs[L + i] for i in range(L)]
-    out_w = [refs[2 * L + i] for i in range(L)]
-    out_b = [refs[3 * L + i] for i in range(L)]
-    loss_ref = refs[4 * L]
-    b_idx = pl.program_id(0)
-    nb = pl.num_programs(0)
-
-    @pl.when(b_idx == 0)
-    def _init():
-        for l in range(L):
-            out_w[l][:] = w_in[l][:]
-            out_b[l][:] = b_in[l][:]
-        loss_ref[0, 0] = 0.0
-
-    # current params live in the revisited out_* blocks; the batch math is
-    # THE shared definition (_sgd_batch_math), so expressions stay identical
-    # to the step kernel by construction
-    new_ws, new_bs, loss = _sgd_batch_math(
-        x_ref[:], y_ref[:], [out_w[l][:] for l in range(L)],
-        [out_b[l][:] for l in range(L)],
-        relu_flags=relu_flags, group_rows=group_rows, batch_size=batch_size,
-        lr=lr, decay=decay, precision=precision,
-    )
-    for l in range(L):
-        out_w[l][:] = new_ws[l]
-        out_b[l][:] = new_bs[l]
-    loss_ref[0, 0] += loss
-
-    @pl.when(b_idx == nb - 1)
-    def _final():
-        loss_ref[0, 0] = loss_ref[0, 0] / nb
+# floor plus scan bookkeeping. In epoch_mode the GRID is the batch
+# dimension: TPU grid steps execute sequentially, so the params (and
+# velocity) live in the revisited output blocks (constant index maps keep
+# them VMEM-resident across the whole grid; x/y stream in per-batch with
+# Pallas's automatic double buffering) and the ENTIRE epoch is ONE kernel
+# launch. Expressions are identical to the step variant per batch and the
+# loss-mean accumulation matches the epoch scan's order, so the result is
+# bit-identical to the scan-of-megakernel path (interpreter-verified;
+# on-chip equality measured by capture phase 2c).
 
 
 def fused_train_epoch_sgd(
@@ -673,65 +773,42 @@ def fused_train_epoch_sgd(
     ``X``: (num_batches, B, in_dim); ``Y``: (num_batches, B, out_dim)
     one-hot. Semantics == lax.scan of fused_train_step_sgd over the batch
     axis (same per-batch expressions, same loss-sum-then-divide order) with
-    zero per-batch dispatches: the grid is the batch axis, params ride the
-    revisited output blocks. VMEM feasibility == the step kernel's
-    (train_step_kernel_fits) plus the streamed (B, in_dim) x/y blocks.
+    zero per-batch dispatches. VMEM feasibility == the step kernel's
+    (train_step_kernel_fits) plus the streamed (B, in_dim) x/y blocks
+    (train_epoch_kernel_fits).
     """
-    from shallowspeed_tpu.optimizer import _decay_factor
-
-    L = len(stage_params)
-    nb, B_, din = X.shape
-    dout = Y.shape[-1]
-    ws = [sp["W"] for sp in stage_params]
-    bs = [jnp.reshape(sp["b"], (1, -1)) for sp in stage_params]
-    decay = _decay_factor(lr, weight_decay) if weight_decay else 1.0
-    kernel = functools.partial(
-        _train_epoch_kernel,
-        L=L,
-        relu_flags=tuple(relu_flags),
-        group_rows=group_rows,
-        batch_size=batch_size,
-        lr=lr,
-        decay=decay,
-        precision=precision,
+    new_params, _, loss = _fused_train_call(
+        stage_params, None, X, Y, epoch_mode=True, relu_flags=relu_flags,
+        group_rows=group_rows, batch_size=batch_size, lr=lr, momentum=None,
+        weight_decay=weight_decay, precision=precision,
     )
-    X2 = jnp.reshape(X, (nb * B_, din))
-    Y2 = jnp.reshape(Y, (nb * B_, dout))
-    const = lambda shape: pl.BlockSpec(shape, lambda b: tuple(0 for _ in shape), memory_space=pltpu.VMEM)  # noqa: E731
-    out_shape = (
-        [jax.ShapeDtypeStruct(w.shape, jnp.float32) for w in ws]
-        + [jax.ShapeDtypeStruct(b.shape, jnp.float32) for b in bs]
-        + [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+    return new_params, loss
+
+
+def fused_train_epoch_momentum(
+    stage_params, velocity, X, Y, *, relu_flags, group_rows, batch_size, lr,
+    momentum, weight_decay=0.0, precision=None,
+):
+    """One heavy-ball training EPOCH as ONE kernel:
+    ``(new_stage_params, new_velocity, mean_loss)`` — fused_train_epoch_sgd
+    with the momentum update; params AND velocity ride revisited output
+    blocks across the grid."""
+    return _fused_train_call(
+        stage_params, velocity, X, Y, epoch_mode=True, relu_flags=relu_flags,
+        group_rows=group_rows, batch_size=batch_size, lr=lr, momentum=momentum,
+        weight_decay=weight_decay, precision=precision,
     )
-    outs = pl.pallas_call(
-        kernel,
-        grid=(nb,),
-        out_shape=tuple(out_shape),
-        in_specs=[
-            pl.BlockSpec((B_, din), lambda b: (b, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((B_, dout), lambda b: (b, 0), memory_space=pltpu.VMEM),
-        ]
-        + [const(w.shape) for w in ws]
-        + [const(b.shape) for b in bs],
-        out_specs=tuple(
-            [const(w.shape) for w in ws]
-            + [const(b.shape) for b in bs]
-            + [const((1, 1))]
-        ),
-        interpret=_interpret(),
-    )(X2, Y2, *ws, *bs)
-    new_params = [{"W": outs[l], "b": outs[L + l]} for l in range(L)]
-    return new_params, outs[2 * L][0, 0]
 
 
-def train_step_kernel_fits(batch_rows, sizes):
+def train_step_kernel_fits(batch_rows, sizes, momentum=False):
     """Conservative VMEM feasibility check for the mega-kernel: params (x2
-    for the updated copies), activations + masks at ``batch_rows``, and the
-    input batch, against the single-block budget."""
-    return _kernel_bytes(batch_rows, sizes) <= SINGLE_BLOCK_BUDGET_BYTES
+    for the updated copies; x4 with momentum's velocity in+out), activations
+    + masks at ``batch_rows``, and the input batch, against the single-block
+    budget."""
+    return _kernel_bytes(batch_rows, sizes, momentum) <= SINGLE_BLOCK_BUDGET_BYTES
 
 
-def train_epoch_kernel_fits(batch_rows, sizes):
+def train_epoch_kernel_fits(batch_rows, sizes, momentum=False):
     """VMEM feasibility for the whole-EPOCH kernel: the step kernel's
     working set PLUS a second copy of the streamed x/y blocks — Pallas
     double-buffers the per-grid-step input fetches, so two batches' worth
@@ -739,15 +816,16 @@ def train_epoch_kernel_fits(batch_rows, sizes):
     widths = list(sizes)
     stream_extra = 4 * batch_rows * (widths[0] + widths[-1])
     return (
-        _kernel_bytes(batch_rows, sizes) + stream_extra
+        _kernel_bytes(batch_rows, sizes, momentum) + stream_extra
         <= SINGLE_BLOCK_BUDGET_BYTES
     )
 
 
-def _kernel_bytes(batch_rows, sizes):
+def _kernel_bytes(batch_rows, sizes, momentum=False):
     widths = list(sizes)
     params = sum(widths[i] * widths[i + 1] + widths[i + 1] for i in range(len(widths) - 1))
+    state = 2 * params if momentum else 0  # velocity in + out copies
     acts = batch_rows * sum(widths)  # layer inputs
     masks = batch_rows * sum(widths[1:-1])
     io = batch_rows * (widths[0] + widths[-1])
-    return 4 * (2 * params + acts + masks + io)
+    return 4 * (2 * params + state + acts + masks + io)

@@ -57,6 +57,27 @@ def _make_batch_step(
     if megakernel:
         sspec = _validate_megakernel(spec, opt, fuse_mubatches, clip_norm)
         from shallowspeed_tpu import pallas_ops
+        from shallowspeed_tpu.optimizer import MomentumSGD as _Mom
+
+        if type(opt) is _Mom:
+
+            def mega_step(params, opt_state, xb, yb):
+                rows = xb.shape[1]
+                x = xb.reshape(-1, xb.shape[-1])
+                y = yb.reshape(-1, yb.shape[-1])
+                new_stage, new_vel, loss = pallas_ops.fused_train_step_momentum(
+                    params[0], opt_state[0], x, y,
+                    relu_flags=sspec.relu_flags,
+                    group_rows=rows,
+                    batch_size=spec.global_batch_size,
+                    lr=opt.lr,
+                    momentum=opt.momentum,
+                    weight_decay=opt.weight_decay,
+                    precision=precision,
+                )
+                return [new_stage], [new_vel], loss
+
+            return mega_step
 
         def mega_step(params, opt_state, xb, yb):
             rows = xb.shape[1]
@@ -119,17 +140,21 @@ def _make_batch_step(
 
 def _validate_megakernel(spec, opt, fuse_mubatches, clip_norm, name="megakernel"):
     """The mega-kernel constraint set, shared by the per-batch and whole-epoch
-    variants: fused microbatches, (decaying) SGD, no clipping, single stage,
-    within the variant's VMEM budget (the epoch kernel additionally holds
-    the double-buffered streamed x/y blocks). Returns the single stage's
-    spec."""
+    variants: fused microbatches, (decaying) SGD or heavy-ball momentum, no
+    clipping, single stage, within the variant's VMEM budget (momentum's
+    velocity doubles the param-state footprint; the epoch kernel
+    additionally holds the double-buffered streamed x/y blocks). Returns
+    the single stage's spec."""
     from shallowspeed_tpu import pallas_ops
     from shallowspeed_tpu.optimizer import SGD as _SGD
+    from shallowspeed_tpu.optimizer import MomentumSGD as _Mom
 
     if not fuse_mubatches:
         raise ValueError(f"{name} requires fuse_mubatches=True")
-    if type(opt) is not _SGD:
-        raise ValueError(f"{name} supports the (decaying) SGD optimizer only")
+    if type(opt) not in (_SGD, _Mom):
+        raise ValueError(
+            f"{name} supports the (decaying) SGD and momentum optimizers only"
+        )
     if clip_norm is not None:
         raise ValueError(f"{name} does not support clip_norm")
     if spec.n_stages != 1 or not spec.stages[0].has_head:
@@ -140,7 +165,9 @@ def _validate_megakernel(spec, opt, fuse_mubatches, clip_norm, name="megakernel"
         if name == "epoch_kernel"
         else pallas_ops.train_step_kernel_fits
     )
-    if not fits(spec.global_batch_size, sspec.local_sizes):
+    if not fits(
+        spec.global_batch_size, sspec.local_sizes, momentum=type(opt) is _Mom
+    ):
         raise ValueError(f"model + batch exceed the {name} VMEM budget")
     return sspec
 
@@ -156,6 +183,27 @@ def _make_epoch_kernel_core(spec, opt, precision, fuse_mubatches, clip_norm):
         spec, opt, fuse_mubatches, clip_norm, name="epoch_kernel"
     )
     from shallowspeed_tpu import pallas_ops
+    from shallowspeed_tpu.optimizer import MomentumSGD as _Mom
+
+    if type(opt) is _Mom:
+
+        def epoch_core(params, opt_state, X, Y):
+            nb, M_, mb, din = X.shape
+            x = X.reshape(nb, M_ * mb, din)
+            y = Y.reshape(nb, M_ * mb, Y.shape[-1])
+            new_stage, new_vel, mean_loss = pallas_ops.fused_train_epoch_momentum(
+                params[0], opt_state[0], x, y,
+                relu_flags=sspec.relu_flags,
+                group_rows=mb,
+                batch_size=spec.global_batch_size,
+                lr=opt.lr,
+                momentum=opt.momentum,
+                weight_decay=opt.weight_decay,
+                precision=precision,
+            )
+            return [new_stage], [new_vel], mean_loss
+
+        return epoch_core
 
     def epoch_core(params, opt_state, X, Y):
         nb, M_, mb, din = X.shape

@@ -374,3 +374,62 @@ class TestEpochKernel:
                 spec, SGD(0.01), fuse_mubatches=True, megakernel=True,
                 epoch_kernel=True,
             )
+
+
+class TestMomentumKernels:
+    """Heavy-ball variants of the step and epoch kernels: same bar as SGD —
+    BIT-identity (params, velocity state, loss) with the fused XLA path
+    through optimizer.MomentumSGD."""
+
+    def test_step_and_epoch_momentum_bit_identical(self):
+        from shallowspeed_tpu.optimizer import MomentumSGD
+
+        sizes, B, M, nb = (20, 16, 12, 10), 32, 4, 3
+        rng = np.random.RandomState(5)
+        X = jnp.asarray(rng.rand(nb, M, B // M, sizes[0]).astype(np.float32))
+        Y = jnp.asarray(
+            np.eye(sizes[-1], dtype=np.float32)[
+                rng.randint(0, sizes[-1], (nb, M, B // M))
+            ]
+        )
+        spec = Mo.make_model_spec(sizes, 1, B)
+        opt = MomentumSGD(0.01, momentum=0.9, weight_decay=1e-4)
+        out = {}
+        for name, kw in {
+            "xla": {},
+            "mega": {"megakernel": True},
+            "epoch": {"epoch_kernel": True},
+        }.items():
+            params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+            st = opt.init(params)
+            epoch = trainer.make_train_epoch(
+                spec, opt, fuse_mubatches=True, **kw
+            )
+            # two epochs so a nonzero velocity feeds the second one
+            params, st, _ = epoch(params, st, X, Y)
+            params, st, loss = epoch(params, st, X, Y)
+            out[name] = (jax.device_get(params), jax.device_get(st), float(loss))
+        for other in ("mega", "epoch"):
+            assert out["xla"][2] == out[other][2]
+            for tree_idx in (0, 1):  # params, then velocity state
+                for a, b in zip(
+                    jax.tree.leaves(out["xla"][tree_idx]),
+                    jax.tree.leaves(out[other][tree_idx]),
+                ):
+                    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_momentum_kernel_vmem_accounting(self):
+        # exact accounting: momentum adds EXACTLY velocity in+out copies
+        # (2 x params floats) — an undercount (e.g. 1x) would approve
+        # configs that OOM VMEM at Mosaic compile time on chip
+        sizes = (700, 700, 10)
+        params = 700 * 700 + 700 + 700 * 10 + 10
+        assert pallas_ops._kernel_bytes(8, sizes, momentum=True) == (
+            pallas_ops._kernel_bytes(8, sizes, momentum=False) + 4 * 2 * params
+        )
+        # boundary: this config fits the SGD budget but NOT the momentum
+        # budget — the validator must catch the difference
+        assert pallas_ops.train_step_kernel_fits(128, sizes)
+        assert not pallas_ops.train_step_kernel_fits(128, sizes, momentum=True)
+        # the flagship class fits both
+        assert pallas_ops.train_step_kernel_fits(128, (784, 128, 10), momentum=True)
