@@ -56,43 +56,15 @@ def _make_batch_step(
     """
     if megakernel:
         sspec = _validate_megakernel(spec, opt, fuse_mubatches, clip_norm)
-        from shallowspeed_tpu import pallas_ops
-        from shallowspeed_tpu.optimizer import MomentumSGD as _Mom
-
-        if type(opt) is _Mom:
-
-            def mega_step(params, opt_state, xb, yb):
-                rows = xb.shape[1]
-                x = xb.reshape(-1, xb.shape[-1])
-                y = yb.reshape(-1, yb.shape[-1])
-                new_stage, new_vel, loss = pallas_ops.fused_train_step_momentum(
-                    params[0], opt_state[0], x, y,
-                    relu_flags=sspec.relu_flags,
-                    group_rows=rows,
-                    batch_size=spec.global_batch_size,
-                    lr=opt.lr,
-                    momentum=opt.momentum,
-                    weight_decay=opt.weight_decay,
-                    precision=precision,
-                )
-                return [new_stage], [new_vel], loss
-
-            return mega_step
 
         def mega_step(params, opt_state, xb, yb):
             rows = xb.shape[1]
             x = xb.reshape(-1, xb.shape[-1])
             y = yb.reshape(-1, yb.shape[-1])
-            new_stage, loss = pallas_ops.fused_train_step_sgd(
-                params[0], x, y,
-                relu_flags=sspec.relu_flags,
-                group_rows=rows,
-                batch_size=spec.global_batch_size,
-                lr=opt.lr,
-                weight_decay=opt.weight_decay,
-                precision=precision,
+            return _fused_kernel_call(
+                spec, sspec, opt, precision, params, opt_state, x, y,
+                epoch_mode=False, group_rows=rows,
             )
-            return [new_stage], opt_state, loss
 
         return mega_step
 
@@ -182,45 +154,42 @@ def _make_epoch_kernel_core(spec, opt, precision, fuse_mubatches, clip_norm):
     sspec = _validate_megakernel(
         spec, opt, fuse_mubatches, clip_norm, name="epoch_kernel"
     )
-    from shallowspeed_tpu import pallas_ops
-    from shallowspeed_tpu.optimizer import MomentumSGD as _Mom
-
-    if type(opt) is _Mom:
-
-        def epoch_core(params, opt_state, X, Y):
-            nb, M_, mb, din = X.shape
-            x = X.reshape(nb, M_ * mb, din)
-            y = Y.reshape(nb, M_ * mb, Y.shape[-1])
-            new_stage, new_vel, mean_loss = pallas_ops.fused_train_epoch_momentum(
-                params[0], opt_state[0], x, y,
-                relu_flags=sspec.relu_flags,
-                group_rows=mb,
-                batch_size=spec.global_batch_size,
-                lr=opt.lr,
-                momentum=opt.momentum,
-                weight_decay=opt.weight_decay,
-                precision=precision,
-            )
-            return [new_stage], [new_vel], mean_loss
-
-        return epoch_core
 
     def epoch_core(params, opt_state, X, Y):
         nb, M_, mb, din = X.shape
         x = X.reshape(nb, M_ * mb, din)
         y = Y.reshape(nb, M_ * mb, Y.shape[-1])
-        new_stage, mean_loss = pallas_ops.fused_train_epoch_sgd(
-            params[0], x, y,
-            relu_flags=sspec.relu_flags,
-            group_rows=mb,
-            batch_size=spec.global_batch_size,
-            lr=opt.lr,
-            weight_decay=opt.weight_decay,
-            precision=precision,
+        return _fused_kernel_call(
+            spec, sspec, opt, precision, params, opt_state, x, y,
+            epoch_mode=True, group_rows=mb,
         )
-        return [new_stage], opt_state, mean_loss
 
     return epoch_core
+
+
+def _fused_kernel_call(
+    spec, sspec, opt, precision, params, opt_state, x, y, *, epoch_mode,
+    group_rows,
+):
+    """The one trainer->pallas_ops bridge for every mega/epoch-kernel
+    variant: threads velocity (opt_state[0]) for momentum, keeps the ()
+    state for SGD. Returns ``(params, opt_state, loss)``."""
+    from shallowspeed_tpu import pallas_ops
+    from shallowspeed_tpu.optimizer import MomentumSGD as _Mom
+
+    is_mom = type(opt) is _Mom
+    new_stage, new_vel, loss = pallas_ops._fused_train_call(
+        params[0], opt_state[0] if is_mom else None, x, y,
+        epoch_mode=epoch_mode,
+        relu_flags=sspec.relu_flags,
+        group_rows=group_rows,
+        batch_size=spec.global_batch_size,
+        lr=opt.lr,
+        momentum=opt.momentum if is_mom else None,
+        weight_decay=opt.weight_decay,
+        precision=precision,
+    )
+    return [new_stage], ([new_vel] if is_mom else opt_state), loss
 
 
 def make_train_step(

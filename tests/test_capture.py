@@ -1,0 +1,128 @@
+"""tpu_capture.main() plumbing test — the capture script runs at most once
+per chip-claim window (the tunnel wedges for hours between them), so a
+signature mismatch or key error anywhere in its phase sequence would burn
+the round's only hardware window. This runs the REAL main() with every
+heavy measurement stubbed: phase ordering, checkpoint-after-every-phase,
+result-key assembly and the rename-into-place contract are exercised for
+real; only the timing/convergence/trace work is faked.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def capture_mod():
+    added = []
+    for p in (str(ROOT), str(ROOT / "scripts")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+            added.append(p)
+    import tpu_capture
+
+    yield tpu_capture
+    for p in added:
+        sys.path.remove(p)
+
+
+def test_capture_main_plumbing(tmp_path, monkeypatch, capture_mod):
+    tc = capture_mod
+    import bench
+    import bench_tpu_matrix
+
+    eq = {"max_abs_param_diff": 0.0, "loss_abs_diff": 0.0, "bitwise_equal": True}
+    monkeypatch.setattr(
+        bench, "_ensure_responsive_backend",
+        lambda *a, **k: ("", {"probes": [{"outcome": "ok", "seconds": 1.0}]}),
+    )
+    monkeypatch.setattr(bench, "numpy_baseline_sps", lambda n_batches=40: 50.0)
+    monkeypatch.setattr(
+        tc, "headline_sweep",
+        lambda unrolls, trials, precision="highest": (
+            {f"unroll={u}": 100.0 * u for u in unrolls}, {}
+        ),
+    )
+    monkeypatch.setattr(
+        tc, "megakernel_cells",
+        lambda nb, trials: (
+            {"fused+default+xla": 1.0, "fused+default+mega": 2.0,
+             "fused+default+epoch": 3.0},
+            {},
+            {"mega": eq, "epoch": eq},
+        ),
+    )
+    monkeypatch.setattr(
+        tc, "convergence_run",
+        lambda d, e: {"epochs": e, "final_val_accuracy": 0.99},
+    )
+    monkeypatch.setattr(
+        tc, "megakernel_convergence",
+        lambda d, e, variant="megakernel": {"variant": variant, "epochs": e},
+    )
+    monkeypatch.setattr(
+        tc, "profile_one_epoch", lambda d, t: {"dir": str(t), "n_files": 1}
+    )
+    monkeypatch.setattr(
+        tc, "profile_headline_epoch", lambda t: {"dir": str(t), "n_files": 1}
+    )
+    monkeypatch.setattr(
+        bench_tpu_matrix, "run_matrix",
+        lambda cells, nb, trials: {("fused", "default", "xla"): 123.0},
+    )
+    monkeypatch.setattr(
+        tc, "executor_backend_cells",
+        lambda nb, trials: ({"executor+default+xla": 1.0}, {}, eq),
+    )
+    monkeypatch.setattr(
+        tc, "executor_backend_api_path",
+        lambda d, epochs=2: {"hashes_match": True, "losses_match": True},
+    )
+
+    out = tmp_path / "CAP.json"
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()  # exists -> the prepare_data subprocess is skipped
+    monkeypatch.setattr(
+        sys, "argv",
+        ["tpu_capture.py", "--quick", "--out", str(out),
+         "--data-dir", str(data_dir)],
+    )
+    tc.main()
+
+    assert out.is_file() and not Path(str(out) + ".partial").exists()
+    result = json.loads(out.read_text())
+    for key in (
+        "info", "numpy_baseline_sps", "headline_sweep_default_precision",
+        "headline_best_sps", "vs_baseline", "headline_sweep_fp32_highest",
+        "megakernel_cells", "megakernel_onchip_equality", "convergence",
+        "megakernel_convergence", "epoch_kernel_convergence", "trace",
+        "trace_headline", "matrix", "matrix_full_epoch_fused",
+        "executor_kernel_backends", "executor_onchip_equality",
+        "executor_api_path", "completed_at",
+    ):
+        assert key in result, f"capture artifact missing {key!r}"
+    assert result["epoch_kernel_convergence"]["variant"] == "epoch_kernel"
+    assert result["megakernel_onchip_equality"]["epoch"]["bitwise_equal"]
+
+
+def test_capture_aborts_cleanly_on_wedged_tunnel(tmp_path, monkeypatch, capture_mod):
+    """A wedged probe must exit 3 BEFORE touching the device or writing
+    anything — the claim stays free for a retry."""
+    tc = capture_mod
+    import bench
+
+    monkeypatch.setattr(
+        bench, "_ensure_responsive_backend",
+        lambda *a, **k: ("_CPU_FALLBACK_TUNNEL_UNRESPONSIVE",
+                         {"probes": [{"outcome": "timeout", "seconds": 150.0}]}),
+    )
+    out = tmp_path / "CAP.json"
+    monkeypatch.setattr(sys, "argv", ["tpu_capture.py", "--out", str(out)])
+    with pytest.raises(SystemExit) as exc:
+        tc.main()
+    assert exc.value.code == 3
+    assert not out.exists() and not Path(str(out) + ".partial").exists()
