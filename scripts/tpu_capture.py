@@ -129,9 +129,8 @@ def headline_sweep(unrolls, trials, precision="highest"):
 
 def megakernel_cells(nb, trials):
     """Same-window triple at both precision classes: fused XLA epoch vs the
-    whole-batch mega-kernel (one op per batch, pallas_ops.fused_train_step_
-    sgd) vs the whole-EPOCH kernel (one op per epoch, pallas_ops.fused_
-    train_epoch_sgd). The roofline says the epoch is op-issue bound, so
+    whole-batch mega-kernel (one op per batch) vs the whole-EPOCH kernel
+    (one op per epoch) — both via pallas_ops.fused_train_call. The roofline says the epoch is op-issue bound, so
     these are the direct attacks at two strengths; interleaved trials make
     every ratio a contention-window-free comparison. Numerics are
     interpreter-bit-identical (tested); the on-chip divergence is measured

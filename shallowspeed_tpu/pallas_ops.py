@@ -573,26 +573,69 @@ def _momentum_batch_math(
     return new_ws, new_bs, new_vws, new_vbs, loss
 
 
+def _adam_batch_math(
+    x, y, ws, bs, mws, mbs, vws, vbs, t, *, relu_flags, group_rows,
+    batch_size, lr, b1, b2, eps, decay, precision,
+):
+    """_batch_grads + the Adam/AdamW update (optimizer.Adam.apply: same
+    expression order — ``m <- b1*m + (1-b1)*g; v <- b2*v + (1-b2)*g*g;
+    p <- decay(p) - lr*(m/c1)/(sqrt(v/c2)+eps)`` with bias corrections
+    ``c = 1 - beta**t``): returns ``(new_ws, new_bs, new_mws, new_mbs,
+    new_vws, new_vbs, t_new, loss)``. ``t`` is the traced step counter."""
+    dws, dbs, loss = _batch_grads(
+        x, y, ws, bs, relu_flags=relu_flags, group_rows=group_rows,
+        batch_size=batch_size, precision=precision,
+    )
+    L = len(ws)
+    t_new = t + 1.0
+    new_mws = [b1 * mws[l] + (1 - b1) * dws[l] for l in range(L)]
+    new_mbs = [b1 * mbs[l] + (1 - b1) * dbs[l] for l in range(L)]
+    new_vws = [b2 * vws[l] + (1 - b2) * dws[l] * dws[l] for l in range(L)]
+    new_vbs = [b2 * vbs[l] + (1 - b2) * dbs[l] * dbs[l] for l in range(L)]
+    c1 = 1.0 - b1**t_new
+    c2 = 1.0 - b2**t_new
+    new_ws = [
+        ws[l] * decay - lr * (new_mws[l] / c1) / (jnp.sqrt(new_vws[l] / c2) + eps)
+        for l in range(L)
+    ]
+    new_bs = [
+        bs[l] * decay - lr * (new_mbs[l] / c1) / (jnp.sqrt(new_vbs[l] / c2) + eps)
+        for l in range(L)
+    ]
+    return new_ws, new_bs, new_mws, new_mbs, new_vws, new_vbs, t_new, loss
+
+
+# per-optimizer operand geometry: (param-mirror state groups, scalar slots)
+_OPT_GEOMETRY = {"sgd": (0, 0), "momentum": (1, 0), "adam": (2, 1)}
+
+
 def _train_kernel_body(
-    x_ref, y_ref, *refs, L, relu_flags, group_rows, batch_size, lr, mu, decay,
+    x_ref, y_ref, *refs, L, relu_flags, group_rows, batch_size, lr, opt, decay,
     precision, epoch_mode,
 ):
-    """THE training kernel body — all four public variants compile from this
-    one definition so the plumbing cannot drift:
+    """THE training kernel body — every public variant (step/epoch x
+    sgd/momentum/adam) compiles from this one definition so the plumbing
+    cannot drift:
 
-    - ``mu``: None = (decaying) SGD; a float = heavy-ball momentum (the
-      operand list then carries velocity mirrors after the params).
+    - ``opt``: {"kind": "sgd"} | {"kind": "momentum", "mu": f} |
+      {"kind": "adam", "b1": f, "b2": f, "eps": f}. The operand list
+      carries one params-mirror group per state mirror (momentum: velocity;
+      adam: m then v) and one (1, 1) block per scalar slot (adam: the step
+      counter t), per _OPT_GEOMETRY.
     - ``epoch_mode``: False = one batch per launch (refs are plain in/out);
       True = the grid is the batch axis — inputs seed the REVISITED output
-      blocks at grid step 0, which then hold the live params (+ velocity)
-      in VMEM for the whole epoch, and the loss block accumulates the
+      blocks at grid step 0, which then hold the live params + state in
+      VMEM for the whole epoch, and the loss block accumulates the
       per-batch losses before a final divide (matching the epoch scan's
       sum-then-divide order exactly).
 
     Operand layout: ``[x, y] + ins + outs + [loss]`` where ``ins``/``outs``
-    are ``w*L + b*L`` (+ ``vw*L + vb*L`` with momentum).
+    are ``w*L + b*L`` then mirror groups (each ``w*L + b*L``-shaped) then
+    scalar (1, 1) blocks.
     """
-    n = (2 if mu is None else 4) * L
+    kind = opt["kind"]
+    n_mirrors, n_scalars = _OPT_GEOMETRY[kind]
+    n = 2 * L * (1 + n_mirrors) + n_scalars
     ins = refs[:n]
     outs = refs[n : 2 * n]
     loss_ref = refs[2 * n]
@@ -607,29 +650,42 @@ def _train_kernel_body(
                 outs[i][:] = ins[i][:]
             loss_ref[0, 0] = 0.0
 
-        src = outs  # current state lives in the revisited output blocks
+        src = outs  # current params + state live in the revisited out blocks
     else:
         src = ins
 
     ws = [src[i][:] for i in range(L)]
     bs = [src[L + i][:] for i in range(L)]
-    if mu is None:
+    common = dict(
+        relu_flags=relu_flags, group_rows=group_rows, batch_size=batch_size,
+        lr=lr, decay=decay, precision=precision,
+    )
+    if kind == "sgd":
         new_ws, new_bs, loss = _sgd_batch_math(
-            x_ref[:], y_ref[:], ws, bs,
-            relu_flags=relu_flags, group_rows=group_rows,
-            batch_size=batch_size, lr=lr, decay=decay, precision=precision,
+            x_ref[:], y_ref[:], ws, bs, **common
         )
         new_vals = new_ws + new_bs
-    else:
+    elif kind == "momentum":
         vws = [src[2 * L + i][:] for i in range(L)]
         vbs = [src[3 * L + i][:] for i in range(L)]
         new_ws, new_bs, new_vws, new_vbs, loss = _momentum_batch_math(
-            x_ref[:], y_ref[:], ws, bs, vws, vbs,
-            relu_flags=relu_flags, group_rows=group_rows,
-            batch_size=batch_size, lr=lr, mu=mu, decay=decay,
-            precision=precision,
+            x_ref[:], y_ref[:], ws, bs, vws, vbs, mu=opt["mu"], **common
         )
         new_vals = new_ws + new_bs + new_vws + new_vbs
+    else:  # adam
+        mws = [src[2 * L + i][:] for i in range(L)]
+        mbs = [src[3 * L + i][:] for i in range(L)]
+        vws = [src[4 * L + i][:] for i in range(L)]
+        vbs = [src[5 * L + i][:] for i in range(L)]
+        t = src[6 * L][0, 0]
+        new_ws, new_bs, new_mws, new_mbs, new_vws, new_vbs, t_new, loss = (
+            _adam_batch_math(
+                x_ref[:], y_ref[:], ws, bs, mws, mbs, vws, vbs, t,
+                b1=opt["b1"], b2=opt["b2"], eps=opt["eps"], **common,
+            )
+        )
+        new_vals = new_ws + new_bs + new_mws + new_mbs + new_vws + new_vbs
+        outs[6 * L][0, 0] = t_new
     for i, v in enumerate(new_vals):
         outs[i][:] = v
 
@@ -644,29 +700,42 @@ def _train_kernel_body(
         loss_ref[0, 0] = loss
 
 
-def _fused_train_call(
-    stage_params, velocity, x, y, *, epoch_mode, relu_flags, group_rows,
-    batch_size, lr, momentum, weight_decay, precision,
+def fused_train_call(
+    stage_params, x, y, *, epoch_mode, relu_flags, group_rows,
+    batch_size, lr, weight_decay, precision, opt=None, mirrors=(), scalars=(),
 ):
-    """The one pallas_call builder behind every fused-training variant:
-    assembles the flat operand list, the (optional) batch-axis grid with
-    constant-index param blocks, and unpacks the outputs. Returns
-    ``(new_stage_params, new_velocity_or_None, loss)``."""
+    """THE public entry point for every fused-training kernel variant
+    (step/epoch x sgd/momentum/adam — trainer._fused_kernel_call is the
+    sole caller and owns the optimizer-state mapping): assembles the flat
+    operand list (params, then one mirror group per optimizer state
+    mirror, then (1, 1) scalar slots), the (optional) batch-axis grid with
+    constant-index blocks, and unpacks the outputs. ``opt`` is the
+    kernel-body optimizer descriptor (default plain SGD; see
+    _train_kernel_body); ``mirrors``/``scalars`` must match its
+    _OPT_GEOMETRY. ``epoch_mode=False`` takes x: (B, in), y: (B, out) and
+    runs one batch; ``epoch_mode=True`` takes X: (nb, B, in), Y: (nb, B,
+    out) and runs the whole epoch as one kernel. Returns
+    ``(new_stage_params, new_mirrors, new_scalars, loss)``."""
     from shallowspeed_tpu.optimizer import _decay_factor
 
+    opt = opt or {"kind": "sgd"}
+    assert _OPT_GEOMETRY[opt["kind"]] == (len(mirrors), len(scalars))
     L = len(stage_params)
-    flat = [sp["W"] for sp in stage_params] + [
-        jnp.reshape(sp["b"], (1, -1)) for sp in stage_params
-    ]
-    if velocity is not None:
-        flat += [v["W"] for v in velocity] + [
-            jnp.reshape(v["b"], (1, -1)) for v in velocity
+
+    def flat_group(group):
+        return [sp["W"] for sp in group] + [
+            jnp.reshape(sp["b"], (1, -1)) for sp in group
         ]
+
+    flat = flat_group(stage_params)
+    for mirror in mirrors:
+        flat += flat_group(mirror)
+    flat += [jnp.reshape(jnp.asarray(s, jnp.float32), (1, 1)) for s in scalars]
     decay = _decay_factor(lr, weight_decay) if weight_decay else 1.0
     kernel = functools.partial(
         _train_kernel_body,
         L=L, relu_flags=tuple(relu_flags), group_rows=group_rows,
-        batch_size=batch_size, lr=lr, mu=momentum, decay=decay,
+        batch_size=batch_size, lr=lr, opt=opt, decay=decay,
         precision=precision, epoch_mode=epoch_mode,
     )
     out_shape = tuple(
@@ -700,50 +769,21 @@ def _fused_train_call(
     outs = pl.pallas_call(
         kernel, out_shape=out_shape, interpret=_interpret(), **call_kwargs
     )(x, y, *flat)
-    new_params = [{"W": outs[l], "b": outs[L + l]} for l in range(L)]
-    new_vel = (
-        None
-        if velocity is None
-        else [{"W": outs[2 * L + l], "b": outs[3 * L + l]} for l in range(L)]
-    )
-    return new_params, new_vel, outs[len(flat)][0, 0]
+
+    def unflat_group(g):
+        base = 2 * L * g
+        return [{"W": outs[base + l], "b": outs[base + L + l]} for l in range(L)]
+
+    new_params = unflat_group(0)
+    new_mirrors = [unflat_group(1 + i) for i in range(len(mirrors))]
+    sc_base = 2 * L * (1 + len(mirrors))
+    new_scalars = [
+        jnp.reshape(outs[sc_base + i], ()) for i in range(len(scalars))
+    ]
+    return new_params, new_mirrors, new_scalars, outs[len(flat)][0, 0]
 
 
-def fused_train_step_sgd(
-    stage_params, x, y, *, relu_flags, group_rows, batch_size, lr,
-    weight_decay=0.0, precision=None,
-):
-    """One SGD training batch as ONE kernel: ``(new_stage_params, loss)``.
 
-    ``stage_params``: the sequential path's single-stage param list
-    [{"W": (out,in), "b": (1,out)}, ...]; ``x``: (B, in_dim); ``y``: (B,
-    out_dim) one-hot. Semantics == trainer's fuse_mubatches batch_step with
-    a (possibly decaying) SGD optimizer: ``group_rows`` is the microbatch
-    row count feeding the grouped stability max, ``batch_size`` the GLOBAL
-    batch scaling the loss. Single block: every operand + activations must
-    fit VMEM (true for the flagship class; see train_step_kernel_fits).
-    """
-    new_params, _, loss = _fused_train_call(
-        stage_params, None, x, y, epoch_mode=False, relu_flags=relu_flags,
-        group_rows=group_rows, batch_size=batch_size, lr=lr, momentum=None,
-        weight_decay=weight_decay, precision=precision,
-    )
-    return new_params, loss
-
-
-def fused_train_step_momentum(
-    stage_params, velocity, x, y, *, relu_flags, group_rows, batch_size, lr,
-    momentum, weight_decay=0.0, precision=None,
-):
-    """One heavy-ball training batch as ONE kernel:
-    ``(new_stage_params, new_velocity, loss)``. Semantics ==
-    fused_train_step_sgd with optimizer.MomentumSGD's update; ``velocity``
-    mirrors ``stage_params`` ([{"W", "b"}, ...])."""
-    return _fused_train_call(
-        stage_params, velocity, x, y, epoch_mode=False, relu_flags=relu_flags,
-        group_rows=group_rows, batch_size=batch_size, lr=lr, momentum=momentum,
-        weight_decay=weight_decay, precision=precision,
-    )
 
 
 # ---------------------------------------------------------------------------
@@ -764,51 +804,22 @@ def fused_train_step_momentum(
 # on-chip equality measured by capture phase 2c).
 
 
-def fused_train_epoch_sgd(
-    stage_params, X, Y, *, relu_flags, group_rows, batch_size, lr,
-    weight_decay=0.0, precision=None,
-):
-    """One SGD training EPOCH as ONE kernel: ``(new_stage_params, mean_loss)``.
-
-    ``X``: (num_batches, B, in_dim); ``Y``: (num_batches, B, out_dim)
-    one-hot. Semantics == lax.scan of fused_train_step_sgd over the batch
-    axis (same per-batch expressions, same loss-sum-then-divide order) with
-    zero per-batch dispatches. VMEM feasibility == the step kernel's
-    (train_step_kernel_fits) plus the streamed (B, in_dim) x/y blocks
-    (train_epoch_kernel_fits).
-    """
-    new_params, _, loss = _fused_train_call(
-        stage_params, None, X, Y, epoch_mode=True, relu_flags=relu_flags,
-        group_rows=group_rows, batch_size=batch_size, lr=lr, momentum=None,
-        weight_decay=weight_decay, precision=precision,
-    )
-    return new_params, loss
 
 
-def fused_train_epoch_momentum(
-    stage_params, velocity, X, Y, *, relu_flags, group_rows, batch_size, lr,
-    momentum, weight_decay=0.0, precision=None,
-):
-    """One heavy-ball training EPOCH as ONE kernel:
-    ``(new_stage_params, new_velocity, mean_loss)`` — fused_train_epoch_sgd
-    with the momentum update; params AND velocity ride revisited output
-    blocks across the grid."""
-    return _fused_train_call(
-        stage_params, velocity, X, Y, epoch_mode=True, relu_flags=relu_flags,
-        group_rows=group_rows, batch_size=batch_size, lr=lr, momentum=momentum,
-        weight_decay=weight_decay, precision=precision,
-    )
 
-
-def train_step_kernel_fits(batch_rows, sizes, momentum=False):
+def train_step_kernel_fits(batch_rows, sizes, state_mirrors=0):
     """Conservative VMEM feasibility check for the mega-kernel: params (x2
-    for the updated copies; x4 with momentum's velocity in+out), activations
-    + masks at ``batch_rows``, and the input batch, against the single-block
+    for the updated copies, plus in+out copies of each optimizer state
+    mirror — momentum: 1 velocity mirror, adam: m and v), activations +
+    masks at ``batch_rows``, and the input batch, against the single-block
     budget."""
-    return _kernel_bytes(batch_rows, sizes, momentum) <= SINGLE_BLOCK_BUDGET_BYTES
+    return (
+        _kernel_bytes(batch_rows, sizes, state_mirrors)
+        <= SINGLE_BLOCK_BUDGET_BYTES
+    )
 
 
-def train_epoch_kernel_fits(batch_rows, sizes, momentum=False):
+def train_epoch_kernel_fits(batch_rows, sizes, state_mirrors=0):
     """VMEM feasibility for the whole-EPOCH kernel: the step kernel's
     working set PLUS a second copy of the streamed x/y blocks — Pallas
     double-buffers the per-grid-step input fetches, so two batches' worth
@@ -816,15 +827,15 @@ def train_epoch_kernel_fits(batch_rows, sizes, momentum=False):
     widths = list(sizes)
     stream_extra = 4 * batch_rows * (widths[0] + widths[-1])
     return (
-        _kernel_bytes(batch_rows, sizes, momentum) + stream_extra
+        _kernel_bytes(batch_rows, sizes, state_mirrors) + stream_extra
         <= SINGLE_BLOCK_BUDGET_BYTES
     )
 
 
-def _kernel_bytes(batch_rows, sizes, momentum=False):
+def _kernel_bytes(batch_rows, sizes, state_mirrors=0):
     widths = list(sizes)
     params = sum(widths[i] * widths[i + 1] + widths[i + 1] for i in range(len(widths) - 1))
-    state = 2 * params if momentum else 0  # velocity in + out copies
+    state = 2 * params * state_mirrors  # in + out copies per state mirror
     acts = batch_rows * sum(widths)  # layer inputs
     masks = batch_rows * sum(widths[1:-1])
     io = batch_rows * (widths[0] + widths[-1])

@@ -50,7 +50,7 @@ def _make_batch_step(
     ``megakernel=True`` (requires ``fuse_mubatches``, a plain/decaying SGD,
     no clipping, a single-stage spec) runs the ENTIRE batch — forward,
     head, backward, update — as ONE Pallas kernel
-    (pallas_ops.fused_train_step_sgd). Identical float math; exists because
+    (pallas_ops.fused_train_call). Identical float math; exists because
     the epoch is op-issue-latency bound (docs/performance.md roofline) and
     one op per batch is the shortest possible serial chain.
     """
@@ -110,22 +110,40 @@ def _make_batch_step(
     return batch_step
 
 
+def _kernel_opt_descriptor(opt):
+    """Map a framework optimizer onto the unified kernel's descriptor
+    (pallas_ops._train_kernel_body's ``opt``), or None if the kernels don't
+    support it. The descriptor's kind keys _OPT_GEOMETRY (state mirrors +
+    scalar slots), so the VMEM accounting and operand assembly stay in
+    lockstep with this one mapping."""
+    from shallowspeed_tpu.optimizer import SGD, Adam, MomentumSGD
+
+    if type(opt) is SGD:
+        return {"kind": "sgd"}
+    if type(opt) is MomentumSGD:
+        return {"kind": "momentum", "mu": opt.momentum}
+    if type(opt) is Adam:
+        return {"kind": "adam", "b1": opt.b1, "b2": opt.b2, "eps": opt.eps}
+    return None
+
+
 def _validate_megakernel(spec, opt, fuse_mubatches, clip_norm, name="megakernel"):
     """The mega-kernel constraint set, shared by the per-batch and whole-epoch
-    variants: fused microbatches, (decaying) SGD or heavy-ball momentum, no
-    clipping, single stage, within the variant's VMEM budget (momentum's
-    velocity doubles the param-state footprint; the epoch kernel
-    additionally holds the double-buffered streamed x/y blocks). Returns
-    the single stage's spec."""
+    variants: fused microbatches, a kernel-supported optimizer (SGD,
+    momentum, adam), no clipping, single stage, within the variant's VMEM
+    budget (each optimizer state mirror — momentum's velocity, adam's
+    m and v — adds a params-sized in+out pair to the footprint; the epoch
+    kernel additionally holds the double-buffered streamed x/y blocks).
+    Returns the single stage's spec."""
     from shallowspeed_tpu import pallas_ops
-    from shallowspeed_tpu.optimizer import SGD as _SGD
-    from shallowspeed_tpu.optimizer import MomentumSGD as _Mom
 
     if not fuse_mubatches:
         raise ValueError(f"{name} requires fuse_mubatches=True")
-    if type(opt) not in (_SGD, _Mom):
+    desc = _kernel_opt_descriptor(opt)
+    if desc is None:
         raise ValueError(
-            f"{name} supports the (decaying) SGD and momentum optimizers only"
+            f"{name} supports the (decaying) SGD, momentum and adam "
+            f"optimizers only"
         )
     if clip_norm is not None:
         raise ValueError(f"{name} does not support clip_norm")
@@ -137,15 +155,17 @@ def _validate_megakernel(spec, opt, fuse_mubatches, clip_norm, name="megakernel"
         if name == "epoch_kernel"
         else pallas_ops.train_step_kernel_fits
     )
+    n_mirrors, _ = pallas_ops._OPT_GEOMETRY[desc["kind"]]
     if not fits(
-        spec.global_batch_size, sspec.local_sizes, momentum=type(opt) is _Mom
+        spec.global_batch_size, sspec.local_sizes, state_mirrors=n_mirrors
     ):
         raise ValueError(f"model + batch exceed the {name} VMEM budget")
     return sspec
 
 
 def _make_epoch_kernel_core(spec, opt, precision, fuse_mubatches, clip_norm):
-    """Whole-epoch mega-kernel core (pallas_ops.fused_train_epoch_sgd): the
+    """Whole-epoch mega-kernel core (pallas_ops.fused_train_call with
+    epoch_mode=True): the
     batch axis becomes the Pallas grid, params stay VMEM-resident across the
     epoch, and the per-epoch serial op chain drops from one kernel per batch
     to ONE kernel total. Same signature as _make_epoch_core's result; batch
@@ -172,24 +192,42 @@ def _fused_kernel_call(
     group_rows,
 ):
     """The one trainer->pallas_ops bridge for every mega/epoch-kernel
-    variant: threads velocity (opt_state[0]) for momentum, keeps the ()
-    state for SGD. Returns ``(params, opt_state, loss)``."""
+    variant: maps the framework optimizer state onto the kernel's mirror
+    groups + scalar slots and back. Returns ``(params, opt_state, loss)``.
+    State mapping: SGD () stays (); momentum's params-mirror rides as one
+    mirror group; adam's {"m", "v", "t"} rides as two mirror groups + the
+    t scalar slot."""
     from shallowspeed_tpu import pallas_ops
-    from shallowspeed_tpu.optimizer import MomentumSGD as _Mom
 
-    is_mom = type(opt) is _Mom
-    new_stage, new_vel, loss = pallas_ops._fused_train_call(
-        params[0], opt_state[0] if is_mom else None, x, y,
+    desc = _kernel_opt_descriptor(opt)
+    kind = desc["kind"]
+    if kind == "momentum":
+        mirrors, scalars = (opt_state[0],), ()
+    elif kind == "adam":
+        mirrors = (opt_state["m"][0], opt_state["v"][0])
+        scalars = (opt_state["t"],)
+    else:
+        mirrors, scalars = (), ()
+    new_stage, new_mirrors, new_scalars, loss = pallas_ops.fused_train_call(
+        params[0], x, y,
         epoch_mode=epoch_mode,
         relu_flags=sspec.relu_flags,
         group_rows=group_rows,
         batch_size=spec.global_batch_size,
         lr=opt.lr,
-        momentum=opt.momentum if is_mom else None,
         weight_decay=opt.weight_decay,
         precision=precision,
+        opt=desc, mirrors=mirrors, scalars=scalars,
     )
-    return [new_stage], ([new_vel] if is_mom else opt_state), loss
+    if kind == "momentum":
+        new_state = [new_mirrors[0]]
+    elif kind == "adam":
+        new_state = {
+            "m": [new_mirrors[0]], "v": [new_mirrors[1]], "t": new_scalars[0]
+        }
+    else:
+        new_state = opt_state
+    return [new_stage], new_state, loss
 
 
 def make_train_step(

@@ -202,7 +202,7 @@ class TestModelIntegration:
 
 
 class TestMegaKernel:
-    """The whole-training-step kernel (fused_train_step_sgd): one op per
+    """The whole-training-step kernel (fused_train_call, step mode): one op per
     batch — forward, grouped-softmax MSE head, backward, SGD update. The
     bar is BIT-identity with the fused XLA path at both precision classes
     (same dots, same grouped stability max, same update expression)."""
@@ -268,14 +268,15 @@ class TestMegaKernel:
         np.testing.assert_array_equal(res[False][1], res[True][1])
 
     def test_megakernel_guards(self):
-        from shallowspeed_tpu.optimizer import Adam
+        class NotAnOptimizer:
+            pass
 
         spec = Mo.make_model_spec((20, 16, 12, 10), 1, 32)
         with pytest.raises(ValueError, match="fuse_mubatches"):
             trainer.make_train_epoch(spec, SGD(0.01), megakernel=True)
-        with pytest.raises(ValueError, match="SGD"):
+        with pytest.raises(ValueError, match="SGD, momentum and adam"):
             trainer.make_train_epoch(
-                spec, Adam(0.01), fuse_mubatches=True, megakernel=True
+                spec, NotAnOptimizer(), fuse_mubatches=True, megakernel=True
             )
         with pytest.raises(ValueError, match="clip_norm"):
             trainer.make_train_epoch(
@@ -294,7 +295,7 @@ class TestMegaKernel:
 
 
 class TestEpochKernel:
-    """The whole-EPOCH kernel (fused_train_epoch_sgd): the batch axis is the
+    """The whole-EPOCH kernel (fused_train_call, epoch_mode): the batch axis is the
     Pallas grid, params ride the revisited output blocks — one device op per
     epoch. The bar is BIT-identity with the fused XLA epoch (and hence the
     per-batch mega-kernel) at both precision classes."""
@@ -418,18 +419,66 @@ class TestMomentumKernels:
                 ):
                     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
-    def test_momentum_kernel_vmem_accounting(self):
-        # exact accounting: momentum adds EXACTLY velocity in+out copies
-        # (2 x params floats) — an undercount (e.g. 1x) would approve
-        # configs that OOM VMEM at Mosaic compile time on chip
+    def test_state_mirror_vmem_accounting(self):
+        # exact accounting: each state mirror adds EXACTLY in+out copies
+        # (2 x params floats) — an undercount would approve configs that
+        # OOM VMEM at Mosaic compile time on chip
         sizes = (700, 700, 10)
         params = 700 * 700 + 700 + 700 * 10 + 10
-        assert pallas_ops._kernel_bytes(8, sizes, momentum=True) == (
-            pallas_ops._kernel_bytes(8, sizes, momentum=False) + 4 * 2 * params
-        )
+        for n in (1, 2):  # momentum, adam
+            assert pallas_ops._kernel_bytes(8, sizes, state_mirrors=n) == (
+                pallas_ops._kernel_bytes(8, sizes) + n * 4 * 2 * params
+            )
         # boundary: this config fits the SGD budget but NOT the momentum
-        # budget — the validator must catch the difference
+        # (or adam) budget — the validator must catch the difference
         assert pallas_ops.train_step_kernel_fits(128, sizes)
-        assert not pallas_ops.train_step_kernel_fits(128, sizes, momentum=True)
-        # the flagship class fits both
-        assert pallas_ops.train_step_kernel_fits(128, (784, 128, 10), momentum=True)
+        assert not pallas_ops.train_step_kernel_fits(128, sizes, state_mirrors=1)
+        # the flagship class fits even adam's two mirrors
+        assert pallas_ops.train_step_kernel_fits(
+            128, (784, 128, 10), state_mirrors=2
+        )
+
+
+class TestAdamKernels:
+    """Adam/AdamW variants of the step and epoch kernels: BIT-identity
+    (params, both moment mirrors, the step counter, loss) with the fused
+    XLA path through optimizer.Adam — the bias-correction powers b**t use
+    the same traced-t expression as Adam.apply."""
+
+    def test_step_and_epoch_adam_bit_identical(self):
+        from shallowspeed_tpu.optimizer import Adam
+
+        sizes, B, M, nb = (20, 16, 12, 10), 32, 4, 3
+        rng = np.random.RandomState(7)
+        X = jnp.asarray(rng.rand(nb, M, B // M, sizes[0]).astype(np.float32))
+        Y = jnp.asarray(
+            np.eye(sizes[-1], dtype=np.float32)[
+                rng.randint(0, sizes[-1], (nb, M, B // M))
+            ]
+        )
+        spec = Mo.make_model_spec(sizes, 1, B)
+        opt = Adam(2e-4, weight_decay=1e-4)
+        out = {}
+        for name, kw in {
+            "xla": {},
+            "mega": {"megakernel": True},
+            "epoch": {"epoch_kernel": True},
+        }.items():
+            params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+            st = opt.init(params)
+            epoch = trainer.make_train_epoch(
+                spec, opt, fuse_mubatches=True, **kw
+            )
+            # two epochs so nonzero moments + a mid-range t feed epoch 2
+            params, st, _ = epoch(params, st, X, Y)
+            params, st, loss = epoch(params, st, X, Y)
+            out[name] = (jax.device_get(params), jax.device_get(st), float(loss))
+        for other in ("mega", "epoch"):
+            assert out["xla"][2] == out[other][2]
+            assert float(out["xla"][1]["t"]) == float(out[other][1]["t"]) == 2 * nb
+            for tree_idx in (0, 1):  # params, then {m, v, t} state
+                for a, b in zip(
+                    jax.tree.leaves(out["xla"][tree_idx]),
+                    jax.tree.leaves(out[other][tree_idx]),
+                ):
+                    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

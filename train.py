@@ -109,14 +109,14 @@ def main():
     ap.add_argument(
         "--megakernel",
         action="store_true",
-        help="with --fuse-mubatches (SGD or momentum): run each training batch as "
+        help="with --fuse-mubatches (SGD, momentum or adam): run each training batch as "
         "ONE Pallas kernel — forward, head, backward and update in a single "
         "op (identical numerics; shortest possible serial op chain)",
     )
     ap.add_argument(
         "--epoch-kernel",
         action="store_true",
-        help="with --fuse-mubatches (SGD or momentum): run each ENTIRE epoch as "
+        help="with --fuse-mubatches (SGD, momentum or adam): run each ENTIRE epoch as "
         "one Pallas kernel — the batch axis is the kernel grid and the "
         "params stay VMEM-resident across the epoch (identical numerics; "
         "one device op per epoch instead of one per batch)",
