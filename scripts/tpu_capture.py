@@ -13,11 +13,15 @@ healthy we capture every number in one process/one device claim:
   4. a jax.profiler trace of one post-compile epoch (artifacts/tpu_trace/);
   5. the single-chip tuning matrix (fusion x precision x pallas backend) and
      full-epoch fused pallas-vs-xla cells, interleaved — the pallas cells
-     compile for real on the chip (non-interpret mode). Deliberately LAST:
+     compile for real on the chip (non-interpret mode). Deliberately LATE:
      kernel compiles are the observed tunnel-wedge trigger, and progress is
      checkpointed to <out>.partial after every phase so a wedge keeps
      everything measured before it (the final artifact is renamed into
-     place with a completed_at marker).
+     place with a completed_at marker);
+  6. adam kernel cells + a 1-epoch adam convergence through the epoch
+     kernel — the very last phase: fresh kernel compiles carry the most
+     wedge risk, and phases are ordered most-valuable-first, so a wedge
+     here loses nothing earlier.
 
 All throughput cells use bench.py's two-point-slope protocol with forced
 host readbacks: on the axon tunnel, dispatch is fully asynchronous and
@@ -127,14 +131,16 @@ def headline_sweep(unrolls, trials, precision="highest"):
     return out, unresolved
 
 
-def megakernel_cells(nb, trials):
-    """Same-window triple at both precision classes: fused XLA epoch vs the
-    whole-batch mega-kernel (one op per batch) vs the whole-EPOCH kernel
-    (one op per epoch) — both via pallas_ops.fused_train_call. The roofline says the epoch is op-issue bound, so
-    these are the direct attacks at two strengths; interleaved trials make
-    every ratio a contention-window-free comparison. Numerics are
-    interpreter-bit-identical (tested); the on-chip divergence is measured
-    first and recorded."""
+def _kernel_variant_cells(opt, precisions, key_fmt, nb, trials, label):
+    """Shared measurement for one optimizer's xla/mega/epoch kernel triple:
+    the on-chip equality probe runs FIRST (ADVICE r03 — the kernels'
+    bit-identity with fused XLA is interpreter-verified on CPU, but Mosaic's
+    compiled lowering is not guaranteed bitwise-equal on hardware, so the
+    actual divergence of one 2-batch epoch from identical params+state is
+    measured and recorded), then every (precision, variant) cell is timed
+    with interleaved trials so all ratios are same-window. ONE definition
+    for the SGD and adam phases so the probe/timing discipline cannot
+    drift."""
     import jax
     import jax.numpy as jnp
 
@@ -142,12 +148,10 @@ def megakernel_cells(nb, trials):
     from shallowspeed_tpu import trainer
     from shallowspeed_tpu.api import (
         FLAGSHIP_BATCH as B,
-        FLAGSHIP_LR as LR,
         FLAGSHIP_MUBATCHES as M,
         FLAGSHIP_SIZES as SIZES,
         PRECISIONS,
     )
-    from shallowspeed_tpu.optimizer import SGD
 
     spec = Mo.make_model_spec(SIZES, 1, B)
     rng = np.random.RandomState(0)
@@ -155,12 +159,6 @@ def megakernel_cells(nb, trials):
     Y = jnp.asarray(
         np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, M, B // M))]
     )
-
-    # On-chip equality probe BEFORE timing (ADVICE r03): the kernels'
-    # bit-identity with fused XLA is interpreter-verified on CPU, but
-    # Mosaic's compiled dots/exp are not guaranteed bitwise-equal to XLA's
-    # lowering on hardware — measure the actual divergence of one 2-batch
-    # epoch from identical params and record it in the artifact.
     VARIANTS = {
         "xla": {},
         "mega": {"megakernel": True},
@@ -169,31 +167,46 @@ def megakernel_cells(nb, trials):
     eq_outs = {}
     for name, kw in VARIANTS.items():
         epoch = trainer.make_train_epoch(
-            spec, SGD(LR), precision=PRECISIONS["highest"],
-            fuse_mubatches=True, **kw,
+            spec, opt, precision=PRECISIONS["highest"], fuse_mubatches=True, **kw
         )
         params0 = jax.tree.map(jnp.asarray, Mo.init_model(spec))
-        p, _, loss = epoch(params0, (), X[:2], Y[:2])
-        eq_outs[name] = (jax.device_get(p), float(loss))
+        p, st, loss = epoch(params0, opt.init(params0), X[:2], Y[:2])
+        # params AND optimizer state in the equality tree (state is () for
+        # SGD, so the record is unchanged there)
+        eq_outs[name] = ((jax.device_get(p), jax.device_get(st)), float(loss))
     equality = {
         name: _equality_record(eq_outs["xla"], eq_outs[name])
         for name in ("mega", "epoch")
     }
-    print(f"  on-chip equality vs fused-xla (fp32): {equality}", flush=True)
+    print(f"  on-chip {label} equality vs fused-xla (fp32): {equality}", flush=True)
 
     run_ks = {}
-    for prec in ("default", "highest"):
+    for prec in precisions:
         for name, kw in VARIANTS.items():
             epoch = trainer.make_train_epoch(
-                spec, SGD(LR), precision=PRECISIONS[prec],
-                fuse_mubatches=True, **kw,
+                spec, opt, precision=PRECISIONS[prec], fuse_mubatches=True, **kw
             )
             params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
-            key = f"fused+{prec}+{name}"
-            run_ks[key] = bench.make_run_k(epoch, params, (), X, Y)
+            key = key_fmt.format(prec=prec, name=name)
+            run_ks[key] = bench.make_run_k(epoch, params, opt.init(params), X, Y)
             print(f"  built {key}", file=sys.stderr, flush=True)
     cells, unresolved = _measure_salvaged(run_ks, trials, nb * B)
     return cells, unresolved, equality
+
+
+def megakernel_cells(nb, trials):
+    """Same-window SGD triple at both precision classes: fused XLA epoch vs
+    the whole-batch mega-kernel (one op per batch) vs the whole-EPOCH kernel
+    (one op per epoch) — both via pallas_ops.fused_train_call. The roofline
+    says the epoch is op-issue bound; these are the direct attacks at two
+    strengths (see _kernel_variant_cells for the probe/timing discipline)."""
+    from shallowspeed_tpu.api import FLAGSHIP_LR as LR
+    from shallowspeed_tpu.optimizer import SGD
+
+    return _kernel_variant_cells(
+        SGD(LR), ("default", "highest"), "fused+{prec}+{name}", nb, trials,
+        label="sgd-kernel",
+    )
 
 
 def megakernel_convergence(data_dir, epochs, variant="megakernel"):
@@ -309,6 +322,39 @@ def executor_backend_api_path(data_dir, epochs=2):
     out["losses_match"] = out["xla"]["losses"] == out["pallas"]["losses"]
     print(f"  API-path executor backends: {out}", flush=True)
     return out
+
+
+def adam_kernel_cells(nb, trials):
+    """Same-window adam triple at the headline precision — adam's few-epoch
+    sweet spot (99.86% after ONE epoch in the round-2 soak) is exactly what
+    a one-op epoch serves (see _kernel_variant_cells)."""
+    from shallowspeed_tpu.optimizer import Adam
+
+    return _kernel_variant_cells(
+        Adam(2e-4), ("default",), "adam+{prec}+{name}", nb, trials,
+        label="adam-kernel",
+    )
+
+
+def adam_epoch_kernel_convergence(data_dir):
+    """1-epoch adam convergence through the epoch kernel at the HEADLINE
+    (default) precision — the config the adam cells time and the README
+    claim cites."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    run = TrainingSession(
+        data_dir=data_dir, optimizer="adam", lr=2e-4, precision="default",
+        fuse_mubatches=True, epoch_kernel=True,
+    )
+    losses, accs = run.train_run(1)
+    result = {
+        "precision": "default",
+        "loss": round(losses[0], 4),
+        "val_accuracy": round(accs[0], 4),
+        "model_hash": run.model_hash(),
+    }
+    print(f"  adam 1-epoch: {result}", flush=True)
+    return result
 
 
 def convergence_run(data_dir, epochs):
@@ -554,6 +600,21 @@ def main():
           "(TrainingSession(kernel_backend=))...", flush=True)
     result["executor_api_path"] = executor_backend_api_path(
         args.data_dir, epochs=1 if args.quick else 2
+    )
+    checkpoint_result()
+
+    print("6) adam kernel triple + 1-epoch adam convergence through the "
+          "epoch kernel...", flush=True)
+    adam_cells, adam_unresolved, adam_eq = adam_kernel_cells(
+        29 if args.quick else 116, 2
+    )
+    result["adam_kernel_cells"] = adam_cells
+    result["adam_onchip_equality"] = adam_eq
+    if adam_unresolved:
+        result["adam_kernel_cells_unresolved"] = adam_unresolved
+    checkpoint_result()
+    result["adam_epoch_kernel_one_epoch"] = adam_epoch_kernel_convergence(
+        args.data_dir
     )
     result["completed_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
     checkpoint_result()

@@ -82,6 +82,17 @@ def test_capture_main_plumbing(tmp_path, monkeypatch, capture_mod):
         tc, "executor_backend_api_path",
         lambda d, epochs=2: {"hashes_match": True, "losses_match": True},
     )
+    monkeypatch.setattr(
+        tc, "adam_kernel_cells",
+        lambda nb, trials: (
+            {"adam+default+xla": 1.0}, {}, {"mega": eq, "epoch": eq}
+        ),
+    )
+    monkeypatch.setattr(
+        tc, "adam_epoch_kernel_convergence",
+        lambda d: {"precision": "default", "loss": 0.1,
+                   "val_accuracy": 0.99, "model_hash": "f" * 40},
+    )
 
     out = tmp_path / "CAP.json"
     data_dir = tmp_path / "data"
@@ -102,7 +113,8 @@ def test_capture_main_plumbing(tmp_path, monkeypatch, capture_mod):
         "megakernel_convergence", "epoch_kernel_convergence", "trace",
         "trace_headline", "matrix", "matrix_full_epoch_fused",
         "executor_kernel_backends", "executor_onchip_equality",
-        "executor_api_path", "completed_at",
+        "executor_api_path", "adam_kernel_cells", "adam_onchip_equality",
+        "adam_epoch_kernel_one_epoch", "completed_at",
     ):
         assert key in result, f"capture artifact missing {key!r}"
     assert result["epoch_kernel_convergence"]["variant"] == "epoch_kernel"
