@@ -64,7 +64,7 @@ def main():
     ap.add_argument("--data-dir", default="/tmp/ssd_data")
     ap.add_argument("--epochs", type=int, default=20, choices=range(1, 1001),
                     metavar="1..1000")
-    ap.add_argument("--out", default=str(ROOT / "TPU_DEFAULT_PRECISION_r02.json"))
+    ap.add_argument("--out", default=str(ROOT / "TPU_DEFAULT_PRECISION_r04.json"))
     args = ap.parse_args()
 
     tag, _probe_diag = bench._ensure_responsive_backend()
