@@ -473,7 +473,8 @@ def flag_kernels_fit(mb, din, dout):
 
 
 def _batch_grads(
-    x, y, ws, bs, *, relu_flags, group_rows, batch_size, precision
+    x, y, ws, bs, *, relu_flags, group_rows, batch_size, precision,
+    clip_norm=None,
 ):
     """The per-batch gradient math shared by every training kernel, on param
     VALUES (already read from refs): L-layer forward with live
@@ -481,7 +482,15 @@ def _batch_grads(
     Returns ``(dws, dbs, loss)`` — gradient SUMS over the batch (the loss
     is pre-scaled by the global batch size, the reference's ledger). ONE
     definition so the bit-identity contract (fused XLA == step kernel ==
-    epoch kernel, any optimizer variant) cannot drift between kernels."""
+    epoch kernel, any optimizer variant) cannot drift between kernels.
+
+    ``clip_norm``: optional global-norm gradient clipping, applied to the
+    batch gradient before it is returned — the same point in the math where
+    the XLA path applies ``optimizer.clip_tree`` to the accumulated batch
+    gradient. The clip goes through ``optimizer.clip_tree`` itself (on the
+    in-kernel gradient VALUES, arranged in the same per-layer {"W","b"}
+    tree shape), so leaf order, accumulation and scale are identical to
+    the XLA path's by construction."""
     L = len(ws)
 
     # ---- forward (activations/masks stay live in VMEM) ----
@@ -536,17 +545,27 @@ def _batch_grads(
                 ge, ws[l], precision=precision,
                 preferred_element_type=jnp.float32,
             )
+
+    if clip_norm is not None:
+        from shallowspeed_tpu.optimizer import clip_tree
+
+        clipped = clip_tree(
+            [{"W": dws[l], "b": dbs[l]} for l in range(L)], clip_norm
+        )
+        dws = [layer["W"] for layer in clipped]
+        dbs = [layer["b"] for layer in clipped]
     return dws, dbs, loss
 
 
 def _sgd_batch_math(
-    x, y, ws, bs, *, relu_flags, group_rows, batch_size, lr, decay, precision
+    x, y, ws, bs, *, relu_flags, group_rows, batch_size, lr, decay, precision,
+    clip_norm=None,
 ):
     """_batch_grads + the (decaying) SGD update: ``(new_ws, new_bs, loss)``.
     Same elementwise update expression as optimizer.SGD.apply."""
     dws, dbs, loss = _batch_grads(
         x, y, ws, bs, relu_flags=relu_flags, group_rows=group_rows,
-        batch_size=batch_size, precision=precision,
+        batch_size=batch_size, precision=precision, clip_norm=clip_norm,
     )
     L = len(ws)
     new_ws = [ws[l] * decay - lr * dws[l] for l in range(L)]
@@ -556,14 +575,14 @@ def _sgd_batch_math(
 
 def _momentum_batch_math(
     x, y, ws, bs, vws, vbs, *, relu_flags, group_rows, batch_size, lr, mu,
-    decay, precision,
+    decay, precision, clip_norm=None,
 ):
     """_batch_grads + the heavy-ball update (optimizer.MomentumSGD.apply:
     ``v <- mu*v + g; p <- decay(p) - lr*v``): returns ``(new_ws, new_bs,
     new_vws, new_vbs, loss)``."""
     dws, dbs, loss = _batch_grads(
         x, y, ws, bs, relu_flags=relu_flags, group_rows=group_rows,
-        batch_size=batch_size, precision=precision,
+        batch_size=batch_size, precision=precision, clip_norm=clip_norm,
     )
     L = len(ws)
     new_vws = [mu * vws[l] + dws[l] for l in range(L)]
@@ -575,7 +594,7 @@ def _momentum_batch_math(
 
 def _adam_batch_math(
     x, y, ws, bs, mws, mbs, vws, vbs, t, *, relu_flags, group_rows,
-    batch_size, lr, b1, b2, eps, decay, precision,
+    batch_size, lr, b1, b2, eps, decay, precision, clip_norm=None,
 ):
     """_batch_grads + the Adam/AdamW update (optimizer.Adam.apply: same
     expression order — ``m <- b1*m + (1-b1)*g; v <- b2*v + (1-b2)*g*g;
@@ -584,7 +603,7 @@ def _adam_batch_math(
     new_vws, new_vbs, t_new, loss)``. ``t`` is the traced step counter."""
     dws, dbs, loss = _batch_grads(
         x, y, ws, bs, relu_flags=relu_flags, group_rows=group_rows,
-        batch_size=batch_size, precision=precision,
+        batch_size=batch_size, precision=precision, clip_norm=clip_norm,
     )
     L = len(ws)
     t_new = t + 1.0
@@ -611,7 +630,7 @@ _OPT_GEOMETRY = {"sgd": (0, 0), "momentum": (1, 0), "adam": (2, 1)}
 
 def _train_kernel_body(
     x_ref, y_ref, *refs, L, relu_flags, group_rows, batch_size, lr, opt, decay,
-    precision, epoch_mode,
+    precision, epoch_mode, clip_norm=None,
 ):
     """THE training kernel body — every public variant (step/epoch x
     sgd/momentum/adam) compiles from this one definition so the plumbing
@@ -658,7 +677,7 @@ def _train_kernel_body(
     bs = [src[L + i][:] for i in range(L)]
     common = dict(
         relu_flags=relu_flags, group_rows=group_rows, batch_size=batch_size,
-        lr=lr, decay=decay, precision=precision,
+        lr=lr, decay=decay, precision=precision, clip_norm=clip_norm,
     )
     if kind == "sgd":
         new_ws, new_bs, loss = _sgd_batch_math(
@@ -703,6 +722,7 @@ def _train_kernel_body(
 def fused_train_call(
     stage_params, x, y, *, epoch_mode, relu_flags, group_rows,
     batch_size, lr, weight_decay, precision, opt=None, mirrors=(), scalars=(),
+    clip_norm=None,
 ):
     """THE public entry point for every fused-training kernel variant
     (step/epoch x sgd/momentum/adam — trainer._fused_kernel_call is the
@@ -714,12 +734,22 @@ def fused_train_call(
     _train_kernel_body); ``mirrors``/``scalars`` must match its
     _OPT_GEOMETRY. ``epoch_mode=False`` takes x: (B, in), y: (B, out) and
     runs one batch; ``epoch_mode=True`` takes X: (nb, B, in), Y: (nb, B,
-    out) and runs the whole epoch as one kernel. Returns
+    out) and runs the whole epoch as one kernel. ``clip_norm``: optional
+    global-norm gradient clipping inside the kernel (see _batch_grads —
+    bit-identical to the XLA path's optimizer.clip_tree). Returns
     ``(new_stage_params, new_mirrors, new_scalars, loss)``."""
     from shallowspeed_tpu.optimizer import _decay_factor
 
     opt = opt or {"kind": "sgd"}
-    assert _OPT_GEOMETRY[opt["kind"]] == (len(mirrors), len(scalars))
+    # explicit raise, not assert: the geometry contract must hold under
+    # ``python -O`` too — a mismatched call would otherwise silently
+    # mis-slice the flat operand list
+    if _OPT_GEOMETRY[opt["kind"]] != (len(mirrors), len(scalars)):
+        raise ValueError(
+            f"optimizer kind {opt['kind']!r} expects "
+            f"{_OPT_GEOMETRY[opt['kind']]} (mirror, scalar) operand groups, "
+            f"got ({len(mirrors)}, {len(scalars)})"
+        )
     L = len(stage_params)
 
     def flat_group(group):
@@ -736,7 +766,7 @@ def fused_train_call(
         _train_kernel_body,
         L=L, relu_flags=tuple(relu_flags), group_rows=group_rows,
         batch_size=batch_size, lr=lr, opt=opt, decay=decay,
-        precision=precision, epoch_mode=epoch_mode,
+        precision=precision, epoch_mode=epoch_mode, clip_norm=clip_norm,
     )
     out_shape = tuple(
         [jax.ShapeDtypeStruct(a.shape, jnp.float32) for a in flat]

@@ -47,15 +47,15 @@ def _make_batch_step(
     mechanism parity with the reference and for the pipeline executor, where
     microbatches are semantic.
 
-    ``megakernel=True`` (requires ``fuse_mubatches``, a plain/decaying SGD,
-    no clipping, a single-stage spec) runs the ENTIRE batch — forward,
-    head, backward, update — as ONE Pallas kernel
-    (pallas_ops.fused_train_call). Identical float math; exists because
-    the epoch is op-issue-latency bound (docs/performance.md roofline) and
-    one op per batch is the shortest possible serial chain.
+    ``megakernel=True`` (requires ``fuse_mubatches``, a kernel-supported
+    optimizer, a single-stage spec) runs the ENTIRE batch — forward,
+    head, backward, (optional global-norm clip), update — as ONE Pallas
+    kernel (pallas_ops.fused_train_call). Identical float math; exists
+    because the epoch is op-issue-latency bound (docs/performance.md
+    roofline) and one op per batch is the shortest possible serial chain.
     """
     if megakernel:
-        sspec = _validate_megakernel(spec, opt, fuse_mubatches, clip_norm)
+        sspec = _validate_megakernel(spec, opt, fuse_mubatches)
 
         def mega_step(params, opt_state, xb, yb):
             rows = xb.shape[1]
@@ -63,7 +63,7 @@ def _make_batch_step(
             y = yb.reshape(-1, yb.shape[-1])
             return _fused_kernel_call(
                 spec, sspec, opt, precision, params, opt_state, x, y,
-                epoch_mode=False, group_rows=rows,
+                epoch_mode=False, group_rows=rows, clip_norm=clip_norm,
             )
 
         return mega_step
@@ -127,13 +127,15 @@ def _kernel_opt_descriptor(opt):
     return None
 
 
-def _validate_megakernel(spec, opt, fuse_mubatches, clip_norm, name="megakernel"):
+def _validate_megakernel(spec, opt, fuse_mubatches, name="megakernel"):
     """The mega-kernel constraint set, shared by the per-batch and whole-epoch
     variants: fused microbatches, a kernel-supported optimizer (SGD,
-    momentum, adam), no clipping, single stage, within the variant's VMEM
-    budget (each optimizer state mirror — momentum's velocity, adam's
-    m and v — adds a params-sized in+out pair to the footprint; the epoch
-    kernel additionally holds the double-buffered streamed x/y blocks).
+    momentum, adam), single stage, within the variant's VMEM budget (each
+    optimizer state mirror — momentum's velocity, adam's m and v — adds a
+    params-sized in+out pair to the footprint; the epoch kernel
+    additionally holds the double-buffered streamed x/y blocks). Global-
+    norm clipping is supported: the gradient sums are live in VMEM, so the
+    norm is one scalar reduction inside the kernel (pallas_ops._batch_grads).
     Returns the single stage's spec."""
     from shallowspeed_tpu import pallas_ops
 
@@ -145,8 +147,6 @@ def _validate_megakernel(spec, opt, fuse_mubatches, clip_norm, name="megakernel"
             f"{name} supports the (decaying) SGD, momentum and adam "
             f"optimizers only"
         )
-    if clip_norm is not None:
-        raise ValueError(f"{name} does not support clip_norm")
     if spec.n_stages != 1 or not spec.stages[0].has_head:
         raise ValueError(f"{name} runs the single-stage sequential path only")
     sspec = spec.stages[0]
@@ -171,9 +171,7 @@ def _make_epoch_kernel_core(spec, opt, precision, fuse_mubatches, clip_norm):
     to ONE kernel total. Same signature as _make_epoch_core's result; batch
     expressions and loss-mean order are bit-identical to scanning the
     per-batch mega-kernel (tested)."""
-    sspec = _validate_megakernel(
-        spec, opt, fuse_mubatches, clip_norm, name="epoch_kernel"
-    )
+    sspec = _validate_megakernel(spec, opt, fuse_mubatches, name="epoch_kernel")
 
     def epoch_core(params, opt_state, X, Y):
         nb, M_, mb, din = X.shape
@@ -181,7 +179,7 @@ def _make_epoch_kernel_core(spec, opt, precision, fuse_mubatches, clip_norm):
         y = Y.reshape(nb, M_ * mb, Y.shape[-1])
         return _fused_kernel_call(
             spec, sspec, opt, precision, params, opt_state, x, y,
-            epoch_mode=True, group_rows=mb,
+            epoch_mode=True, group_rows=mb, clip_norm=clip_norm,
         )
 
     return epoch_core
@@ -189,7 +187,7 @@ def _make_epoch_kernel_core(spec, opt, precision, fuse_mubatches, clip_norm):
 
 def _fused_kernel_call(
     spec, sspec, opt, precision, params, opt_state, x, y, *, epoch_mode,
-    group_rows,
+    group_rows, clip_norm=None,
 ):
     """The one trainer->pallas_ops bridge for every mega/epoch-kernel
     variant: maps the framework optimizer state onto the kernel's mirror
@@ -217,7 +215,7 @@ def _fused_kernel_call(
         lr=opt.lr,
         weight_decay=opt.weight_decay,
         precision=precision,
-        opt=desc, mirrors=mirrors, scalars=scalars,
+        opt=desc, mirrors=mirrors, scalars=scalars, clip_norm=clip_norm,
     )
     if kind == "momentum":
         new_state = [new_mirrors[0]]

@@ -11,7 +11,7 @@ import pytest
 
 from shallowspeed_tpu import model as Mo
 from shallowspeed_tpu import ops, pallas_ops, trainer
-from shallowspeed_tpu.optimizer import SGD
+from shallowspeed_tpu.optimizer import SGD, Adam, MomentumSGD
 
 RNG = np.random.RandomState(0)
 
@@ -278,10 +278,6 @@ class TestMegaKernel:
             trainer.make_train_epoch(
                 spec, NotAnOptimizer(), fuse_mubatches=True, megakernel=True
             )
-        with pytest.raises(ValueError, match="clip_norm"):
-            trainer.make_train_epoch(
-                spec, SGD(0.01), fuse_mubatches=True, clip_norm=1.0, megakernel=True
-            )
         spec2 = Mo.make_model_spec((20, 16, 12, 10), 2, 32)
         with pytest.raises(ValueError, match="single-stage"):
             trainer.make_train_epoch(
@@ -482,3 +478,62 @@ class TestAdamKernels:
                     jax.tree.leaves(out[other][tree_idx]),
                 ):
                     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+class TestClipKernels:
+    """Global-norm clipping INSIDE the mega/epoch kernels (round-4 verdict
+    item #4): with a clip tight enough to bind on every batch, the kernel
+    variants must stay BIT-identical (params, optimizer state, loss) to the
+    fused XLA path, whose clip goes through optimizer.clip_tree. Also checks
+    the clip actually changed training (vs the unclipped kernel run)."""
+
+    def _run(self, opt, kw, clip, seed=9, epochs=2):
+        sizes, B, M, nb = (20, 16, 12, 10), 32, 4, 3
+        rng = np.random.RandomState(seed)
+        X = jnp.asarray(rng.rand(nb, M, B // M, sizes[0]).astype(np.float32))
+        Y = jnp.asarray(
+            np.eye(sizes[-1], dtype=np.float32)[
+                rng.randint(0, sizes[-1], (nb, M, B // M))
+            ]
+        )
+        spec = Mo.make_model_spec(sizes, 1, B)
+        params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+        st = opt.init(params)
+        epoch = trainer.make_train_epoch(
+            spec, opt, fuse_mubatches=True, clip_norm=clip, **kw
+        )
+        loss = None
+        for _ in range(epochs):
+            params, st, loss = epoch(params, st, X, Y)
+        return jax.device_get(params), jax.device_get(st), float(loss)
+
+    @pytest.mark.parametrize(
+        "opt",
+        [
+            SGD(0.01, weight_decay=1e-4),
+            MomentumSGD(0.01, 0.9),
+            Adam(2e-4),
+        ],
+        ids=["sgd", "momentum", "adam"],
+    )
+    def test_clip_bit_identical_across_variants(self, opt):
+        CLIP = 0.05  # far below the natural grad norm: binds every batch
+        outs = {
+            name: self._run(opt, kw, CLIP)
+            for name, kw in {
+                "xla": {},
+                "mega": {"megakernel": True},
+                "epoch": {"epoch_kernel": True},
+            }.items()
+        }
+        for other in ("mega", "epoch"):
+            assert outs["xla"][2] == outs[other][2]
+            for tree_idx in (0, 1):  # params, then optimizer state
+                for a, b in zip(
+                    jax.tree.leaves(outs["xla"][tree_idx]),
+                    jax.tree.leaves(outs[other][tree_idx]),
+                ):
+                    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # the clip is live: the clipped epoch-kernel run differs from the
+        # unclipped one
+        unclipped = self._run(opt, {"epoch_kernel": True}, None)
+        assert outs["epoch"][2] != unclipped[2]
