@@ -13,15 +13,34 @@ healthy we capture every number in one process/one device claim:
   4. a jax.profiler trace of one post-compile epoch (artifacts/tpu_trace/);
   5. the single-chip tuning matrix (fusion x precision x pallas backend) and
      full-epoch fused pallas-vs-xla cells, interleaved — the pallas cells
-     compile for real on the chip (non-interpret mode). Deliberately LATE:
-     kernel compiles are the observed tunnel-wedge trigger, and progress is
-     checkpointed to <out>.partial after every phase so a wedge keeps
-     everything measured before it (the final artifact is renamed into
-     place with a completed_at marker);
+     compile for real on the chip (non-interpret mode);
   6. adam kernel cells + a 1-epoch adam convergence through the epoch
-     kernel — the very last phase: fresh kernel compiles carry the most
-     wedge risk, and phases are ordered most-valuable-first, so a wedge
-     here loses nothing earlier.
+     kernel.
+
+TIER-0 FIRST (round-4 verdict #1): before any of the long phases, a minimal
+bundle — NumPy denominator, the fused default/highest headline pair at the
+default unroll, and the sgd kernel triple (xla/mega/epoch) WITH its on-chip
+equality probes — is measured and banked as its own COMPLETE artifact
+(<out minus .json>_tier0.json). A wedge anywhere in the full matrix can no
+longer cost the round its three verdict cells. ``--tier0-only`` stops there.
+
+WEDGE CONTAINMENT (round-4 verdict #6): every phase runs in a worker thread
+with a wall-clock budget (_PhaseRunner). A phase that exceeds its budget is
+recorded as skipped-by-budget and the capture moves on — one hung RPC cannot
+consume the remaining window (the run-C SIGTERM precedent). After two
+consecutive budget skips the tunnel is presumed wedged and later phases get
+a short suspect budget, so they are still each ATTEMPTED (a transiently
+recovered tunnel resumes normal budgets on the first success) while the
+worst case stays bounded. A skipped phase that completes late is merged into
+the artifact before the final write, flagged. Progress goes to <out>.partial
+after every phase; the final artifact is renamed into place with a
+completed_at marker.
+
+Phase order within the full capture is most-valuable-first. The first
+FRESH kernel compiles (the observed wedge trigger) happen deliberately
+early — in tier-0 and phase 2c — because the kernel verdict cells are the
+round's most valuable numbers and tier-0 banking plus per-phase budgets
+bound the cost if one wedges.
 
 All throughput cells use bench.py's two-point-slope protocol with forced
 host readbacks: on the axon tunnel, dispatch is fully asynchronous and
@@ -30,7 +49,7 @@ dispatch latency and reports physically impossible numbers (observed:
 "334M samples/s" ~= 350 TFLOP/s fp32, above single-chip peak).
 
 Writes TPU_CAPTURE_r<N>.json at the repo root and prints a summary table.
-Run:  python scripts/tpu_capture.py [--quick]
+Run:  python scripts/tpu_capture.py [--quick] [--tier0-only]
 A wedged tunnel is detected by bench.py's subprocess probe and aborts the
 capture with exit 3 (nothing is written).
 """
@@ -38,6 +57,7 @@ capture with exit 3 (nothing is written).
 import argparse
 import json
 import sys
+import threading
 import time
 from pathlib import Path
 
@@ -448,11 +468,159 @@ def profile_headline_epoch(trace_dir):
     return {"dir": str(trace_dir), "n_files": len(files)}
 
 
+# Per-phase wall-clock budgets (seconds). Generous for healthy runs — their
+# job is to stop ONE wedged RPC from consuming the remaining claim window,
+# not to tightly bound healthy phases. Monkeypatchable by the plumbing test.
+PHASE_BUDGET_S = {
+    "t0-baseline": 300, "t0-headline-pair": 1200, "t0-kernel-cells": 1800,
+    "1-baseline": 300,
+    "2-headline-default": 1500, "2b-headline-fp32": 1200,
+    "2c-kernel-cells": 1800,
+    "3-convergence": 1500, "3b-mega-convergence": 1200,
+    "3c-epoch-convergence": 1200,
+    "4-trace": 600, "4b-trace-headline": 600,
+    "5-matrix": 1800, "5b-matrix-full": 1800, "5c-executor-backends": 1200,
+    "5d-executor-api": 900, "6-adam-cells": 1500, "6b-adam-convergence": 600,
+}
+# after two consecutive budget skips the tunnel is presumed wedged: later
+# phases still run (each must be ATTEMPTED per the round-4 verdict) but at
+# this short budget, so the worst case stays bounded well under the watcher
+# window; the first success restores normal budgets
+SUSPECT_BUDGET_S = 300
+
+
+class _PhaseRunner:
+    """Budget-bounded phase execution (round-4 verdict #6).
+
+    Each phase is a zero-arg closure returning a dict of result updates; it
+    runs in a daemon worker thread and the main thread waits at most the
+    phase's budget. On timeout the phase is recorded under
+    ``phases_skipped_by_budget`` and the capture moves on — the hung thread
+    is abandoned (a wedged tunnel RPC cannot be interrupted from Python). If
+    an abandoned phase completes while later phases run, ``merge_late``
+    folds its updates into the artifact before the final write (without
+    overwriting keys a later phase produced) and flags it. Exceptions are
+    recorded under ``phase_errors`` and do NOT abort the capture: a fast
+    failure answered, so it resets the consecutive-skip wedge counter."""
+
+    def __init__(self, result, checkpoint):
+        self.result = result
+        self.checkpoint = checkpoint
+        self.consecutive_skips = 0
+        self._late = []  # (label, box) of abandoned phases
+
+    def run(self, label, fn):
+        budget = PHASE_BUDGET_S.get(label, 900)
+        if self.consecutive_skips >= 2:
+            budget = min(budget, SUSPECT_BUDGET_S)
+        box = {}
+
+        def work():
+            try:
+                box["updates"] = fn()
+            except Exception as e:  # noqa: BLE001 — recorded, not fatal
+                box["error"] = f"{type(e).__name__}: {e}"
+
+        # contamination honesty: an abandoned over-budget thread keeps
+        # issuing device work in this process; any phase that starts while
+        # one is still unfinished may share the chip with it, so its cells
+        # must carry a flag rather than read as clean
+        concurrent = [lbl for lbl, b in self._late if not b]
+        if concurrent:
+            self.result.setdefault(
+                "phases_with_concurrent_abandoned_work", {}
+            )[label] = concurrent
+        t = threading.Thread(target=work, daemon=True, name=f"phase-{label}")
+        t_start = time.monotonic()
+        t.start()
+        t.join(budget)
+        took = round(time.monotonic() - t_start, 1)
+        if t.is_alive():
+            self.consecutive_skips += 1
+            self.result.setdefault("phases_skipped_by_budget", []).append(
+                {"phase": label, "budget_s": budget}
+            )
+            self._late.append((label, box))
+            print(
+                f"  PHASE {label} exceeded its {budget}s budget; "
+                "skipping forward (wedge containment)",
+                flush=True,
+            )
+            self.checkpoint()
+            return False
+        if "error" in box:
+            self.consecutive_skips = 0
+            self.result.setdefault("phase_errors", []).append(
+                {"phase": label, "error": box["error"]}
+            )
+            print(f"  PHASE {label} failed: {box['error']}", flush=True)
+            self.checkpoint()
+            return False
+        self.consecutive_skips = 0
+        self.result.update(box.get("updates") or {})
+        self.result.setdefault("phase_seconds", {})[label] = took
+        self.checkpoint()
+        return True
+
+    def merge_late(self):
+        for label, box in self._late:
+            if "updates" in box:
+                for k, v in (box["updates"] or {}).items():
+                    self.result.setdefault(k, v)
+                self.result.setdefault("phases_late_completed", []).append(label)
+
+
+def tier0_phases(runner, quick):
+    """The three verdict cells (round-4 verdict #1), cheapest-complete form:
+    NumPy denominator, the fused default/highest headline pair at the
+    default unroll (bench.jax_sps_many — interleaved, same-window), and the
+    sgd xla/mega/epoch kernel triple at the headline precision with its
+    fp32 on-chip equality probes (probes run first inside the cell fn)."""
+
+    def t0_baseline():
+        b = bench.numpy_baseline_sps(n_batches=10)
+        print(f"  numpy: {b:,.0f} samples/s", flush=True)
+        return {"numpy_baseline_sps": round(b, 1)}
+
+    runner.run("t0-baseline", t0_baseline)
+
+    def t0_pair():
+        pair = bench.jax_sps_many(("default", "highest"), trials=2)
+        upd = {"headline_pair": {k: round(v, 1) for k, v in pair.items()}}
+        base = runner.result.get("numpy_baseline_sps")
+        if "default" in pair:
+            upd["headline_best_sps"] = round(pair["default"], 1)
+            if base:
+                upd["vs_baseline"] = round(pair["default"] / base, 2)
+        for k, v in upd["headline_pair"].items():
+            print(f"  {k}: {v:,.0f} samples/s", flush=True)
+        return upd
+
+    runner.run("t0-headline-pair", t0_pair)
+
+    def t0_kernels():
+        from shallowspeed_tpu.api import FLAGSHIP_LR as LR
+        from shallowspeed_tpu.optimizer import SGD
+
+        cells, unresolved, eq = _kernel_variant_cells(
+            SGD(LR), ("default",), "fused+{prec}+{name}",
+            14 if quick else 29, 2, label="sgd-kernel",
+        )
+        upd = {"kernel_cells_default": cells, "kernel_onchip_equality": eq}
+        if unresolved:
+            upd["kernel_cells_unresolved"] = unresolved
+        return upd
+
+    runner.run("t0-kernel-cells", t0_kernels)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--data-dir", default="/tmp/ssd_data")
     ap.add_argument("--quick", action="store_true", help="fewer reps/epochs")
-    ap.add_argument("--out", default=str(ROOT / "TPU_CAPTURE_r04.json"))
+    ap.add_argument("--tier0-only", action="store_true",
+                    help="bank the tier-0 artifact and stop")
+    ap.add_argument("--out", default=str(ROOT / "TPU_CAPTURE_r05.json"))
     args = ap.parse_args()
 
     tag, _probe_diag = bench._ensure_responsive_backend()
@@ -478,148 +646,213 @@ def main():
             check=True,
         )
 
-    # Phase order is deliberate: most valuable first, riskiest LAST (the
-    # tunnel has wedged mid-capture on a kernel compile before, and a wedge
-    # hangs every subsequent RPC in this process). Progress goes to
-    # <out>.partial after every completed phase — never clobbering a
-    # previous complete artifact at <out> — and the final result is renamed
-    # into place carrying a completed_at marker, so a partial capture is
-    # both preserved and unmistakable.
+    # ---- TIER 0: bank the verdict cells as a complete artifact FIRST ----
+    t0_out = Path(args.out).with_name(Path(args.out).stem + "_tier0.json")
+    t0_partial = Path(str(t0_out) + ".partial")
+    t0_result = {"info": dict(info), "tier": 0}
+    runner0 = _PhaseRunner(
+        t0_result,
+        lambda: t0_partial.write_text(json.dumps(t0_result, indent=2) + "\n"),
+    )
+    print("tier-0: headline pair + kernel triple + equality probes...", flush=True)
+    tier0_phases(runner0, args.quick)
+    runner0.merge_late()
+    # the rename-into-place marker means "verdict cells banked": only stamp
+    # completed_at and promote the file when every tier-0 phase actually
+    # delivered — a skipped/errored tier-0 stays a .partial, unmistakably
+    t0_complete = not t0_result.get("phases_skipped_by_budget") and not (
+        t0_result.get("phase_errors")
+    )
+    if t0_complete:
+        t0_result["completed_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    t0_partial.write_text(json.dumps(t0_result, indent=2) + "\n")
+    if t0_complete:
+        t0_partial.rename(t0_out)
+        print(f"tier-0 artifact banked: {t0_out}", flush=True)
+    else:
+        print(f"tier-0 INCOMPLETE — kept as {t0_partial}", flush=True)
+    if args.tier0_only:
+        print(json.dumps({
+            "tier0": str(t0_out),
+            "headline_best_sps": t0_result.get("headline_best_sps"),
+            "vs_baseline": t0_result.get("vs_baseline"),
+        }))
+        return
+
+    # ---- full capture: most-valuable-first, per-phase budgets ----
     result = {"info": info}
     partial_path = Path(str(args.out) + ".partial")
-
-    def checkpoint_result():
-        partial_path.write_text(json.dumps(result, indent=2) + "\n")
+    runner = _PhaseRunner(
+        result,
+        lambda: partial_path.write_text(json.dumps(result, indent=2) + "\n"),
+    )
+    trials = 2 if args.quick else 3
+    nb_cells = 29 if args.quick else 116
 
     print("1) NumPy baseline (host CPU)...", flush=True)
-    baseline = bench.numpy_baseline_sps(n_batches=10 if args.quick else 40)
-    print(f"  numpy: {baseline:,.0f} samples/s", flush=True)
-    result["numpy_baseline_sps"] = round(baseline, 1)
-    checkpoint_result()
+
+    def p1():
+        baseline = bench.numpy_baseline_sps(n_batches=10 if args.quick else 40)
+        print(f"  numpy: {baseline:,.0f} samples/s", flush=True)
+        return {"numpy_baseline_sps": round(baseline, 1)}
+
+    runner.run("1-baseline", p1)
 
     print("2) headline sweep (fused sequential epoch, DEFAULT precision "
           "— the convergence-verified bench headline config)...", flush=True)
-    sweep, unresolved = headline_sweep((1, 2, 4, 8), 2 if args.quick else 3,
-                                       precision="default")
-    best = max(sweep.values())
-    result["headline_sweep_default_precision"] = sweep
-    if unresolved:
-        result["headline_sweep_default_unresolved"] = unresolved
-    result["headline_best_sps"] = best
-    result["vs_baseline"] = round(best / baseline, 2)
-    checkpoint_result()
+
+    def p2():
+        sweep, unresolved = headline_sweep((1, 2, 4, 8), trials, precision="default")
+        best = max(sweep.values())
+        upd = {"headline_sweep_default_precision": sweep, "headline_best_sps": best}
+        if unresolved:
+            upd["headline_sweep_default_unresolved"] = unresolved
+        base = result.get("numpy_baseline_sps")
+        if base:
+            upd["vs_baseline"] = round(best / base, 2)
+        return upd
+
+    runner.run("2-headline-default", p2)
+
     print("2b) fp32 HIGHEST sweep (the bitwise-NumPy-parity config)...",
           flush=True)
-    sweep_fp32, unresolved_fp32 = headline_sweep((1, 2, 4, 8), 2 if args.quick else 3,
-                                                 precision="highest")
-    best_fp32 = max(sweep_fp32.values())
-    result["headline_sweep_fp32_highest"] = sweep_fp32
-    if unresolved_fp32:
-        result["headline_sweep_fp32_unresolved"] = unresolved_fp32
-    result["headline_best_fp32_sps"] = best_fp32
-    result["vs_baseline_fp32"] = round(best_fp32 / baseline, 2)
-    checkpoint_result()
+
+    def p2b():
+        sweep, unresolved = headline_sweep((1, 2, 4, 8), trials, precision="highest")
+        best = max(sweep.values())
+        upd = {"headline_sweep_fp32_highest": sweep, "headline_best_fp32_sps": best}
+        if unresolved:
+            upd["headline_sweep_fp32_unresolved"] = unresolved
+        base = result.get("numpy_baseline_sps")
+        if base:
+            upd["vs_baseline_fp32"] = round(best / base, 2)
+        return upd
+
+    runner.run("2b-headline-fp32", p2b)
 
     print("2c) fused-XLA vs mega-kernel vs epoch-kernel (same-window, both "
           "precision classes; the op-issue-roofline attacks)...", flush=True)
-    mega, mega_unresolved, mega_eq = megakernel_cells(
-        29 if args.quick else 116, 2 if args.quick else 3
-    )
-    result["megakernel_cells"] = mega
-    result["megakernel_onchip_equality"] = mega_eq
-    if mega_unresolved:
-        result["megakernel_cells_unresolved"] = mega_unresolved
-    checkpoint_result()
+
+    def p2c():
+        mega, unresolved, eq = megakernel_cells(nb_cells, trials)
+        upd = {"megakernel_cells": mega, "megakernel_onchip_equality": eq}
+        if unresolved:
+            upd["megakernel_cells_unresolved"] = unresolved
+        return upd
+
+    runner.run("2c-kernel-cells", p2c)
 
     print("3) convergence (real dataset, per-epoch eval)...", flush=True)
-    result["convergence"] = convergence_run(args.data_dir, 5 if args.quick else 20)
-    checkpoint_result()
+    runner.run("3-convergence", lambda: {
+        "convergence": convergence_run(args.data_dir, 5 if args.quick else 20)
+    })
 
     print("3b) mega-kernel convergence (headline precision)...", flush=True)
-    result["megakernel_convergence"] = megakernel_convergence(
-        args.data_dir, 5 if args.quick else 20
-    )
-    checkpoint_result()
+    runner.run("3b-mega-convergence", lambda: {
+        "megakernel_convergence": megakernel_convergence(
+            args.data_dir, 5 if args.quick else 20
+        )
+    })
 
     print("3c) epoch-kernel convergence (headline precision)...", flush=True)
-    result["epoch_kernel_convergence"] = megakernel_convergence(
-        args.data_dir, 5 if args.quick else 20, variant="epoch_kernel"
-    )
-    checkpoint_result()
+    runner.run("3c-epoch-convergence", lambda: {
+        "epoch_kernel_convergence": megakernel_convergence(
+            args.data_dir, 5 if args.quick else 20, variant="epoch_kernel"
+        )
+    })
 
     # per-round trace dirs: the committed round-2 trace in artifacts/tpu_trace
     # is a pinned test fixture (test_trace_stats_reproduces_roofline_numbers)
     # and must never be appended to by a later capture
     print("4) profiler trace...", flush=True)
-    result["trace"] = profile_one_epoch(
-        args.data_dir, ROOT / "artifacts" / "tpu_trace_r04"
-    )
-    checkpoint_result()
+    runner.run("4-trace", lambda: {
+        "trace": profile_one_epoch(args.data_dir, ROOT / "artifacts" / "tpu_trace_r05")
+    })
     print("4b) headline-config (fused+default) trace...", flush=True)
-    result["trace_headline"] = profile_headline_epoch(
-        ROOT / "artifacts" / "tpu_trace_headline_r04"
-    )
-    checkpoint_result()
+    runner.run("4b-trace-headline", lambda: {
+        "trace_headline": profile_headline_epoch(
+            ROOT / "artifacts" / "tpu_trace_headline_r05"
+        )
+    })
 
-    print("5) tuning matrix (interleaved cells, same-window ratios; "
-          "pallas compiles — the risky phase — run last)...", flush=True)
+    print("5) tuning matrix (interleaved cells, same-window ratios)...",
+          flush=True)
     sys.path.insert(0, str(ROOT / "scripts"))
     from bench_tpu_matrix import ALL_CELLS, run_matrix
 
-    raw = run_matrix(ALL_CELLS, 29 if args.quick else 116, 2)
-    matrix = {}
-    for key, sps in raw.items():
-        matrix["+".join(key)] = round(sps, 1)
-        print(f"  {'+'.join(key)}: {sps:,.0f} samples/s", flush=True)
-    result["matrix"] = matrix
-    checkpoint_result()
+    def p5():
+        raw = run_matrix(ALL_CELLS, nb_cells, 2)
+        matrix = {}
+        for key, sps in raw.items():
+            matrix["+".join(key)] = round(sps, 1)
+            print(f"  {'+'.join(key)}: {sps:,.0f} samples/s", flush=True)
+        return {"matrix": matrix}
+
+    runner.run("5-matrix", p5)
 
     print("5b) full-epoch fused cells: pallas vs xla at equal precision "
           "class (the kernels take the caller's precision)...", flush=True)
-    fused_cells = [(True, p, k) for p in ("highest", "default") for k in (False, True)]
-    raw_full = run_matrix(fused_cells, 29 if args.quick else bench.N_SAMPLES // 128, 2)
-    matrix_full = {}
-    for key, sps in raw_full.items():
-        matrix_full["+".join(key)] = round(sps, 1)
-        print(f"  {'+'.join(key)}: {sps:,.0f} samples/s", flush=True)
-    result["matrix_full_epoch_fused"] = matrix_full
-    checkpoint_result()
+
+    def p5b():
+        fused_cells = [(True, p, k) for p in ("highest", "default") for k in (False, True)]
+        raw = run_matrix(fused_cells, 29 if args.quick else bench.N_SAMPLES // 128, 2)
+        matrix = {}
+        for key, sps in raw.items():
+            matrix["+".join(key)] = round(sps, 1)
+            print(f"  {'+'.join(key)}: {sps:,.0f} samples/s", flush=True)
+        return {"matrix_full_epoch_fused": matrix}
+
+    runner.run("5b-matrix-full", p5b)
 
     print("5c) pipeline-executor kernel backends (xla vs pallas flag "
           "kernels, dp=pp=1, same-window)...", flush=True)
-    exec_cells, exec_unresolved, exec_eq = executor_backend_cells(
-        29 if args.quick else 116, 2
-    )
-    result["executor_kernel_backends"] = exec_cells
-    result["executor_onchip_equality"] = exec_eq
-    if exec_unresolved:
-        result["executor_kernel_backends_unresolved"] = exec_unresolved
-    checkpoint_result()
+
+    def p5c():
+        cells, unresolved, eq = executor_backend_cells(nb_cells, 2)
+        upd = {"executor_kernel_backends": cells, "executor_onchip_equality": eq}
+        if unresolved:
+            upd["executor_kernel_backends_unresolved"] = unresolved
+        return upd
+
+    runner.run("5c-executor-backends", p5c)
 
     print("5d) executor backend through the API surface "
           "(TrainingSession(kernel_backend=))...", flush=True)
-    result["executor_api_path"] = executor_backend_api_path(
-        args.data_dir, epochs=1 if args.quick else 2
-    )
-    checkpoint_result()
+    runner.run("5d-executor-api", lambda: {
+        "executor_api_path": executor_backend_api_path(
+            args.data_dir, epochs=1 if args.quick else 2
+        )
+    })
 
     print("6) adam kernel triple + 1-epoch adam convergence through the "
           "epoch kernel...", flush=True)
-    adam_cells, adam_unresolved, adam_eq = adam_kernel_cells(
-        29 if args.quick else 116, 2
-    )
-    result["adam_kernel_cells"] = adam_cells
-    result["adam_onchip_equality"] = adam_eq
-    if adam_unresolved:
-        result["adam_kernel_cells_unresolved"] = adam_unresolved
-    checkpoint_result()
-    result["adam_epoch_kernel_one_epoch"] = adam_epoch_kernel_convergence(
-        args.data_dir
-    )
+
+    def p6():
+        cells, unresolved, eq = adam_kernel_cells(nb_cells, 2)
+        upd = {"adam_kernel_cells": cells, "adam_onchip_equality": eq}
+        if unresolved:
+            upd["adam_kernel_cells_unresolved"] = unresolved
+        return upd
+
+    runner.run("6-adam-cells", p6)
+    runner.run("6b-adam-convergence", lambda: {
+        "adam_epoch_kernel_one_epoch": adam_epoch_kernel_convergence(
+            args.data_dir
+        )
+    })
+
+    runner.merge_late()
     result["completed_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
-    checkpoint_result()
+    partial_path.write_text(json.dumps(result, indent=2) + "\n")
     partial_path.rename(args.out)
-    print(json.dumps({"headline_best_sps": best, "vs_baseline": result["vs_baseline"]}))
+    print(json.dumps({
+        "headline_best_sps": result.get("headline_best_sps"),
+        "vs_baseline": result.get("vs_baseline"),
+        "tier0": str(t0_out),
+        "phases_skipped_by_budget": [
+            e["phase"] for e in result.get("phases_skipped_by_budget", [])
+        ],
+    }))
 
 
 if __name__ == "__main__":

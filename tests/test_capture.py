@@ -2,13 +2,16 @@
 per chip-claim window (the tunnel wedges for hours between them), so a
 signature mismatch or key error anywhere in its phase sequence would burn
 the round's only hardware window. This runs the REAL main() with every
-heavy measurement stubbed: phase ordering, checkpoint-after-every-phase,
-result-key assembly and the rename-into-place contract are exercised for
-real; only the timing/convergence/trace work is faked.
+heavy measurement stubbed: tier-0 banking, phase ordering,
+checkpoint-after-every-phase, the per-phase budget containment and the
+rename-into-place contract are exercised for real; only the
+timing/convergence/trace work is faked.
 """
 
 import json
 import sys
+import threading
+import time
 from pathlib import Path
 
 import pytest
@@ -41,6 +44,19 @@ def test_capture_main_plumbing(tmp_path, monkeypatch, capture_mod):
         lambda *a, **k: ("", {"probes": [{"outcome": "ok", "seconds": 1.0}]}),
     )
     monkeypatch.setattr(bench, "numpy_baseline_sps", lambda n_batches=40: 50.0)
+    monkeypatch.setattr(
+        bench, "jax_sps_many",
+        lambda precisions, trials=2: {"default": 200.0, "highest": 100.0},
+    )
+    monkeypatch.setattr(
+        tc, "_kernel_variant_cells",
+        lambda opt, precisions, key_fmt, nb, trials, label: (
+            {"fused+default+xla": 1.0, "fused+default+mega": 2.0,
+             "fused+default+epoch": 3.0},
+            {},
+            {"mega": eq, "epoch": eq},
+        ),
+    )
     monkeypatch.setattr(
         tc, "headline_sweep",
         lambda unrolls, trials, precision="highest": (
@@ -119,6 +135,218 @@ def test_capture_main_plumbing(tmp_path, monkeypatch, capture_mod):
         assert key in result, f"capture artifact missing {key!r}"
     assert result["epoch_kernel_convergence"]["variant"] == "epoch_kernel"
     assert result["megakernel_onchip_equality"]["epoch"]["bitwise_equal"]
+    assert not result.get("phases_skipped_by_budget")
+
+    # tier-0 artifact: banked as its own COMPLETE file before the full matrix
+    t0 = tmp_path / "CAP_tier0.json"
+    assert t0.is_file() and not Path(str(t0) + ".partial").exists()
+    t0r = json.loads(t0.read_text())
+    for key in (
+        "info", "numpy_baseline_sps", "headline_pair", "headline_best_sps",
+        "vs_baseline", "kernel_cells_default", "kernel_onchip_equality",
+        "completed_at",
+    ):
+        assert key in t0r, f"tier-0 artifact missing {key!r}"
+    assert t0r["tier"] == 0
+    assert t0r["headline_pair"] == {"default": 200.0, "highest": 100.0}
+    assert t0r["vs_baseline"] == 4.0  # 200 / 50
+
+
+def test_capture_tier0_only_stops_after_banking(tmp_path, monkeypatch, capture_mod):
+    tc = capture_mod
+    import bench
+
+    eq = {"max_abs_param_diff": 0.0, "loss_abs_diff": 0.0, "bitwise_equal": True}
+    monkeypatch.setattr(
+        bench, "_ensure_responsive_backend",
+        lambda *a, **k: ("", {"probes": [{"outcome": "ok", "seconds": 1.0}]}),
+    )
+    monkeypatch.setattr(bench, "numpy_baseline_sps", lambda n_batches=40: 50.0)
+    monkeypatch.setattr(
+        bench, "jax_sps_many",
+        lambda precisions, trials=2: {"default": 200.0, "highest": 100.0},
+    )
+    monkeypatch.setattr(
+        tc, "_kernel_variant_cells",
+        lambda *a, **k: ({"fused+default+epoch": 3.0}, {}, {"epoch": eq}),
+    )
+    out = tmp_path / "CAP.json"
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    monkeypatch.setattr(
+        sys, "argv",
+        ["tpu_capture.py", "--tier0-only", "--out", str(out),
+         "--data-dir", str(data_dir)],
+    )
+    tc.main()
+    assert (tmp_path / "CAP_tier0.json").is_file()
+    assert not out.exists()  # the full matrix never started
+
+
+def test_capture_budget_skips_forward(tmp_path, monkeypatch, capture_mod):
+    """A phase that hangs past its wall-clock budget is recorded as
+    skipped-by-budget and every LATER phase still runs (round-4 verdict #6:
+    one hung RPC must not consume the remaining window)."""
+    tc = capture_mod
+    import bench
+    import bench_tpu_matrix
+
+    eq = {"max_abs_param_diff": 0.0, "loss_abs_diff": 0.0, "bitwise_equal": True}
+    monkeypatch.setattr(
+        bench, "_ensure_responsive_backend",
+        lambda *a, **k: ("", {"probes": [{"outcome": "ok", "seconds": 1.0}]}),
+    )
+    monkeypatch.setattr(bench, "numpy_baseline_sps", lambda n_batches=40: 50.0)
+    monkeypatch.setattr(
+        bench, "jax_sps_many",
+        lambda precisions, trials=2: {"default": 200.0, "highest": 100.0},
+    )
+    monkeypatch.setattr(
+        tc, "_kernel_variant_cells",
+        lambda *a, **k: ({"fused+default+epoch": 3.0}, {}, {"epoch": eq}),
+    )
+    monkeypatch.setattr(
+        tc, "headline_sweep",
+        lambda unrolls, trials, precision="highest": (
+            {f"unroll={u}": 100.0 * u for u in unrolls}, {}
+        ),
+    )
+    monkeypatch.setattr(
+        tc, "megakernel_cells",
+        lambda nb, trials: ({"fused+default+xla": 1.0}, {}, {"mega": eq, "epoch": eq}),
+    )
+    # phase 3 HANGS (simulated wedged RPC: uninterruptible sleep)
+    hang = threading.Event()
+    monkeypatch.setattr(
+        tc, "convergence_run", lambda d, e: hang.wait(30) or {"epochs": e}
+    )
+    monkeypatch.setitem(tc.PHASE_BUDGET_S, "3-convergence", 0.3)
+    monkeypatch.setattr(
+        tc, "megakernel_convergence",
+        lambda d, e, variant="megakernel": {"variant": variant, "epochs": e},
+    )
+    monkeypatch.setattr(
+        tc, "profile_one_epoch", lambda d, t: {"dir": str(t), "n_files": 1}
+    )
+    monkeypatch.setattr(
+        tc, "profile_headline_epoch", lambda t: {"dir": str(t), "n_files": 1}
+    )
+    monkeypatch.setattr(
+        bench_tpu_matrix, "run_matrix",
+        lambda cells, nb, trials: {("fused", "default", "xla"): 123.0},
+    )
+    monkeypatch.setattr(
+        tc, "executor_backend_cells",
+        lambda nb, trials: ({"executor+default+xla": 1.0}, {}, eq),
+    )
+    monkeypatch.setattr(
+        tc, "executor_backend_api_path",
+        lambda d, epochs=2: {"hashes_match": True, "losses_match": True},
+    )
+    monkeypatch.setattr(
+        tc, "adam_kernel_cells",
+        lambda nb, trials: ({"adam+default+xla": 1.0}, {}, {"epoch": eq}),
+    )
+    monkeypatch.setattr(
+        tc, "adam_epoch_kernel_convergence", lambda d: {"val_accuracy": 0.99}
+    )
+    out = tmp_path / "CAP.json"
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    monkeypatch.setattr(
+        sys, "argv",
+        ["tpu_capture.py", "--quick", "--out", str(out), "--data-dir", str(data_dir)],
+    )
+    try:
+        tc.main()
+    finally:
+        hang.set()  # release the hung worker thread
+    assert out.is_file()
+    result = json.loads(out.read_text())
+    skipped = [e["phase"] for e in result["phases_skipped_by_budget"]]
+    assert skipped == ["3-convergence"]
+    assert "convergence" not in result
+    # every LATER phase still ran
+    for key in (
+        "megakernel_convergence", "epoch_kernel_convergence", "trace",
+        "trace_headline", "matrix", "matrix_full_epoch_fused",
+        "executor_kernel_backends", "executor_api_path", "adam_kernel_cells",
+        "completed_at",
+    ):
+        assert key in result, f"later phase result missing {key!r}"
+    # honesty: every phase that ran while the abandoned worker was still
+    # alive is flagged as potentially sharing the device with it
+    flagged = result["phases_with_concurrent_abandoned_work"]
+    assert flagged["3b-mega-convergence"] == ["3-convergence"]
+    assert "6b-adam-convergence" in flagged
+
+
+def test_capture_tier0_incomplete_stays_partial(tmp_path, monkeypatch, capture_mod):
+    """A tier-0 whose phases errored must NOT be renamed into place with a
+    completed_at marker — the banked-artifact contract means all three
+    verdict cells delivered."""
+    tc = capture_mod
+    import bench
+
+    monkeypatch.setattr(
+        bench, "_ensure_responsive_backend",
+        lambda *a, **k: ("", {"probes": [{"outcome": "ok", "seconds": 1.0}]}),
+    )
+    monkeypatch.setattr(bench, "numpy_baseline_sps", lambda n_batches=40: 50.0)
+    monkeypatch.setattr(
+        bench, "jax_sps_many",
+        lambda precisions, trials=2: {"default": 200.0, "highest": 100.0},
+    )
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic compile failed")
+
+    monkeypatch.setattr(tc, "_kernel_variant_cells", boom)
+    out = tmp_path / "CAP.json"
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    monkeypatch.setattr(
+        sys, "argv",
+        ["tpu_capture.py", "--tier0-only", "--out", str(out),
+         "--data-dir", str(data_dir)],
+    )
+    tc.main()
+    t0 = tmp_path / "CAP_tier0.json"
+    assert not t0.exists()
+    partial = json.loads((tmp_path / "CAP_tier0.json.partial").read_text())
+    assert "completed_at" not in partial
+    assert partial["phase_errors"][0]["phase"] == "t0-kernel-cells"
+    assert "mosaic compile failed" in partial["phase_errors"][0]["error"]
+
+
+def test_phase_runner_late_merge(capture_mod):
+    """An abandoned phase that completes after its budget is merged into the
+    artifact before the final write, without clobbering later results."""
+    tc = capture_mod
+    result = {"existing": "kept"}
+    runner = tc._PhaseRunner(result, lambda: None)
+    release = threading.Event()
+    done = threading.Event()
+
+    def slow_phase():
+        release.wait(10)
+        done.set()
+        return {"late_key": 42, "existing": "late-must-not-clobber"}
+
+    tc.PHASE_BUDGET_S["unit-test-phase"] = 0.1
+    try:
+        ok = runner.run("unit-test-phase", slow_phase)
+    finally:
+        release.set()
+    assert ok is False
+    assert result["phases_skipped_by_budget"][0]["phase"] == "unit-test-phase"
+    assert done.wait(10)
+    time.sleep(0.3)  # let the worker finish the box assignment after fn returns
+    runner.merge_late()
+    assert result["late_key"] == 42
+    assert result["existing"] == "kept"  # setdefault semantics: no clobber
+    assert result["phases_late_completed"] == ["unit-test-phase"]
+    tc.PHASE_BUDGET_S.pop("unit-test-phase", None)
 
 
 def test_capture_aborts_cleanly_on_wedged_tunnel(tmp_path, monkeypatch, capture_mod):
