@@ -191,3 +191,139 @@ def test_random_r2_feature_combo_matches_sequential(seed):
             np.asarray(a["b"]).reshape(-1), b["b"].reshape(-1),
             rtol=rtol, atol=atol, err_msg=label,
         )
+
+def _random_case_r3(seed):
+    """Round-5 feature fuzz (round-4 verdict #3): the full lattice —
+    optimizer x zero1 x kernel_backend x virtual stages x epoch-vs-step —
+    from independent seed bits, so pallas-backend interactions (e.g.
+    zero1 x pallas x interleaved) get randomized coverage, not just their
+    dedicated tests."""
+    rng = np.random.RandomState(3000 + seed)
+    kb = ["xla", "pallas"][seed % 2]
+    V = [1, 2][(seed // 2) % 2]
+    dp, pp = [(2, 2), (1, 4), (2, 1)][(seed // 4) % 3]
+    opt = OPTS[(seed + seed // 2) % 3]
+    zero1 = bool((seed // 3) % 2)
+    clip = [None, 0.05][(seed // 6) % 2]
+    fused = bool((seed + seed // 4) % 2)  # per-step loop vs whole-run program
+    n_stages = pp * V
+    n_sizes = n_stages * int(rng.randint(2, 4))
+    n_sizes = max(n_sizes, 2)
+    widths = sorted(rng.randint(8, 48, size=n_sizes - 1).tolist(), reverse=True)
+    sizes = tuple(widths) + (int(rng.randint(4, min(8, min(widths)) + 1)),)
+    M = int(pp * rng.choice([1, 2]))  # interleaved needs M % pp == 0
+    B = int(dp * M * rng.choice([4, 8]))
+    sched = S.InterleavedSchedule if V > 1 else SCHEDS[seed % 3]
+    return sizes, dp, pp, V, M, B, opt, zero1, kb, sched, clip, fused
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_r3_kernel_backend_combo_matches_sequential(seed):
+    """Random (optimizer, zero1, kernel_backend, virtual, epoch-vs-step)
+    combinations must still equal sequential training — the pallas executor
+    backend composes with every other feature, not just dp=pp=1."""
+    sizes, dp, pp, V, M, B, opt, zero1, kb, sched, clip, fused = _random_case_r3(seed)
+    spec_pp = Mo.make_model_spec(sizes, pp * V, B)
+    assert spec_pp.stages[-1].n_linears > 0  # generator guarantees parity regime
+
+    rng = np.random.RandomState(4000 + seed)
+    X = rng.randn(2, B, sizes[0]).astype(np.float32)
+    Y = np.eye(sizes[-1], dtype=np.float32)[rng.randint(0, sizes[-1], (2, B))]
+
+    spec1 = Mo.make_model_spec(sizes, 1, B)
+    params = jax.tree.map(jnp.asarray, Mo.init_model(spec1))
+    step1 = trainer.make_train_step(spec1, opt, clip_norm=clip)
+    st = opt.init(params)
+    for i in range(2):
+        params, st = step1(
+            params,
+            st,
+            jnp.asarray(X[i].reshape(M, B // M, -1)),
+            jnp.asarray(Y[i].reshape(M, B // M, -1)),
+        )
+    want = [l for stage in params for l in stage]
+
+    mesh = make_mesh(dp, pp)
+    order = E.interleave_order(pp * V, pp) if V > 1 else None
+    prog = lower_schedule(sched, M, pp, virtual=V)
+    stacked, flags = E.init_stacked(spec_pp, mesh, order=order)
+    ost = E.zero1_init_state(opt, spec_pp, mesh) if zero1 else opt.init(stacked)
+    if fused:
+        run = E.make_pipeline_run(
+            mesh, spec_pp, prog, B // dp // M, opt, zero1=zero1, clip_norm=clip,
+            kernel_backend=kb,
+        )
+        stacked, ost, _ = run(stacked, flags, ost, jnp.asarray(X), jnp.asarray(Y), 1)
+    else:
+        step = E.make_pipeline_step(
+            mesh, spec_pp, prog, B // dp // M, opt, zero1=zero1, clip_norm=clip,
+            kernel_backend=kb,
+        )
+        for i in range(2):
+            stacked, ost, _ = step(
+                stacked, flags, ost, jnp.asarray(X[i]), jnp.asarray(Y[i])
+            )
+    got = [l for s in E.unstack_params(stacked, spec_pp, order=order) for l in s]
+    assert len(want) == len(got)
+
+    label = (
+        f"sizes={sizes} dp={dp} pp={pp} V={V} M={M} B={B} "
+        f"{type(opt).__name__} zero1={zero1} kb={kb} clip={clip} "
+        f"fused={fused} {sched.__name__}"
+    )
+    rtol, atol = (5e-3, 5e-5) if isinstance(opt, Adam) else (5e-4, 5e-6)
+    for a, b in zip(want, got):
+        np.testing.assert_allclose(
+            np.asarray(a["W"]), b["W"], rtol=rtol, atol=atol, err_msg=label
+        )
+        np.testing.assert_allclose(
+            np.asarray(a["b"]).reshape(-1), b["b"].reshape(-1),
+            rtol=rtol, atol=atol, err_msg=label,
+        )
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_kernel_variant_fuzz(seed):
+    """Sequential kernel-variant fuzz: random single-stage shapes, optimizer,
+    clip and weight decay — the mega- and epoch-kernels must stay
+    BIT-identical to the fused-XLA epoch, not just at the handcrafted
+    shapes of their dedicated tests."""
+    rng = np.random.RandomState(5000 + seed)
+    L = int(rng.randint(2, 6))
+    widths = sorted(rng.randint(8, 40, size=L).tolist(), reverse=True)
+    sizes = tuple(widths) + (int(rng.randint(4, min(8, min(widths)) + 1)),)
+    M = int(rng.choice([1, 2, 4]))
+    B = int(M * rng.choice([4, 8]))
+    nb = int(rng.randint(1, 4))
+    opt = OPTS[seed % 3]
+    clip = [None, 0.05][(seed // 3) % 2]
+
+    X = jnp.asarray(rng.rand(nb, M, B // M, sizes[0]).astype(np.float32))
+    Y = jnp.asarray(
+        np.eye(sizes[-1], dtype=np.float32)[rng.randint(0, sizes[-1], (nb, M, B // M))]
+    )
+    spec = Mo.make_model_spec(sizes, 1, B)
+    label = f"sizes={sizes} M={M} B={B} nb={nb} {type(opt).__name__} clip={clip}"
+    out = {}
+    for name, kw in {
+        "xla": {},
+        "mega": {"megakernel": True},
+        "epoch": {"epoch_kernel": True},
+    }.items():
+        params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+        st = opt.init(params)
+        epoch = trainer.make_train_epoch(
+            spec, opt, fuse_mubatches=True, clip_norm=clip, **kw
+        )
+        params, st, loss = epoch(params, st, X, Y)
+        out[name] = (jax.device_get(params), jax.device_get(st), float(loss))
+    for other in ("mega", "epoch"):
+        assert out["xla"][2] == out[other][2], label
+        for tree_idx in (0, 1):
+            for a, b in zip(
+                jax.tree.leaves(out["xla"][tree_idx]),
+                jax.tree.leaves(out[other][tree_idx]),
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b), err_msg=label
+                )
