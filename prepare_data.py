@@ -16,6 +16,8 @@ Default: try openml, fall back to digits, then synthetic.
 """
 
 import argparse
+import json
+import sys
 from pathlib import Path
 
 import numpy as np
@@ -30,18 +32,33 @@ def _split(x, y, seed=42, test_frac=0.15):
     present: train_test_split(test_size=0.15, random_state=42) — the exact
     call in /root/reference/download_dataset.py:16-18 — so cross-repo
     accuracy comparisons share identical validation membership. NumPy
-    fallback (deterministic, but its own permutation) when sklearn is
-    unavailable."""
+    fallback (deterministic, but its OWN permutation — validation
+    membership, and hence reported accuracies, differ from the reference's)
+    when sklearn is unavailable. Returns ``(x_train, x_val, y_train, y_val,
+    provenance)``; the fallback warns on stderr and the provenance string is
+    recorded in the saved dataset's metadata so a cross-environment accuracy
+    comparison can check which split produced it."""
     try:
         from sklearn.model_selection import train_test_split
 
-        return train_test_split(x, y, test_size=test_frac, random_state=seed)
+        parts = train_test_split(x, y, test_size=test_frac, random_state=seed)
+        return (*parts, f"sklearn.train_test_split(test_size={test_frac}, "
+                        f"random_state={seed})")
     except ImportError:
+        print(
+            "prepare_data: sklearn unavailable — using the NumPy fallback "
+            "split (deterministic but NOT the reference's validation "
+            "membership; accuracies are not sample-for-sample comparable)",
+            file=sys.stderr,
+        )
         rng = np.random.RandomState(seed)
         idx = rng.permutation(len(x))
         n_val = int(round(len(x) * test_frac))
         val, train = idx[:n_val], idx[n_val:]
-        return x[train], x[val], y[train], y[val]
+        return (
+            x[train], x[val], y[train], y[val],
+            f"numpy.permutation_fallback(seed={seed}, test_frac={test_frac})",
+        )
 
 
 def _load_openml():
@@ -105,13 +122,19 @@ def prepare(save_dir: Path, source: str = "auto") -> str:
     # reference preprocessing: /255-equivalent normalization then mean-center
     # (download_dataset.py:12-13). Our loaders already emit [0,1]; just center.
     x = x - x.mean()
-    x_train, x_val, y_train, y_val = _split(x, y)
+    x_train, x_val, y_train, y_val, split_provenance = _split(x, y)
 
     save_dir.mkdir(parents=True, exist_ok=True)
     np.save(save_dir / "x_train.npy", x_train)
     np.save(save_dir / "x_val.npy", x_val)
     np.save(save_dir / "y_train.npy", y_train)
     np.save(save_dir / "y_val.npy", y_val)
+    # split provenance rides with the dataset: an accuracy measured on a
+    # fallback-split val set is not sample-for-sample comparable with the
+    # reference's, and the consumer can only know that if the dataset says so
+    (save_dir / "dataset_meta.json").write_text(
+        json.dumps({"source": used, "split": split_provenance}, indent=2) + "\n"
+    )
     try:  # also write parquet for byte-format parity with the reference
         import pandas as pd
 

@@ -853,12 +853,22 @@ def train_epoch_kernel_fits(batch_rows, sizes, state_mirrors=0):
     """VMEM feasibility for the whole-EPOCH kernel: the step kernel's
     working set PLUS a second copy of the streamed x/y blocks — Pallas
     double-buffers the per-grid-step input fetches, so two batches' worth
-    of x/y can be resident at once."""
+    of x/y can be resident at once.
+
+    ADVISORY, not a guarantee: the model counts operands and the streaming
+    double-buffer but cannot see scratch/staging Mosaic may add for the
+    revisited constant-index param blocks, so a 12.5% safety margin is
+    held back from the budget. The margin (and the byte model itself) is
+    to be calibrated against a real Mosaic compile log at flagship shapes
+    when the chip answers (round-4 verdict #5) — until then a config that
+    passes here can still OOM at compile time on hardware; the capture
+    records that as a phase error rather than assuming the predicate."""
     widths = list(sizes)
     stream_extra = 4 * batch_rows * (widths[0] + widths[-1])
+    budget = SINGLE_BLOCK_BUDGET_BYTES - SINGLE_BLOCK_BUDGET_BYTES // 8
     return (
         _kernel_bytes(batch_rows, sizes, state_mirrors) + stream_extra
-        <= SINGLE_BLOCK_BUDGET_BYTES
+        <= budget
     )
 
 

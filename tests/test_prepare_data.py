@@ -62,9 +62,63 @@ def test_split_matches_reference_sklearn_permutation():
 
     x = np.arange(200, dtype=np.float32).reshape(200, 1)
     y = np.eye(10, dtype=np.float32)[np.arange(200) % 10]
-    xt, xv, yt, yv = prepare_data._split(x, y)
+    xt, xv, yt, yv, provenance = prepare_data._split(x, y)
+    assert provenance.startswith("sklearn.train_test_split")
     xt_r, xv_r, yt_r, yv_r = train_test_split(x, y, test_size=0.15, random_state=42)
     np.testing.assert_array_equal(xt, xt_r)
     np.testing.assert_array_equal(xv, xv_r)
     np.testing.assert_array_equal(yt, yt_r)
     np.testing.assert_array_equal(yv, yv_r)
+
+
+def test_openml_branch_executes_with_mocked_fetcher(tmp_path, monkeypatch):
+    """The openml branch (the reference's REAL data path,
+    download_dataset.py:9-23) must execute end-to-end — this environment has
+    no egress, so the fetcher is mocked with a tiny MNIST-784-shaped frame
+    (round-4 verdict #8: until now only the digits/synthetic branches ever
+    ran)."""
+    import sklearn.datasets
+
+    def fake_fetch_openml(name, version, data_home, return_X_y, as_frame):
+        assert name == "mnist_784" and version == 1 and not as_frame
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 256, (40, 784)).astype(np.float32)
+        # fetch_openml returns string labels for mnist_784
+        y = np.array([str(i % 10) for i in range(40)], dtype=object)
+        return x, y
+
+    monkeypatch.setattr(sklearn.datasets, "fetch_openml", fake_fetch_openml)
+    used = prepare_data.prepare(tmp_path / "d", source="openml")
+    assert used == "openml"
+    x = np.load(tmp_path / "d" / "x_train.npy")
+    y = np.load(tmp_path / "d" / "y_train.npy")
+    assert x.shape == (34, 784) and y.shape == (34, 10)  # 85% of 40
+    assert x.min() < 0 < x.max()  # /255 then mean-centered
+    np.testing.assert_allclose(y.sum(axis=1), 1.0)
+    import json
+
+    meta = json.loads((tmp_path / "d" / "dataset_meta.json").read_text())
+    assert meta["source"] == "openml"
+    assert meta["split"].startswith("sklearn.train_test_split")
+
+
+def test_fallback_split_warns_and_records_provenance(tmp_path, monkeypatch, capsys):
+    """When sklearn is absent the NumPy fallback split must announce itself
+    (stderr) and stamp its provenance into the dataset metadata — a silently
+    different validation membership is invisible in the accuracy numbers."""
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_sklearn(name, *a, **k):
+        if name.startswith("sklearn.model_selection"):
+            raise ImportError("mocked: no sklearn")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_sklearn)
+    x = np.arange(100, dtype=np.float32).reshape(100, 1)
+    y = np.eye(10, dtype=np.float32)[np.arange(100) % 10]
+    xt, xv, yt, yv, provenance = prepare_data._split(x, y)
+    assert provenance.startswith("numpy.permutation_fallback")
+    assert "NOT the reference" in capsys.readouterr().err
+    assert len(xv) == 15 and len(xt) == 85

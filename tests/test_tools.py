@@ -313,8 +313,13 @@ def test_bench_publishes_before_spending_tunnel_patience(monkeypatch, capsys):
         for ln in stdout_at_probe["out"].splitlines()
         if ln.startswith("{")
     ]
-    assert len(pre_lines) == 1 and pre_lines[0]["preliminary"] is True
-    assert pre_lines[0]["value"] == 5e4
+    # two records precede the probe: the null-value stub printed before ANY
+    # measurement (ADVICE r04 — a driver kill during the phase-1 CPU cells
+    # still leaves a parseable line) and the complete CPU preliminary
+    assert len(pre_lines) == 2
+    assert pre_lines[0]["preliminary"] is True and pre_lines[0]["value"] is None
+    assert "stub" in pre_lines[0]["tunnel"]["state"]
+    assert pre_lines[1]["preliminary"] is True and pre_lines[1]["value"] == 5e4
     # the final (last) line is the authoritative record with diagnostics
     post_lines = [
         _json.loads(ln)
@@ -363,10 +368,11 @@ def test_bench_healthy_probe_upgrades_to_chip_record(monkeypatch, capsys):
         for ln in capsys.readouterr().out.splitlines()
         if ln.startswith("{")
     ]
-    assert len(lines) == 3  # preliminary, interim, final
-    assert lines[0]["preliminary"] and "UNRESPONSIVE" in lines[0]["metric"]
-    assert lines[1]["preliminary"] and "WEDGED_MIDRUN" in lines[1]["metric"]
-    assert lines[1]["tunnel"]["probes"][0]["outcome"] == "ok"
+    assert len(lines) == 4  # stub, preliminary, interim, final
+    assert lines[0]["preliminary"] and lines[0]["value"] is None
+    assert lines[1]["preliminary"] and "UNRESPONSIVE" in lines[1]["metric"]
+    assert lines[2]["preliminary"] and "WEDGED_MIDRUN" in lines[2]["metric"]
+    assert lines[2]["tunnel"]["probes"][0]["outcome"] == "ok"
     final = lines[-1]
     assert final["metric"] == "mnist_mlp_train_samples_per_sec_per_chip"
     assert final["value"] == 5e6 and final["value_backend"] == "tpu"
