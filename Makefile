@@ -32,6 +32,11 @@ bench-matrix:
 tpu-capture:
 	python scripts/tpu_capture.py
 
+# bank only the tier-0 verdict cells (headline pair + kernel triple +
+# equality probes) — for a chip window too short for the full matrix
+tpu-capture-tier0:
+	python scripts/tpu_capture.py --tier0-only
+
 # the convergence-equivalence experiment behind the default-precision
 # bench headline (20-epoch run at --precision default + same-window pair)
 tpu-default-precision:

@@ -857,15 +857,24 @@ def train_epoch_kernel_fits(batch_rows, sizes, state_mirrors=0):
 
     ADVISORY, not a guarantee: the model counts operands and the streaming
     double-buffer but cannot see scratch/staging Mosaic may add for the
-    revisited constant-index param blocks, so a 12.5% safety margin is
-    held back from the budget. The margin (and the byte model itself) is
-    to be calibrated against a real Mosaic compile log at flagship shapes
-    when the chip answers (round-4 verdict #5) — until then a config that
-    passes here can still OOM at compile time on hardware; the capture
-    records that as a phase error rather than assuming the predicate."""
+    revisited constant-index param blocks, so on a REAL TPU backend a
+    12.5% safety margin is held back from the budget. In interpreter mode
+    (CPU CI) there is no VMEM and the full budget applies — the margin
+    must not reject configs that always worked off-chip. The margin (and
+    the byte model itself) is to be calibrated against a real Mosaic
+    compile log at flagship shapes when the chip answers (round-4 verdict
+    #5; capture phase t0-vmem records compiled-or-failed + the compiler's
+    memory analysis) — until then a config that passes here can still OOM
+    at compile time on hardware; the capture records that as a phase
+    error rather than assuming the predicate. The step kernel keeps the
+    full budget: its single-block operand accounting is exact, while the
+    margin covers specifically the epoch kernel's streaming/staging
+    unknowns."""
     widths = list(sizes)
     stream_extra = 4 * batch_rows * (widths[0] + widths[-1])
-    budget = SINGLE_BLOCK_BUDGET_BYTES - SINGLE_BLOCK_BUDGET_BYTES // 8
+    budget = SINGLE_BLOCK_BUDGET_BYTES
+    if not _interpret():
+        budget -= SINGLE_BLOCK_BUDGET_BYTES // 8
     return (
         _kernel_bytes(batch_rows, sizes, state_mirrors) + stream_extra
         <= budget

@@ -49,6 +49,10 @@ def test_capture_main_plumbing(tmp_path, monkeypatch, capture_mod):
         lambda precisions, trials=2: {"default": 200.0, "highest": 100.0},
     )
     monkeypatch.setattr(
+        tc, "epoch_kernel_vmem_analysis",
+        lambda: {"epoch_kernel_vmem": {"sgd": {"compiled_ok": True}}},
+    )
+    monkeypatch.setattr(
         tc, "_kernel_variant_cells",
         lambda opt, precisions, key_fmt, nb, trials, label: (
             {"fused+default+xla": 1.0, "fused+default+mega": 2.0,
@@ -167,6 +171,10 @@ def test_capture_tier0_only_stops_after_banking(tmp_path, monkeypatch, capture_m
         lambda precisions, trials=2: {"default": 200.0, "highest": 100.0},
     )
     monkeypatch.setattr(
+        tc, "epoch_kernel_vmem_analysis",
+        lambda: {"epoch_kernel_vmem": {"sgd": {"compiled_ok": True}}},
+    )
+    monkeypatch.setattr(
         tc, "_kernel_variant_cells",
         lambda *a, **k: ({"fused+default+epoch": 3.0}, {}, {"epoch": eq}),
     )
@@ -200,6 +208,10 @@ def test_capture_budget_skips_forward(tmp_path, monkeypatch, capture_mod):
     monkeypatch.setattr(
         bench, "jax_sps_many",
         lambda precisions, trials=2: {"default": 200.0, "highest": 100.0},
+    )
+    monkeypatch.setattr(
+        tc, "epoch_kernel_vmem_analysis",
+        lambda: {"epoch_kernel_vmem": {"sgd": {"compiled_ok": True}}},
     )
     monkeypatch.setattr(
         tc, "_kernel_variant_cells",
@@ -297,6 +309,10 @@ def test_capture_tier0_incomplete_stays_partial(tmp_path, monkeypatch, capture_m
         bench, "jax_sps_many",
         lambda precisions, trials=2: {"default": 200.0, "highest": 100.0},
     )
+    monkeypatch.setattr(
+        tc, "epoch_kernel_vmem_analysis",
+        lambda: {"epoch_kernel_vmem": {"sgd": {"compiled_ok": True}}},
+    )
 
     def boom(*a, **k):
         raise RuntimeError("mosaic compile failed")
@@ -366,3 +382,18 @@ def test_capture_aborts_cleanly_on_wedged_tunnel(tmp_path, monkeypatch, capture_
         tc.main()
     assert exc.value.code == 3
     assert not out.exists() and not Path(str(out) + ".partial").exists()
+
+
+def test_epoch_kernel_vmem_analysis_real_body(capture_mod):
+    """The REAL vmem-calibration body (tiny shapes, so CPU-fast) — every
+    other capture test stubs this phase, and a capture phase covered only
+    by stubs is exactly the signature-break class that burns chip windows."""
+    tc = capture_mod
+    out = tc.epoch_kernel_vmem_analysis(sizes=(20, 16, 10), B=8, M=2)
+    rec = out["epoch_kernel_vmem"]
+    for name in ("sgd", "adam"):
+        assert rec[name]["compiled_ok"] is True
+        assert rec[name]["fits_predicate"] is True
+        assert rec[name]["predicted_kernel_bytes"] > 0
+    assert rec["adam"]["predicted_kernel_bytes"] > rec["sgd"]["predicted_kernel_bytes"]
+    assert rec["budget_bytes"] > 0
