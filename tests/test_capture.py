@@ -397,3 +397,156 @@ def test_epoch_kernel_vmem_analysis_real_body(capture_mod):
         assert rec[name]["predicted_kernel_bytes"] > 0
     assert rec["adam"]["predicted_kernel_bytes"] > rec["sgd"]["predicted_kernel_bytes"]
     assert rec["budget_bytes"] > 0
+
+
+def test_capture_resume_skips_captured_phases(tmp_path, monkeypatch, capture_mod):
+    """--resume folds a previous run's .partial into the new run: phases
+    whose primary keys are already captured are NOT re-measured, retried
+    phases get fresh bookkeeping, and the prior run's flags move aside
+    under prior_run."""
+    tc = capture_mod
+    import bench
+    import bench_tpu_matrix
+
+    eq = {"max_abs_param_diff": 0.0, "loss_abs_diff": 0.0, "bitwise_equal": True}
+    out = tmp_path / "CAP.json"
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    # a previous run measured the headline sweeps + kernel cells, then was
+    # killed: phase 3 was skipped-by-budget; the trace completed LATE
+    # (after its budget) so its result must be re-measured, not trusted
+    (tmp_path / "CAP.json.partial").write_text(json.dumps({
+        "info": {"platform": "tpu"},
+        "capture_config": {"quick": True, "data_dir": str(data_dir)},
+        "numpy_baseline_sps": 77.0,
+        "headline_sweep_default_precision": {"unroll=8": 800.0},
+        "headline_best_sps": 800.0,
+        "vs_baseline": 10.39,
+        "headline_sweep_fp32_highest": {"unroll=8": 400.0},
+        "megakernel_cells": {"fused+default+epoch": 9.0},
+        "megakernel_onchip_equality": {"epoch": eq},
+        "trace": {"n_files": 99},
+        "phases_skipped_by_budget": [{"phase": "3-convergence", "budget_s": 1500}],
+        "phases_late_completed": ["4-trace"],
+    }))
+
+    calls = []
+    monkeypatch.setattr(
+        bench, "_ensure_responsive_backend",
+        lambda *a, **k: ("", {"probes": [{"outcome": "ok", "seconds": 1.0}]}),
+    )
+    monkeypatch.setattr(
+        bench, "numpy_baseline_sps",
+        lambda n_batches=40: calls.append("baseline") or 50.0,
+    )
+    monkeypatch.setattr(
+        bench, "jax_sps_many",
+        lambda precisions, trials=2: {"default": 200.0, "highest": 100.0},
+    )
+    monkeypatch.setattr(
+        tc, "_kernel_variant_cells",
+        lambda *a, **k: ({"fused+default+epoch": 3.0}, {}, {"epoch": eq}),
+    )
+    monkeypatch.setattr(
+        tc, "epoch_kernel_vmem_analysis",
+        lambda: {"epoch_kernel_vmem": {"sgd": {"compiled_ok": True}}},
+    )
+    monkeypatch.setattr(
+        tc, "headline_sweep",
+        lambda *a, **k: calls.append("headline_sweep") or ({"unroll=1": 1.0}, {}),
+    )
+    monkeypatch.setattr(
+        tc, "megakernel_cells",
+        lambda nb, trials: calls.append("megakernel_cells") or ({}, {}, {}),
+    )
+    monkeypatch.setattr(
+        tc, "convergence_run",
+        lambda d, e: calls.append("convergence") or {"epochs": e},
+    )
+    monkeypatch.setattr(
+        tc, "megakernel_convergence",
+        lambda d, e, variant="megakernel": {"variant": variant},
+    )
+    monkeypatch.setattr(tc, "profile_one_epoch", lambda d, t: {"n_files": 1})
+    monkeypatch.setattr(tc, "profile_headline_epoch", lambda t: {"n_files": 1})
+    monkeypatch.setattr(
+        bench_tpu_matrix, "run_matrix",
+        lambda cells, nb, trials: {("fused", "default", "xla"): 123.0},
+    )
+    monkeypatch.setattr(
+        tc, "executor_backend_cells", lambda nb, trials: ({}, {}, eq)
+    )
+    monkeypatch.setattr(
+        tc, "executor_backend_api_path", lambda d, epochs=2: {"hashes_match": True}
+    )
+    monkeypatch.setattr(tc, "adam_kernel_cells", lambda nb, trials: ({}, {}, {}))
+    monkeypatch.setattr(
+        tc, "adam_epoch_kernel_convergence", lambda d: {"val_accuracy": 0.99}
+    )
+    monkeypatch.setattr(
+        sys, "argv",
+        ["tpu_capture.py", "--quick", "--resume", "--out", str(out),
+         "--data-dir", str(data_dir)],
+    )
+    tc.main()
+
+    result = json.loads(out.read_text())
+    # captured phases were NOT re-measured in the full capture (tier-0 has
+    # its own file and DID run its pair fresh; the baseline is also shared
+    # into tier-0, so it ran at most once there, never for phase 1)
+    assert "headline_sweep" not in calls
+    assert "megakernel_cells" not in calls
+    # the previously-skipped phase WAS retried this run
+    assert "convergence" in calls
+    assert result["convergence"] == {"epochs": 5}
+    # prior values survive, prior bookkeeping moved aside, fresh run clean
+    assert result["headline_best_sps"] == 800.0
+    assert result["numpy_baseline_sps"] == 77.0
+    assert result["prior_run"]["phases_skipped_by_budget"][0]["phase"] == "3-convergence"
+    assert not result.get("phases_skipped_by_budget")
+    assert "completed_at" in result
+    # the prior run's device info is preserved, not discarded
+    assert result["prior_run"]["info"] == {"platform": "tpu"}
+    # the LATE-completed trace was invalidated and re-measured fresh
+    assert result["trace"] == {"n_files": 1}
+
+
+def test_resume_ignores_corrupt_and_mismatched_artifacts(tmp_path, capture_mod):
+    """A truncated .partial (killed mid-checkpoint) or one captured under a
+    different config must be skipped with a note, never crash or silently
+    merge quick-config cells into a full-config artifact."""
+    tc = capture_mod
+    sig = {"quick": False, "data_dir": "/d"}
+    # corrupt file: skipped, next path tried
+    corrupt = tmp_path / "a.partial"
+    corrupt.write_text('{"numpy_baseline_sps": 5')  # truncated
+    good = tmp_path / "b.json"
+    good.write_text(json.dumps({"capture_config": sig, "matrix": {"x": 1.0}}))
+    result = {}
+    tc._load_resume_state(result, (corrupt, good), sig)
+    assert result["matrix"] == {"x": 1.0}
+    assert str(corrupt) in result["resume_unreadable_artifacts"]
+    # config mismatch: artifact ignored entirely, mismatch recorded
+    result2 = {}
+    other = tmp_path / "c.json"
+    other.write_text(json.dumps(
+        {"capture_config": {"quick": True, "data_dir": "/d"}, "matrix": {"y": 2.0}}
+    ))
+    tc._load_resume_state(result2, (other,), sig)
+    assert "matrix" not in result2
+    assert result2["resume_ignored_mismatched"][0]["capture_config"]["quick"] is True
+
+
+def test_finalize_ratios_fills_cross_run_derivations(capture_mod):
+    """vs_baseline must be computable when the baseline and the sweep came
+    from DIFFERENT runs (resume), and never overwrite an existing value."""
+    tc = capture_mod
+    r = {"numpy_baseline_sps": 100.0, "headline_best_sps": 500.0,
+         "headline_best_fp32_sps": 300.0}
+    tc._finalize_ratios(r)
+    assert r["vs_baseline"] == 5.0 and r["vs_baseline_fp32"] == 3.0
+    r2 = {"numpy_baseline_sps": 100.0, "headline_pair": {"default": 250.0},
+          "vs_baseline_fp32": 9.9}
+    tc._finalize_ratios(r2)
+    assert r2["vs_baseline"] == 2.5
+    assert r2["vs_baseline_fp32"] == 9.9  # untouched
