@@ -19,11 +19,14 @@ they exercise the full multihost surface:
   6. the same with interleaved virtual stages (P=2 x V=2): ring relays stay
      on-process while the dp reduce crosses the boundary;
   7. the fused multi-epoch program (make_pipeline_run): two epochs in one
-     dispatch with the cross-process dp psum inside the epochs-outer scan.
+     dispatch with the cross-process dp psum inside the epochs-outer scan;
+  8. the same step on the PALLAS kernel backend (flag-operand kernels,
+     interpret mode on these CPU workers): the per-slot kernel units
+     compose with jax.distributed and match the xla backend's loss.
 
 Prints one JSON line {"pid", "psum_ok", "loss", "loss_z", "loss_i",
-"loss_run"} on success; any assertion failure exits non-zero and fails the
-parent test.
+"loss_run", "loss_pallas"} on success; any assertion failure exits non-zero
+and fails the parent test.
 """
 
 import json
@@ -150,6 +153,16 @@ def main():
     losses_r = np.asarray(losses_r)
     assert losses_r.shape == (2,) and losses_r[1] < losses_r[0]
 
+    # --- pallas kernel backend under the distributed runtime ---------------
+    # identical init to the first GPipe step, so the flag kernels' loss must
+    # match the xla backend's across the process-spanning mesh
+    st_p, fl_p = init_global(spec)
+    step_p = E.make_pipeline_step(
+        mesh, spec, prog, half // M, SGD(0.05), kernel_backend="pallas"
+    )
+    _, _, loss_p = step_p(st_p, fl_p, (), xg, yg)
+    np.testing.assert_allclose(float(loss_p), float(loss), rtol=1e-6)
+
     print(
         json.dumps(
             {
@@ -159,6 +172,7 @@ def main():
                 "loss_z": float(loss_z),
                 "loss_i": float(loss_i),
                 "loss_run": float(losses_r[-1]),
+                "loss_pallas": float(loss_p),
             }
         )
     )

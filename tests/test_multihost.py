@@ -96,7 +96,7 @@ def test_two_process_distributed_training_step():
     processes agree on the (replicated) losses."""
     outs = _run_worker_fleet(Path(__file__).parent / "_multihost_worker.py", 2)
     assert all(o["psum_ok"] for o in outs)
-    for key in ("loss", "loss_z", "loss_i", "loss_run"):
+    for key in ("loss", "loss_z", "loss_i", "loss_run", "loss_pallas"):
         losses = sorted((o["pid"], o[key]) for o in outs)
         assert losses[0][1] == pytest.approx(losses[1][1], rel=1e-6)
         assert np.isfinite(losses[0][1]) and losses[0][1] > 0
