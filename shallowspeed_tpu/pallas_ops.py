@@ -813,9 +813,6 @@ def fused_train_call(
     return new_params, new_mirrors, new_scalars, outs[len(flat)][0, 0]
 
 
-
-
-
 # ---------------------------------------------------------------------------
 # Whole-EPOCH mega-kernel: the batch dimension as the Pallas grid
 # ---------------------------------------------------------------------------
@@ -832,9 +829,6 @@ def fused_train_call(
 # loss-mean accumulation matches the epoch scan's order, so the result is
 # bit-identical to the scan-of-megakernel path (interpreter-verified;
 # on-chip equality measured by capture phase 2c).
-
-
-
 
 
 def train_step_kernel_fits(batch_rows, sizes, state_mirrors=0):
