@@ -19,8 +19,8 @@ healthy we capture every number in one process/one device claim:
 
 TIER-0 FIRST (round-4 verdict #1): before any of the long phases, a minimal
 bundle — NumPy denominator, the fused default/highest headline pair at the
-default unroll, and the sgd kernel triple (xla/mega/epoch) WITH its on-chip
-equality probes — is measured and banked as its own COMPLETE artifact
+default unroll, and the sgd kernel LADDER (xla/mega/epoch/run) WITH its
+on-chip equality probes — is measured and banked as its own COMPLETE artifact
 (<out minus .json>_tier0.json). A wedge anywhere in the full matrix can no
 longer cost the round its three verdict cells. ``--tier0-only`` stops there.
 
@@ -151,16 +151,41 @@ def headline_sweep(unrolls, trials, precision="highest"):
     return out, unresolved
 
 
+def _runkernel_wallclock_sps(run_fn, params, opt_state, X, Y, ref_sps, trials):
+    """Whole-dispatch wall-clock for the run kernel (the
+    bench.crosscheck_whole_run_sps pattern): the slope protocol would
+    recompile for every adapted leg size (static n_epochs), polluting timed
+    legs with Mosaic compiles — the documented wedge trigger. Instead, size
+    K to ~2 s of expected device work from an already-resolved sibling
+    cell's slope, pre-compile + warm with ONE fresh compile, then take the
+    best-of-``trials`` plain wall of a single K-epoch dispatch ending in a
+    forced readback (one RTT constant amortized to a few percent over ~2 s
+    of work)."""
+    samples_per_epoch = X.shape[0] * X.shape[1] * X.shape[2]
+    K = int(min(1000, max(8, 2.0 * ref_sps / samples_per_epoch)))
+    p, st, _ = run_fn(params, opt_state, X, Y, K)  # compile + warm
+    bench.sync_readback(p)
+    best = None
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        p, st, _ = run_fn(p, st, X, Y, K)
+        bench.sync_readback(p)
+        wall = time.perf_counter() - t0
+        best = wall if best is None else min(best, wall)
+    return samples_per_epoch * K / best
+
+
 def _kernel_variant_cells(opt, precisions, key_fmt, nb, trials, label):
-    """Shared measurement for one optimizer's xla/mega/epoch kernel triple:
-    the on-chip equality probe runs FIRST (ADVICE r03 — the kernels'
-    bit-identity with fused XLA is interpreter-verified on CPU, but Mosaic's
-    compiled lowering is not guaranteed bitwise-equal on hardware, so the
-    actual divergence of one 2-batch epoch from identical params+state is
-    measured and recorded), then every (precision, variant) cell is timed
-    with interleaved trials so all ratios are same-window. ONE definition
-    for the SGD and adam phases so the probe/timing discipline cannot
-    drift."""
+    """Shared measurement for one optimizer's kernel LADDER — fused xla vs
+    mega (one op/batch) vs epoch (one op/epoch) vs run (one op for ALL the
+    timed epochs): the on-chip equality probe runs FIRST (ADVICE r03 — the
+    kernels' bit-identity with fused XLA is interpreter-verified on CPU,
+    but Mosaic's compiled lowering is not guaranteed bitwise-equal on
+    hardware, so the actual divergence of one 2-batch epoch from identical
+    params+state is measured and recorded), then every (precision, variant)
+    cell is timed with interleaved trials so all ratios are same-window.
+    ONE definition for the SGD and adam phases so the probe/timing
+    discipline cannot drift."""
     import jax
     import jax.numpy as jnp
 
@@ -177,40 +202,80 @@ def _kernel_variant_cells(opt, precisions, key_fmt, nb, trials, label):
     rng = np.random.RandomState(0)
     X = jnp.asarray(rng.rand(nb, M, B // M, SIZES[0]).astype(np.float32))
     Y = jnp.asarray(
-        np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, M, B // M))]
+        np.eye(sizes_last := SIZES[-1], dtype=np.float32)[
+            rng.randint(0, sizes_last, (nb, M, B // M))
+        ]
     )
     VARIANTS = {
         "xla": {},
         "mega": {"megakernel": True},
         "epoch": {"epoch_kernel": True},
+        "run": None,  # equality-probed here; timed by _runkernel_wallclock_sps
     }
+
+    def make_run_fn(prec):
+        return trainer.make_train_run(
+            spec, opt, precision=PRECISIONS[prec], fuse_mubatches=True,
+            with_eval=False, run_kernel=True,
+        )
+
     eq_outs = {}
     for name, kw in VARIANTS.items():
-        epoch = trainer.make_train_epoch(
-            spec, opt, precision=PRECISIONS["highest"], fuse_mubatches=True, **kw
-        )
         params0 = jax.tree.map(jnp.asarray, Mo.init_model(spec))
-        p, st, loss = epoch(params0, opt.init(params0), X[:2], Y[:2])
+        if name == "run":
+            p, st, losses = make_run_fn("highest")(
+                params0, opt.init(params0), X[:2], Y[:2], 1
+            )
+            loss = float(losses[0])
+        else:
+            epoch = trainer.make_train_epoch(
+                spec, opt, precision=PRECISIONS["highest"], fuse_mubatches=True,
+                **kw,
+            )
+            p, st, loss = epoch(params0, opt.init(params0), X[:2], Y[:2])
         # params AND optimizer state in the equality tree (state is () for
         # SGD, so the record is unchanged there)
         eq_outs[name] = ((jax.device_get(p), jax.device_get(st)), float(loss))
     equality = {
         name: _equality_record(eq_outs["xla"], eq_outs[name])
-        for name in ("mega", "epoch")
+        for name in ("mega", "epoch", "run")
     }
     print(f"  on-chip {label} equality vs fused-xla (fp32): {equality}", flush=True)
 
     run_ks = {}
     for prec in precisions:
         for name, kw in VARIANTS.items():
-            epoch = trainer.make_train_epoch(
-                spec, opt, precision=PRECISIONS[prec], fuse_mubatches=True, **kw
-            )
+            if name == "run":
+                continue  # whole-dispatch wall-clock below, not slope legs
             params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
             key = key_fmt.format(prec=prec, name=name)
+            epoch = trainer.make_train_epoch(
+                spec, opt, precision=PRECISIONS[prec], fuse_mubatches=True,
+                **kw,
+            )
             run_ks[key] = bench.make_run_k(epoch, params, opt.init(params), X, Y)
             print(f"  built {key}", file=sys.stderr, flush=True)
     cells, unresolved = _measure_salvaged(run_ks, trials, nb * B)
+    for prec in precisions:
+        key = key_fmt.format(prec=prec, name="run")
+        ref_sps = cells.get(key_fmt.format(prec=prec, name="epoch")) or cells.get(
+            key_fmt.format(prec=prec, name="xla")
+        )
+        if not ref_sps:
+            unresolved[key] = "no resolved sibling cell to size the dispatch from"
+            continue
+        try:
+            params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+            sps = _runkernel_wallclock_sps(
+                make_run_fn(prec), params, opt.init(params), X, Y, ref_sps,
+                trials,
+            )
+        except Exception as e:  # noqa: BLE001 — one cell must not abort the set
+            unresolved[key] = f"{type(e).__name__}: {e}"
+            continue
+        cells[key] = round(sps, 1)
+        print(f"  {key}: {cells[key]:,.0f} samples/s (whole-dispatch wall)",
+              flush=True)
     return cells, unresolved, equality
 
 

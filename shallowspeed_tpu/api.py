@@ -79,6 +79,7 @@ class TrainingSession:
         clip_norm=None,
         megakernel=False,
         epoch_kernel=False,
+        run_kernel=False,
         kernel_backend="xla",
     ):
         if global_batch_size % dp != 0:
@@ -114,6 +115,17 @@ class TrainingSession:
                 "epoch_kernel runs the whole epoch as one Pallas kernel; "
                 "it requires fuse_mubatches=True (sequential path)"
             )
+        if run_kernel and not fuse_mubatches:
+            raise ValueError(
+                "run_kernel runs the whole multi-epoch run as one Pallas "
+                "kernel; it requires fuse_mubatches=True (sequential path)"
+            )
+        if run_kernel and (megakernel or epoch_kernel):
+            raise ValueError(
+                "run_kernel subsumes the mega/epoch kernels; pass only "
+                "run_kernel=True"
+            )
+        self._run_kernel = bool(run_kernel)
         if kernel_backend not in ("xla", "pallas"):
             raise ValueError(
                 f"kernel_backend must be 'xla' or 'pallas', got {kernel_backend!r}"
@@ -253,13 +265,13 @@ class TrainingSession:
                 self.spec, opt, precision=self.precision,
                 fuse_mubatches=fuse_mubatches, unroll=scan_unroll,
                 clip_norm=clip_norm, megakernel=megakernel,
-                epoch_kernel=epoch_kernel,
+                epoch_kernel=epoch_kernel or run_kernel,
             )
             self._predict = trainer.make_predict(self.spec, precision=self.precision)
             self._run_kwargs = dict(
                 precision=self.precision, fuse_mubatches=fuse_mubatches,
                 unroll=scan_unroll, clip_norm=clip_norm, megakernel=megakernel,
-                epoch_kernel=epoch_kernel,
+                epoch_kernel=epoch_kernel or run_kernel,
             )
             self._Xe = self._X.reshape(nb, self.M, self.B // self.M, -1)
             self._Ye = self._Y.reshape(nb, self.M, self.B // self.M, -1)
@@ -391,8 +403,16 @@ class TrainingSession:
         """Build (once per with_eval) the layout's fused whole-run program."""
         if with_eval not in self._run_fns:
             if self._sequential:
+                kwargs = dict(self._run_kwargs)
+                if not with_eval and getattr(self, "_run_kernel", False):
+                    # the eval-free run rides the whole-RUN kernel: one
+                    # device op for all n_epochs (per-epoch eval needs
+                    # per-epoch params, so the evaluated run keeps the
+                    # epochs-outer scan over the epoch kernel)
+                    kwargs["epoch_kernel"] = False
+                    kwargs["run_kernel"] = True
                 self._run_fns[with_eval] = trainer.make_train_run(
-                    self.spec, self._opt, with_eval=with_eval, **self._run_kwargs
+                    self.spec, self._opt, with_eval=with_eval, **kwargs
                 )
             else:
                 eval_kwargs = {}

@@ -630,9 +630,9 @@ _OPT_GEOMETRY = {"sgd": (0, 0), "momentum": (1, 0), "adam": (2, 1)}
 
 def _train_kernel_body(
     x_ref, y_ref, *refs, L, relu_flags, group_rows, batch_size, lr, opt, decay,
-    precision, epoch_mode, clip_norm=None,
+    precision, epoch_mode, run_mode=False, clip_norm=None,
 ):
-    """THE training kernel body — every public variant (step/epoch x
+    """THE training kernel body — every public variant (step/epoch/run x
     sgd/momentum/adam) compiles from this one definition so the plumbing
     cannot drift:
 
@@ -647,6 +647,12 @@ def _train_kernel_body(
       VMEM for the whole epoch, and the loss block accumulates the
       per-batch losses before a final divide (matching the epoch scan's
       sum-then-divide order exactly).
+    - ``run_mode`` (requires ``epoch_mode``): the grid is (epochs, batches)
+      — the ENTIRE multi-epoch run is one kernel. Params + state seed at
+      the very first grid step and stay VMEM-resident for the whole run;
+      the loss block's index map follows the epoch axis, so each epoch
+      accumulates its own mean into ``losses[e]`` with the same
+      zero/sum/divide order as the single-epoch kernel.
 
     Operand layout: ``[x, y] + ins + outs + [loss]`` where ``ins``/``outs``
     are ``w*L + b*L`` then mirror groups (each ``w*L + b*L``-shaped) then
@@ -660,13 +666,26 @@ def _train_kernel_body(
     loss_ref = refs[2 * n]
 
     if epoch_mode:
-        b_idx = pl.program_id(0)
-        nb = pl.num_programs(0)
+        if run_mode:
+            e_idx, b_idx = pl.program_id(0), pl.program_id(1)
+            nb = pl.num_programs(1)
+            first_step = (e_idx == 0) & (b_idx == 0)
+        else:
+            b_idx = pl.program_id(0)
+            nb = pl.num_programs(0)
+            first_step = b_idx == 0
 
-        @pl.when(b_idx == 0)
+        @pl.when(first_step)
         def _init():
             for i in range(n):
                 outs[i][:] = ins[i][:]
+
+        # the loss block is revisited per epoch in run_mode (its index map
+        # follows the epoch axis), so it zeroes at the START of every epoch
+        # — for the single-epoch kernel this is the same b == 0 step _init
+        # runs on, preserving the exact zero/sum/divide order
+        @pl.when(b_idx == 0)
+        def _zero_loss():
             loss_ref[0, 0] = 0.0
 
         src = outs  # current params + state live in the revisited out blocks
@@ -719,10 +738,28 @@ def _train_kernel_body(
         loss_ref[0, 0] = loss
 
 
+# ---------------------------------------------------------------------------
+# Whole-RUN mega-kernel: (epochs x batches) as the Pallas grid
+# ---------------------------------------------------------------------------
+#
+# The epoch kernel collapses an epoch to one device op, but a 20-epoch
+# convergence run is still ~20 serial dispatches (plus scan bookkeeping) on
+# the op-issue-bound critical path. In run_mode the grid gains an OUTER
+# epoch axis: TPU grid steps execute row-major (epoch-major), params and
+# optimizer state seed once and live in the revisited output blocks for the
+# WHOLE run, x/y blocks re-stream each epoch (their index map ignores the
+# epoch axis), and the per-epoch mean losses land in a (n_epochs, 1) output
+# whose block follows the epoch axis. The entire training RUN — the
+# reference's outermost loop — becomes ONE device op. Bit-identical to
+# looping the epoch kernel (tested); eval stays outside (per-epoch
+# accuracies need per-epoch params, so the evaluated run keeps the
+# epochs-outer scan).
+
+
 def fused_train_call(
     stage_params, x, y, *, epoch_mode, relu_flags, group_rows,
     batch_size, lr, weight_decay, precision, opt=None, mirrors=(), scalars=(),
-    clip_norm=None,
+    clip_norm=None, n_epochs=None,
 ):
     """THE public entry point for every fused-training kernel variant
     (step/epoch x sgd/momentum/adam — trainer._fused_kernel_call is the
@@ -734,10 +771,13 @@ def fused_train_call(
     _train_kernel_body); ``mirrors``/``scalars`` must match its
     _OPT_GEOMETRY. ``epoch_mode=False`` takes x: (B, in), y: (B, out) and
     runs one batch; ``epoch_mode=True`` takes X: (nb, B, in), Y: (nb, B,
-    out) and runs the whole epoch as one kernel. ``clip_norm``: optional
-    global-norm gradient clipping inside the kernel (see _batch_grads —
-    bit-identical to the XLA path's optimizer.clip_tree). Returns
-    ``(new_stage_params, new_mirrors, new_scalars, loss)``."""
+    out) and runs the whole epoch as one kernel; with ``n_epochs`` set
+    (requires epoch_mode) the grid is (n_epochs, nb) and the ENTIRE run is
+    one kernel — ``loss`` comes back as the (n_epochs,) per-epoch means.
+    ``clip_norm``: optional global-norm gradient clipping inside the
+    kernel (see _batch_grads — bit-identical to the XLA path's
+    optimizer.clip_tree). Returns ``(new_stage_params, new_mirrors,
+    new_scalars, loss)``."""
     from shallowspeed_tpu.optimizer import _decay_factor
 
     opt = opt or {"kind": "sgd"}
@@ -762,32 +802,55 @@ def fused_train_call(
         flat += flat_group(mirror)
     flat += [jnp.reshape(jnp.asarray(s, jnp.float32), (1, 1)) for s in scalars]
     decay = _decay_factor(lr, weight_decay) if weight_decay else 1.0
+    if n_epochs is not None and not epoch_mode:
+        raise ValueError("n_epochs requires epoch_mode=True")
     kernel = functools.partial(
         _train_kernel_body,
         L=L, relu_flags=tuple(relu_flags), group_rows=group_rows,
         batch_size=batch_size, lr=lr, opt=opt, decay=decay,
-        precision=precision, epoch_mode=epoch_mode, clip_norm=clip_norm,
+        precision=precision, epoch_mode=epoch_mode,
+        run_mode=n_epochs is not None, clip_norm=clip_norm,
     )
+    loss_shape = (1, 1) if n_epochs is None else (n_epochs, 1)
     out_shape = tuple(
         [jax.ShapeDtypeStruct(a.shape, jnp.float32) for a in flat]
-        + [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+        + [jax.ShapeDtypeStruct(loss_shape, jnp.float32)]
     )
     if epoch_mode:
         nb, B_, din = x.shape
         dout = y.shape[-1]
         x = jnp.reshape(x, (nb * B_, din))
         y = jnp.reshape(y, (nb * B_, dout))
-        const = lambda shape: pl.BlockSpec(  # noqa: E731
-            shape, lambda b: tuple(0 for _ in shape), memory_space=pltpu.VMEM
-        )
-        call_kwargs = dict(
-            grid=(nb,),
-            in_specs=[
+        if n_epochs is None:
+            const = lambda shape: pl.BlockSpec(  # noqa: E731
+                shape, lambda b: tuple(0 for _ in shape),
+                memory_space=pltpu.VMEM,
+            )
+            xy_specs = [
                 pl.BlockSpec((B_, din), lambda b: (b, 0), memory_space=pltpu.VMEM),
                 pl.BlockSpec((B_, dout), lambda b: (b, 0), memory_space=pltpu.VMEM),
             ]
-            + [const(a.shape) for a in flat],
-            out_specs=tuple([const(a.shape) for a in flat] + [const((1, 1))]),
+            loss_spec = const((1, 1))
+            grid = (nb,)
+        else:
+            # epoch-major grid; x/y index maps ignore the epoch axis (the
+            # same data re-streams every epoch), the loss block follows it
+            const = lambda shape: pl.BlockSpec(  # noqa: E731
+                shape, lambda e, b: tuple(0 for _ in shape),
+                memory_space=pltpu.VMEM,
+            )
+            xy_specs = [
+                pl.BlockSpec((B_, din), lambda e, b: (b, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B_, dout), lambda e, b: (b, 0), memory_space=pltpu.VMEM),
+            ]
+            loss_spec = pl.BlockSpec(
+                (1, 1), lambda e, b: (e, 0), memory_space=pltpu.VMEM
+            )
+            grid = (n_epochs, nb)
+        call_kwargs = dict(
+            grid=grid,
+            in_specs=xy_specs + [const(a.shape) for a in flat],
+            out_specs=tuple([const(a.shape) for a in flat] + [loss_spec]),
         )
     else:
         call_kwargs = dict(
@@ -810,7 +873,9 @@ def fused_train_call(
     new_scalars = [
         jnp.reshape(outs[sc_base + i], ()) for i in range(len(scalars))
     ]
-    return new_params, new_mirrors, new_scalars, outs[len(flat)][0, 0]
+    loss_out = outs[len(flat)]
+    loss = loss_out[0, 0] if n_epochs is None else jnp.reshape(loss_out, (-1,))
+    return new_params, new_mirrors, new_scalars, loss
 
 
 # ---------------------------------------------------------------------------

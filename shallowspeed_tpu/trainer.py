@@ -150,9 +150,11 @@ def _validate_megakernel(spec, opt, fuse_mubatches, name="megakernel"):
     if spec.n_stages != 1 or not spec.stages[0].has_head:
         raise ValueError(f"{name} runs the single-stage sequential path only")
     sspec = spec.stages[0]
+    # the run kernel streams x/y per grid step exactly like the epoch
+    # kernel (the extra epoch axis adds no VMEM), so it shares that budget
     fits = (
         pallas_ops.train_epoch_kernel_fits
-        if name == "epoch_kernel"
+        if name in ("epoch_kernel", "run_kernel")
         else pallas_ops.train_step_kernel_fits
     )
     n_mirrors, _ = pallas_ops._OPT_GEOMETRY[desc["kind"]]
@@ -187,7 +189,7 @@ def _make_epoch_kernel_core(spec, opt, precision, fuse_mubatches, clip_norm):
 
 def _fused_kernel_call(
     spec, sspec, opt, precision, params, opt_state, x, y, *, epoch_mode,
-    group_rows, clip_norm=None,
+    group_rows, clip_norm=None, n_epochs=None,
 ):
     """The one trainer->pallas_ops bridge for every mega/epoch-kernel
     variant: maps the framework optimizer state onto the kernel's mirror
@@ -216,6 +218,7 @@ def _fused_kernel_call(
         weight_decay=opt.weight_decay,
         precision=precision,
         opt=desc, mirrors=mirrors, scalars=scalars, clip_norm=clip_norm,
+        n_epochs=n_epochs,
     )
     if kind == "momentum":
         new_state = [new_mirrors[0]]
@@ -317,6 +320,7 @@ def make_train_run(
     with_eval=True,
     megakernel=False,
     epoch_kernel=False,
+    run_kernel=False,
 ):
     """Whole-RUN scan: every epoch (and its validation accuracy) in ONE program.
 
@@ -335,7 +339,48 @@ def make_train_run(
     expressed as data flow instead of a host loop. ``n_epochs`` is static
     (one compile per value). vx: (n_val, in_dim); vy: (n_val, out_dim)
     one-hot.
+
+    ``run_kernel=True`` (requires the epoch-kernel constraint set and
+    ``with_eval=False``) runs the ENTIRE multi-epoch training run as ONE
+    Pallas kernel: the grid is (n_epochs, batches), params + optimizer
+    state stay VMEM-resident for the whole run, and the per-epoch mean
+    losses come back as the losses vector — the last rung of the
+    batch -> epoch -> run dispatch-collapse ladder (one device op for the
+    reference's whole outermost loop). Bit-identical to looping the epoch
+    kernel. Per-epoch eval needs per-epoch params, so the evaluated run
+    keeps the epochs-outer scan.
     """
+    if run_kernel:
+        if megakernel or epoch_kernel:
+            raise ValueError(
+                "run_kernel already subsumes the epoch/mega kernels; pass "
+                "only run_kernel=True"
+            )
+        if with_eval:
+            raise ValueError(
+                "run_kernel supports with_eval=False only (per-epoch eval "
+                "needs per-epoch params outside the kernel)"
+            )
+        sspec = _validate_megakernel(spec, opt, fuse_mubatches, name="run_kernel")
+
+        @partial(jax.jit, static_argnums=(4,), donate_argnums=(0, 1))
+        def run(params, opt_state, X, Y, n_epochs):
+            # static check at trace time: a (0, nb) grid never writes the
+            # output blocks, so n_epochs=0 would return undefined buffers
+            # where the scan path returns the inputs unchanged
+            if n_epochs < 1:
+                raise ValueError("run_kernel requires n_epochs >= 1")
+            nb, M_, mb, din = X.shape
+            x = X.reshape(nb, M_ * mb, din)
+            y = Y.reshape(nb, M_ * mb, Y.shape[-1])
+            return _fused_kernel_call(
+                spec, sspec, opt, precision, params, opt_state, x, y,
+                epoch_mode=True, group_rows=mb, clip_norm=clip_norm,
+                n_epochs=n_epochs,
+            )
+
+        return run
+
     if epoch_kernel:
         if megakernel:
             raise ValueError("megakernel and epoch_kernel are exclusive")

@@ -356,3 +356,37 @@ def test_adam_epoch_kernel_checkpoint_resume_cross_layout(data_dir, tmp_path):
         ]),
         rtol=2e-4, atol=2e-6,
     )
+
+
+def test_run_kernel_via_api_matches_epoch_kernel(data_dir):
+    """TrainingSession(run_kernel=True): the eval-free fused run is ONE
+    device op and must reproduce the epoch-kernel session's losses and
+    final hash; the evaluated surfaces (train_epoch, accuracy) still work
+    and ride the epoch kernel."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    losses = {}
+    hashes = {}
+    for kw in ({"epoch_kernel": True}, {"run_kernel": True}):
+        run = TrainingSession(
+            sizes=SIZES, data_dir=data_dir, fuse_mubatches=True,
+            global_batch_size=32, mubatches=2, **kw,
+        )
+        losses[tuple(kw)], _ = run.train_run(2, with_eval=False)
+        hashes[tuple(kw)] = run.model_hash()
+        assert 0.0 <= run.accuracy() <= 1.0  # eval path still alive
+    assert losses[("epoch_kernel",)] == losses[("run_kernel",)]
+    assert hashes[("epoch_kernel",)] == hashes[("run_kernel",)]
+
+
+def test_run_kernel_api_validation(data_dir):
+    from shallowspeed_tpu.api import TrainingSession
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="fuse_mubatches"):
+        TrainingSession(sizes=SIZES, data_dir=data_dir, run_kernel=True)
+    with _pytest.raises(ValueError, match="subsumes"):
+        TrainingSession(
+            sizes=SIZES, data_dir=data_dir, fuse_mubatches=True,
+            run_kernel=True, epoch_kernel=True,
+        )

@@ -197,3 +197,26 @@ def test_fused_run_cli_no_eval(tiny_data):
     )
     assert out.count("mean train loss") == 2
     assert out.count("Accuracy:") == 1  # the final summary only
+
+
+def test_sequential_cli_run_kernel_matches_fused(tiny_data):
+    """--run-kernel --fused-run --no-eval (the ENTIRE 2-epoch run as one
+    Pallas kernel) trains to the same model hash and prints the same
+    per-epoch losses as the fused XLA run through the real CLI."""
+    import re as _re
+
+    outs = {}
+    for extra in ([], ["--run-kernel"]):
+        outs[bool(extra)] = _run(
+            ["--epochs", "2", "--global-batch-size", "32", "--mubatches", "2",
+             "--no-eval", "--fuse-mubatches", "--fused-run", *extra],
+            tiny_data,
+        )
+    for key in (r"final model hash: ([0-9a-f]{40})",):
+        a = _re.search(key, outs[False]).group(1)
+        b = _re.search(key, outs[True]).group(1)
+        assert a == b
+    losses = {
+        k: _re.findall(r"mean train loss: ([0-9.]+)", v) for k, v in outs.items()
+    }
+    assert losses[False] == losses[True] and len(losses[True]) == 2

@@ -281,7 +281,18 @@ def test_random_kernel_variant_fuzz(seed):
         )
         params, st, loss = epoch(params, st, X, Y)
         out[name] = (jax.device_get(params), jax.device_get(st), float(loss))
-    for other in ("mega", "epoch"):
+    # the whole-RUN kernel at n_epochs=1 must land on the same bits too
+    params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+    st = opt.init(params)
+    run = trainer.make_train_run(
+        spec, opt, fuse_mubatches=True, with_eval=False, run_kernel=True,
+        clip_norm=clip,
+    )
+    p_r, st_r, losses_r = run(params, st, X, Y, 1)
+    out["run"] = (
+        jax.device_get(p_r), jax.device_get(st_r), float(losses_r[0])
+    )
+    for other in ("mega", "epoch", "run"):
         assert out["xla"][2] == out[other][2], label
         for tree_idx in (0, 1):
             for a, b in zip(

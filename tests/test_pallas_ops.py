@@ -537,3 +537,118 @@ class TestClipKernels:
         # unclipped one
         unclipped = self._run(opt, {"epoch_kernel": True}, None)
         assert outs["epoch"][2] != unclipped[2]
+
+
+class TestRunKernel:
+    """The whole-RUN kernel (fused_train_call, n_epochs): the grid is
+    (epochs, batches), params + optimizer state VMEM-resident for the whole
+    run — ONE device op for the entire training run. The bar is BIT-identity
+    (params, state, per-epoch losses) with looping the epoch kernel, and
+    hence with fused XLA."""
+
+    def _data(self, sizes, B, M, nb, seed=11):
+        rng = np.random.RandomState(seed)
+        X = jnp.asarray(rng.rand(nb, M, B // M, sizes[0]).astype(np.float32))
+        Y = jnp.asarray(
+            np.eye(sizes[-1], dtype=np.float32)[
+                rng.randint(0, sizes[-1], (nb, M, B // M))
+            ]
+        )
+        return X, Y
+
+    @pytest.mark.parametrize(
+        "opt,clip",
+        [
+            (SGD(0.01, weight_decay=1e-4), None),
+            (MomentumSGD(0.01, 0.9), 0.05),
+            (Adam(2e-4), None),
+        ],
+        ids=["sgd", "momentum+clip", "adam"],
+    )
+    def test_run_kernel_bit_identical_to_epoch_loop(self, opt, clip):
+        sizes, B, M, nb, E = (20, 16, 12, 10), 32, 4, 3, 4
+        X, Y = self._data(sizes, B, M, nb)
+        spec = Mo.make_model_spec(sizes, 1, B)
+
+        params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+        st = opt.init(params)
+        epoch = trainer.make_train_epoch(
+            spec, opt, fuse_mubatches=True, epoch_kernel=True, clip_norm=clip
+        )
+        want_losses = []
+        for _ in range(E):
+            params, st, loss = epoch(params, st, X, Y)
+            want_losses.append(float(loss))
+        want = (jax.device_get(params), jax.device_get(st))
+
+        params2 = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+        st2 = opt.init(params2)
+        run = trainer.make_train_run(
+            spec, opt, fuse_mubatches=True, run_kernel=True, with_eval=False,
+            clip_norm=clip,
+        )
+        params2, st2, losses = run(params2, st2, X, Y, E)
+        got = (jax.device_get(params2), jax.device_get(st2))
+
+        np.testing.assert_array_equal(
+            np.asarray(losses), np.asarray(want_losses, np.float32)
+        )
+        for tree_idx in (0, 1):
+            for a, b in zip(
+                jax.tree.leaves(want[tree_idx]), jax.tree.leaves(got[tree_idx])
+            ):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_run_kernel_matches_fused_xla_run(self):
+        """End of the ladder meets the start: the one-op run reproduces the
+        fused-XLA whole-run program's losses exactly."""
+        sizes, B, M, nb, E = (20, 16, 12, 10), 32, 4, 2, 3
+        X, Y = self._data(sizes, B, M, nb, seed=13)
+        spec = Mo.make_model_spec(sizes, 1, B)
+        out = {}
+        for name, kw in {
+            "xla": {},
+            "run": {"run_kernel": True},
+        }.items():
+            params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+            run = trainer.make_train_run(
+                spec, SGD(0.01), fuse_mubatches=True, with_eval=False, **kw
+            )
+            params, _, losses = run(params, (), X, Y, E)
+            out[name] = (jax.device_get(params), np.asarray(losses))
+        np.testing.assert_array_equal(out["xla"][1], out["run"][1])
+        for a, b in zip(
+            jax.tree.leaves(out["xla"][0]), jax.tree.leaves(out["run"][0])
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_run_kernel_guards(self):
+        spec = Mo.make_model_spec((20, 16, 12, 10), 1, 32)
+        with pytest.raises(ValueError, match="with_eval"):
+            trainer.make_train_run(
+                spec, SGD(0.01), fuse_mubatches=True, run_kernel=True
+            )
+        with pytest.raises(ValueError, match="subsumes"):
+            trainer.make_train_run(
+                spec, SGD(0.01), fuse_mubatches=True, run_kernel=True,
+                epoch_kernel=True, with_eval=False,
+            )
+        with pytest.raises(ValueError, match="epoch_mode"):
+            pallas_ops.fused_train_call(
+                [{"W": jnp.zeros((4, 4)), "b": jnp.zeros(4)}],
+                jnp.zeros((8, 4)), jnp.zeros((8, 4)),
+                epoch_mode=False, relu_flags=(False,), group_rows=8,
+                batch_size=8, lr=0.1, weight_decay=0.0, precision=None,
+                n_epochs=2,
+            )
+
+    def test_run_kernel_rejects_zero_epochs(self):
+        spec = Mo.make_model_spec((20, 16, 12, 10), 1, 32)
+        X, Y = self._data((20, 16, 12, 10), 32, 4, 2)
+        run = trainer.make_train_run(
+            spec, SGD(0.01), fuse_mubatches=True, run_kernel=True,
+            with_eval=False,
+        )
+        params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
+        with pytest.raises(ValueError, match="n_epochs >= 1"):
+            run(params, (), X, Y, 0)

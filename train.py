@@ -122,6 +122,15 @@ def main():
         "one device op per epoch instead of one per batch)",
     )
     ap.add_argument(
+        "--run-kernel",
+        action="store_true",
+        help="with --fuse-mubatches (SGD, momentum or adam): run the whole "
+        "multi-epoch training run as ONE Pallas kernel when dispatched via "
+        "--fused-run --no-eval (grid = epochs x batches, params VMEM-resident "
+        "for the entire run; identical numerics). Per-epoch runs and the "
+        "evaluated fused run ride the epoch kernel",
+    )
+    ap.add_argument(
         "--weight-decay",
         type=float,
         default=0.0,
@@ -184,6 +193,7 @@ def main():
         fuse_mubatches=args.fuse_mubatches,
         megakernel=args.megakernel,
         epoch_kernel=args.epoch_kernel,
+        run_kernel=args.run_kernel,
         optimizer=args.optimizer,
         momentum=args.momentum,
         virtual_stages=args.virtual_stages,
