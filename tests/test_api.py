@@ -390,3 +390,21 @@ def test_run_kernel_api_validation(data_dir):
             sizes=SIZES, data_dir=data_dir, fuse_mubatches=True,
             run_kernel=True, epoch_kernel=True,
         )
+
+
+def test_run_kernel_state_rides_checkpoint_protocol(data_dir, tmp_path):
+    """Optimizer state produced INSIDE the whole-run kernel (adam's m/v
+    mirrors + step counter advanced across a multi-epoch grid) must ride
+    the checkpoint protocol: save after a 2-epoch one-op run, resume, and
+    land bit-for-bit on the uninterrupted 4-epoch one-op run."""
+    kw = dict(optimizer="adam", lr=2e-4, fuse_mubatches=True, run_kernel=True)
+    ref = _session(data_dir, **kw)
+    ref.train_run(4, with_eval=False)
+
+    run = _session(data_dir, **kw)
+    run.train_run(2, with_eval=False)
+    ck = tmp_path / "run_kernel.npz"
+    run.save(ck)
+    resumed = _session(data_dir, resume=ck, **kw)
+    resumed.train_run(2, with_eval=False)
+    assert resumed.model_hash() == ref.model_hash()
