@@ -550,3 +550,43 @@ def test_finalize_ratios_fills_cross_run_derivations(capture_mod):
     tc._finalize_ratios(r2)
     assert r2["vs_baseline"] == 2.5
     assert r2["vs_baseline_fp32"] == 9.9  # untouched
+
+
+def test_phase_runner_suspect_budget_after_consecutive_skips(capture_mod, monkeypatch):
+    """After two consecutive budget skips the tunnel is presumed wedged:
+    later phases still run (each must be ATTEMPTED) but at the short
+    suspect budget, and the first success restores normal budgets."""
+    tc = capture_mod
+    result = {}
+    runner = tc._PhaseRunner(result, lambda: None)
+    release = threading.Event()
+    budgets_seen = []
+
+    real_join = threading.Thread.join
+
+    def spy_join(self, timeout=None):
+        if self.name.startswith("phase-"):
+            budgets_seen.append(timeout)
+        return real_join(self, timeout)
+
+    monkeypatch.setattr(threading.Thread, "join", spy_join)
+    for label in ("hang-a", "hang-b", "after-wedge"):
+        monkeypatch.setitem(tc.PHASE_BUDGET_S, label, 500)
+    monkeypatch.setitem(tc.PHASE_BUDGET_S, "hang-a", 0.1)
+    monkeypatch.setitem(tc.PHASE_BUDGET_S, "hang-b", 0.1)
+    try:
+        assert runner.run("hang-a", lambda: release.wait(30)) is False
+        assert runner.run("hang-b", lambda: release.wait(30)) is False
+        # third phase: budget clamped to SUSPECT_BUDGET_S, still attempted
+        assert runner.run("after-wedge", lambda: {"ok": 1}) is True
+        assert budgets_seen[-1] == tc.SUSPECT_BUDGET_S
+        # success resets the wedge counter: full budget again
+        monkeypatch.setitem(tc.PHASE_BUDGET_S, "recovered", 777)
+        assert runner.run("recovered", lambda: {"ok2": 2}) is True
+        assert budgets_seen[-1] == 777
+    finally:
+        release.set()
+    assert [e["phase"] for e in result["phases_skipped_by_budget"]] == [
+        "hang-a", "hang-b"
+    ]
+    assert result["ok"] == 1 and result["ok2"] == 2
