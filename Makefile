@@ -32,10 +32,15 @@ bench-matrix:
 tpu-capture:
 	python scripts/tpu_capture.py
 
-# bank only the tier-0 verdict cells (headline pair + kernel triple +
+# bank only the tier-0 verdict cells (headline pair + kernel ladder +
 # equality probes) — for a chip window too short for the full matrix
 tpu-capture-tier0:
 	python scripts/tpu_capture.py --tier0-only
+
+# unattended: probe the tunnel every 10 min, run the resumable capture on
+# the first healthy probe (see scripts/tunnel_watch.sh)
+tpu-watch:
+	bash scripts/tunnel_watch.sh
 
 # the convergence-equivalence experiment behind the default-precision
 # bench headline (20-epoch run at --precision default + same-window pair)
