@@ -828,7 +828,7 @@ def _load_resume_state(result, paths, config_sig):
         for k in (
             "phases_skipped_by_budget", "phase_errors",
             "phases_late_completed", "phases_with_concurrent_abandoned_work",
-            "completed_at", "info",
+            "completed_at", "info", "phase_seconds",
         ):
             if k in prev:
                 prior[k] = prev.pop(k)
