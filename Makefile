@@ -3,10 +3,26 @@
 CPU_MESH = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
            XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test data train train-mesh bench bench-scaling schedules clean
+# verify needs bash (pipefail / PIPESTATUS)
+SHELL := /bin/bash
+
+.PHONY: test verify metrics-smoke data train train-mesh bench bench-scaling \
+        schedules clean
 
 test:
 	python -m pytest tests/ -q
+
+# the ROADMAP tier-1 command, verbatim — the gate every PR must keep green
+verify:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# telemetry end-to-end smoke: 1 CPU epoch with --metrics-out, then assert
+# the file is non-empty valid JSONL with a per-epoch record (needs data:
+# `make data` first, or point SHALLOWSPEED_DATA_DIR at a prepared dir)
+metrics-smoke:
+	rm -f /tmp/metrics.jsonl
+	$(CPU_MESH) python train.py --epochs 1 --no-eval --metrics-out /tmp/metrics.jsonl
+	python -c "import json; lines = [json.loads(l) for l in open('/tmp/metrics.jsonl') if l.strip()]; assert lines, 'metrics file is empty'; assert any(r.get('kind') == 'event' and r.get('name') == 'epoch' for r in lines), 'no per-epoch record'; print(f'metrics-smoke OK: {len(lines)} valid JSONL records')"
 
 data:
 	python prepare_data.py

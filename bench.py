@@ -780,9 +780,13 @@ def main():
     # publication" previously began only after ~15-30 min of CPU measurement; a
     # driver window shorter than that still ended with empty stdout. The stub's
     # null value is honest — nothing measured yet — and it is superseded by
-    # every later record line on any path that survives phase 1.
+    # every later record line on any path that survives phase 1. Its metric
+    # tag is the NEUTRAL _STUB_NOT_MEASURED (ADVICE r05: the tunnel has not
+    # been probed at this point, so a _CPU_FALLBACK_TUNNEL_UNRESPONSIVE tag
+    # would claim a tunnel state that was never tested), and build_record
+    # stamps a machine-readable "stub": true key alongside.
     stub, stub_warnings = build_record(
-        {}, {}, baseline, "_CPU_FALLBACK_TUNNEL_UNRESPONSIVE",
+        {}, {}, baseline, "_STUB_NOT_MEASURED",
         tunnel_env_active=True,
         tunnel={
             "state": "stub — printed before ANY measurement; authoritative "
@@ -914,7 +918,10 @@ def build_record(
     ``stub=True``: emit a record-SHAPED line with null values even when
     nothing is measured yet (the phase-0 stub printed before the phase-1 CPU
     cells) — deriving it here keeps the stub's schema and config claim from
-    drifting out of sync with the published record's.
+    drifting out of sync with the published record's. The record carries a
+    machine-readable ``"stub": true`` key (and the caller passes the neutral
+    ``_STUB_NOT_MEASURED`` tag) so no consumer can misread an untested
+    tunnel as a probed-unresponsive one (ADVICE r05).
 
     Returns ``(record_dict | None, warnings)``; None = nothing measured.
     """
@@ -989,6 +996,8 @@ def build_record(
         record["tunnel"] = tunnel
     if preliminary:
         record["preliminary"] = True
+    if stub:
+        record["stub"] = True
     return record, warnings
 
 

@@ -947,7 +947,11 @@ def main():
     result = {"info": info, "capture_config": config_sig}
     partial_path = Path(str(args.out) + ".partial")
     if args.resume:
-        _load_resume_state(result, (partial_path,), config_sig)
+        # pass BOTH the banked artifact and the .partial (mirroring the
+        # tier-0 call; complete beats partial): once a capture has been
+        # renamed into <out>, a later --resume must build on it instead of
+        # re-measuring every phase and overwriting it (ADVICE r05)
+        _load_resume_state(result, (Path(args.out), partial_path), config_sig)
     runner = _PhaseRunner(
         result,
         lambda: partial_path.write_text(json.dumps(result, indent=2) + "\n"),

@@ -6,5 +6,8 @@ setup(
     description="TPU-native distributed-training framework (DP x PP on a JAX mesh)",
     packages=find_packages(include=["shallowspeed_tpu", "shallowspeed_tpu.*"]),
     python_requires=">=3.10",
-    install_requires=["jax>=0.7", "numpy"],
+    # 0.4.37 is the oldest runtime the compat layer supports
+    # (parallel/compat.py maps jax.shard_map/check_vma onto the
+    # jax.experimental spelling; multihost probes is_initialized)
+    install_requires=["jax>=0.4.37", "numpy"],
 )

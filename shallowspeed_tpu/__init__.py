@@ -30,8 +30,13 @@ Architecture (TPU-first, not a port):
                  optimizer state through checkpoints, stacked pp sharding and
                  ZeRO-1 chunking.
 - ``checkpoint`` layout-independent .npz save/resume (params + opt state).
+- ``observability`` training telemetry: metrics recorders (versioned JSONL /
+                 in-memory / null), profiling spans wrapping
+                 jax.profiler.TraceAnnotation, and the chrome-trace
+                 analyzer behind docs/performance.md's roofline numbers.
 - ``api``        ``TrainingSession`` — data + model + layout + optimizer +
-                 eval as one object (the CLI in train.py is a thin wrapper).
+                 eval as one object (the CLI in train.py is a thin wrapper);
+                 ``metrics=`` streams per-epoch telemetry + spans.
 """
 
 from shallowspeed_tpu import (

@@ -17,6 +17,8 @@ else the SPMD pipeline executor — same weights either way (tested layout
 equivalence).
 """
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -28,6 +30,7 @@ from shallowspeed_tpu import schedules as S
 from shallowspeed_tpu import trainer, utils
 from shallowspeed_tpu.checkpoint import load_checkpoint, save_checkpoint
 from shallowspeed_tpu.data import Dataset, default_data_dir
+from shallowspeed_tpu.observability import NullMetrics
 from shallowspeed_tpu.optimizer import (
     is_stateless,
     join_state,
@@ -36,6 +39,7 @@ from shallowspeed_tpu.optimizer import (
 )
 from shallowspeed_tpu.parallel import executor as E
 from shallowspeed_tpu.parallel import lower_schedule, make_mesh
+from shallowspeed_tpu.parallel.lowering import program_stats
 
 # The reference's canonical training configuration (train.py:56-59,98,107) —
 # the single source of truth for every benchmark script in this repo.
@@ -81,7 +85,13 @@ class TrainingSession:
         epoch_kernel=False,
         run_kernel=False,
         kernel_backend="xla",
+        metrics=None,
     ):
+        # telemetry hook (observability package): None -> the zero-overhead
+        # null backend. Everything the session emits — construction spans,
+        # jit-compile spans, per-epoch training records, pipeline program
+        # stats — flows through this one recorder (docs/observability.md).
+        self._metrics = metrics if metrics is not None else NullMetrics()
         if global_batch_size % dp != 0:
             raise ValueError("global batch size must be divisible by dp")
         local_batch = global_batch_size // dp
@@ -181,8 +191,9 @@ class TrainingSession:
                 f"than one global batch of {self.B}"
             )
         Xb, Yb = self._train_ds.epoch_arrays()
-        self._X = jnp.asarray(Xb.reshape(nb, self.B, Xb.shape[-1]))
-        self._Y = jnp.asarray(Yb.reshape(nb, self.B, Yb.shape[-1]))
+        with self._metrics.span("device_put"):
+            self._X = jnp.asarray(Xb.reshape(nb, self.B, Xb.shape[-1]))
+            self._Y = jnp.asarray(Yb.reshape(nb, self.B, Yb.shape[-1]))
         self.batches_per_epoch = nb
 
         n_model_stages = pp * virtual_stages
@@ -245,8 +256,24 @@ class TrainingSession:
         else:
             host_params = Mo.init_model(self.spec)
 
+        # telemetry aux: when recording AND clipping, the epoch/run programs
+        # also return the pre-clip global gradient norm (ordinary fused
+        # outputs — never host callbacks inside the scan). The kernel paths
+        # keep gradients in VMEM, so the aux is unavailable there; the mesh
+        # fused run (make_pipeline_run) doesn't thread it either.
+        aux_gnorm = (
+            self._metrics.enabled
+            and clip_norm is not None
+            and not (megakernel or epoch_kernel or run_kernel)
+        )
+        self._epoch_aux = aux_gnorm
+        self._run_aux = aux_gnorm and self._sequential
+        self._epoch_compiled = False  # compile-span already recorded?
+        self._epoch_dispatched = False  # first train_epoch includes compile
+
         if self._sequential:
-            self._params = jax.tree.map(jnp.asarray, host_params)
+            with self._metrics.span("device_put"):
+                self._params = jax.tree.map(jnp.asarray, host_params)
             if host_opt_state is not None and not is_stateless(opt):
                 self._opt_state = join_state(
                     opt,
@@ -266,6 +293,7 @@ class TrainingSession:
                 fuse_mubatches=fuse_mubatches, unroll=scan_unroll,
                 clip_norm=clip_norm, megakernel=megakernel,
                 epoch_kernel=epoch_kernel or run_kernel,
+                with_grad_norm=self._epoch_aux,
             )
             self._predict = trainer.make_predict(self.spec, precision=self.precision)
             self._run_kwargs = dict(
@@ -278,13 +306,27 @@ class TrainingSession:
             self._X = self._Y = None  # the microbatched views are the only users
         else:
             self.mesh = make_mesh(dp, pp, devices)
-            prog = lower_schedule(
-                S.SCHEDULES[schedule], mubatches, pp, virtual=self.V
-            )
-            self._stacked, self._flags = E.put_stacked(
-                *E.stack_params(host_params, self.spec, order=self._order),
-                self.mesh,
-            )
+            with self._metrics.span("schedule_lower"):
+                prog = lower_schedule(
+                    S.SCHEDULES[schedule], mubatches, pp, virtual=self.V
+                )
+            if self._metrics.enabled:
+                # per-tick program stats, recorded once at lowering time:
+                # the executor's runtime tick behaviour is fully determined
+                # by these static tables (ticks, sends, occupancy, bubble)
+                stats = program_stats(prog)
+                self._metrics.event(
+                    "pipeline_program",
+                    schedule=schedule, dp=dp, pp=pp, virtual=self.V, **stats,
+                )
+                self._metrics.gauge(
+                    "pipeline.bubble_fraction", stats["bubble_fraction"]
+                )
+            with self._metrics.span("device_put"):
+                self._stacked, self._flags = E.put_stacked(
+                    *E.stack_params(host_params, self.spec, order=self._order),
+                    self.mesh,
+                )
             if self._zero1:
                 self._opt_state = E.zero1_state_from_logical(
                     host_opt_state, opt, self.spec, self.mesh, order=self._order
@@ -315,6 +357,7 @@ class TrainingSession:
                 precision=self.precision, zero1=self._zero1,
                 unroll=scan_unroll, tick_unroll=tick_unroll,
                 clip_norm=clip_norm, kernel_backend=kernel_backend,
+                with_grad_norm=self._epoch_aux,
             )
             self._prog = prog
             self._mubatch_local = local_batch // mubatches
@@ -327,20 +370,75 @@ class TrainingSession:
 
     # -- training -----------------------------------------------------------
 
+    def _epoch_args(self):
+        """The layout's runtime argument tuple for one epoch."""
+        if self._sequential:
+            return (self._params, self._opt_state, self._Xe, self._Ye)
+        return (self._stacked, self._flags, self._opt_state, self._X, self._Y)
+
+    def _ensure_epoch_compiled(self):
+        """With metrics enabled, compile the epoch program once inside a
+        ``jit_compile`` span (trace + lowering + XLA compile, timed as a
+        first-class record) before the first dispatch. Steady-state dispatch
+        stays on the jit wrapper's C++ fast path — on this backend the AOT
+        executable's Python dispatch costs ~2-3% per epoch, so the compiled
+        object is only the timing probe, not the call path. The probe does
+        NOT warm the jit wrapper's own call cache (verified on jax 0.4.x:
+        the first jit call still compiles), so the first dispatch pays a
+        second compile — a deliberate one-time cost for an isolated
+        compile-time record, and the reason the first ``epoch`` event is
+        stamped ``includes_compile`` (its wall/samples_per_sec are NOT
+        steady-state; consumers must not read them as such)."""
+        if not self._metrics.enabled or self._epoch_compiled:
+            return
+        with self._metrics.span("jit_compile"):
+            self._epoch_fn.lower(*self._epoch_args()).compile()
+        self._metrics.counter("jit_compiles")
+        self._epoch_compiled = True
+
     def train_epoch(self) -> float:
         """One epoch over the training shard; returns the mean batch training
         loss (same definition on both layouts: global-batch-scaled MSE of each
-        batch under its pre-update params, averaged over the epoch)."""
-        if self._sequential:
-            self._params, self._opt_state, mean_loss = self._epoch_fn(
-                self._params, self._opt_state, self._Xe, self._Ye
+        batch under its pre-update params, averaged over the epoch).
+
+        With a metrics recorder attached, emits one ``epoch`` event per call
+        (epoch index, loss, samples/s, wall seconds — plus the mean pre-clip
+        grad norm when clipping) and a ``train_epoch`` span. The first
+        recorded epoch carries ``includes_compile: true`` — the jit call
+        cache is cold on the first dispatch, so that record's wall clock
+        includes compilation and must not be read as steady-state."""
+        first_dispatch = self._metrics.enabled and not self._epoch_dispatched
+        self._ensure_epoch_compiled()
+        t0 = time.perf_counter()
+        with self._metrics.span("train_epoch"):
+            out = self._epoch_fn(*self._epoch_args())
+            if self._sequential:
+                self._params, self._opt_state, mean_loss = out[0], out[1], out[2]
+            else:
+                self._stacked, self._opt_state, mean_loss = out[0], out[1], out[2]
+            loss = float(mean_loss)  # forces device completion
+        if self._metrics.enabled:
+            wall = time.perf_counter() - t0
+            samples = self.batches_per_epoch * self.B
+            record = dict(
+                epoch=self.epoch,
+                loss=loss,
+                samples_per_sec=samples / wall if wall > 0 else 0.0,
+                wall_s=wall,
             )
-        else:
-            self._stacked, self._opt_state, mean_loss = self._epoch_fn(
-                self._stacked, self._flags, self._opt_state, self._X, self._Y
-            )
+            if self._epoch_aux:
+                record["grad_norm"] = float(out[3]["grad_norm"])
+            if first_dispatch:
+                # the jit call cache was cold: this wall includes compile
+                record["includes_compile"] = True
+            self._metrics.event("epoch", **record)
+            if not first_dispatch:  # steady-state only, per the histogram's use
+                self._metrics.observe("epoch.seconds", wall)
+            self._metrics.counter("epochs_trained")
+            self._metrics.counter("samples_trained", samples)
+        self._epoch_dispatched = True
         self.epoch += 1
-        return float(mean_loss)
+        return loss
 
     def train_run(self, epochs: int, with_eval: bool = True):
         """Train ``epochs`` epochs; returns ``(losses, accuracies)`` as lists
@@ -357,28 +455,61 @@ class TrainingSession:
             raise ValueError("epochs must be positive")
         if with_eval and self._vx is None:
             self._load_val()
-        compiled = self._compiled_runs.get((with_eval, epochs))
-        if compiled is not None:
-            out = compiled(*self._fused_run_args(with_eval))
-        else:
-            out = self._fused_run_fn(with_eval)(
-                *self._fused_run_args(with_eval), epochs
-            )
-        if with_eval:
-            state, opt_state, losses, accs = out
-        else:
-            state, opt_state, losses = out
-            accs = None
+        if self._metrics.enabled:
+            # AOT-compile first (inside warm_run's jit_compile span) so the
+            # recorded dispatch wall time is steady-state execution
+            self.warm_run(epochs, with_eval=with_eval)
+        start = self.epoch
+        t0 = time.perf_counter()
+        with self._metrics.span("train_run"):
+            compiled = self._compiled_runs.get((with_eval, epochs))
+            if compiled is not None:
+                out = compiled(*self._fused_run_args(with_eval))
+            else:
+                out = self._fused_run_fn(with_eval)(
+                    *self._fused_run_args(with_eval), epochs
+                )
+            if self._run_aux:
+                out, aux = out[:-1], out[-1]
+            else:
+                aux = None
+            if with_eval:
+                state, opt_state, losses, accs = out
+            else:
+                state, opt_state, losses = out
+                accs = None
+            losses = [float(v) for v in np.asarray(losses)]  # forces completion
+            accs_f = [float(v) for v in np.asarray(accs)] if with_eval else None
         if self._sequential:
             self._params = state
         else:
             self._stacked = state
         self._opt_state = opt_state
         self.epoch += epochs
-        return (
-            [float(v) for v in np.asarray(losses)],
-            [float(v) for v in np.asarray(accs)] if with_eval else None,
-        )
+        if self._metrics.enabled:
+            wall = time.perf_counter() - t0
+            samples = self.batches_per_epoch * self.B
+            # one fused dispatch -> per-epoch wall clocks don't exist; the
+            # run-mean samples/s is attributed to every epoch record
+            sps = epochs * samples / wall if wall > 0 else 0.0
+            gns = None if aux is None else np.asarray(aux["grad_norm"])
+            for e, loss in enumerate(losses):
+                record = dict(
+                    epoch=start + e,
+                    loss=loss,
+                    samples_per_sec=sps,
+                    wall_s=wall / epochs,
+                    fused_run=True,
+                )
+                if accs_f is not None:
+                    record["accuracy"] = accs_f[e]
+                if gns is not None:
+                    record["grad_norm"] = float(gns[e])
+                self._metrics.event("epoch", **record)
+            self._metrics.observe("run.seconds", wall)
+            self._metrics.counter("epochs_trained", epochs)
+            self._metrics.counter("samples_trained", epochs * samples)
+        return losses, accs_f
 
     def warm_run(self, epochs: int, with_eval: bool = True):
         """AOT-compile the fused ``train_run`` program without executing it.
@@ -393,11 +524,13 @@ class TrainingSession:
             self._load_val()
         key = (with_eval, epochs)
         if key not in self._compiled_runs:
-            self._compiled_runs[key] = (
-                self._fused_run_fn(with_eval)
-                .lower(*self._fused_run_args(with_eval), epochs)
-                .compile()
-            )
+            with self._metrics.span("jit_compile"):
+                self._compiled_runs[key] = (
+                    self._fused_run_fn(with_eval)
+                    .lower(*self._fused_run_args(with_eval), epochs)
+                    .compile()
+                )
+            self._metrics.counter("jit_compiles")
 
     def _fused_run_fn(self, with_eval):
         """Build (once per with_eval) the layout's fused whole-run program."""
@@ -412,7 +545,8 @@ class TrainingSession:
                     kwargs["epoch_kernel"] = False
                     kwargs["run_kernel"] = True
                 self._run_fns[with_eval] = trainer.make_train_run(
-                    self.spec, self._opt, with_eval=with_eval, **kwargs
+                    self.spec, self._opt, with_eval=with_eval,
+                    with_grad_norm=self._run_aux, **kwargs
                 )
             else:
                 eval_kwargs = {}
@@ -504,13 +638,24 @@ class TrainingSession:
         """Argmax accuracy over the full validation split."""
         if self._vx is None:
             self._load_val()
-        if self._sequential:
-            return trainer.accuracy(self._predict, self._params, self._vx, self._vy)
-        n_val = self._vx.shape[0]
-        preds = self._eval_step(self._stacked, self._flags, self._vx_padded)[:n_val]
-        out_dim = self.spec.out_dim
-        correct = int((jnp.argmax(preds[:, :out_dim], 1) == self._vy_labels).sum())
-        return correct / max(n_val, 1)
+        with self._metrics.span("eval"):
+            if self._sequential:
+                acc = trainer.accuracy(
+                    self._predict, self._params, self._vx, self._vy
+                )
+            else:
+                n_val = self._vx.shape[0]
+                preds = self._eval_step(
+                    self._stacked, self._flags, self._vx_padded
+                )[:n_val]
+                out_dim = self.spec.out_dim
+                correct = int(
+                    (jnp.argmax(preds[:, :out_dim], 1) == self._vy_labels).sum()
+                )
+                acc = correct / max(n_val, 1)
+        if self._metrics.enabled:
+            self._metrics.gauge("val_accuracy", acc)
+        return acc
 
     # -- state --------------------------------------------------------------
 

@@ -1,0 +1,48 @@
+"""Training telemetry: structured metrics, profiling spans, trace analysis.
+
+The framework's north star is "as fast as the hardware allows" — which is
+unclaimable without instrumentation. This package is the single home for
+everything that *observes* a run, so every perf PR can ship a recomputable
+evidence trail instead of prose:
+
+- ``metrics``      the recording surface: ``MetricsRecorder`` (in-memory
+                   counters / gauges / timers / per-step histograms),
+                   ``JsonlMetrics`` (the versioned JSONL sink) and
+                   ``NullMetrics`` (the zero-overhead default — recording
+                   disabled costs nothing on the hot path);
+- ``spans``        profiling spans: wall-clock + ``jax.profiler``
+                   TraceAnnotation context managers (so host-side phases —
+                   schedule lowering, jit compile, device put, epoch
+                   execution — are labeled inside profiler captures AND
+                   timed into the metrics stream), plus ``capture`` wrapping
+                   ``jax.profiler.trace``;
+- ``trace_stats``  the chrome-trace analyzer behind docs/performance.md's
+                   roofline numbers (promoted from scripts/ to an importable,
+                   tested module; the script remains as a thin shim).
+
+Wiring: ``TrainingSession(metrics=JsonlMetrics(path))`` records per-epoch
+training telemetry (loss, samples/s, grad-norm when clipping), compile-time
+spans, and — on mesh layouts — the lowered pipeline program's static tick
+stats (ticks, sends, stage occupancy, bubble fraction). The CLI flag is
+``train.py --metrics-out FILE``. See docs/observability.md.
+"""
+
+from shallowspeed_tpu.observability.metrics import (
+    SCHEMA_VERSION,
+    JsonlMetrics,
+    MetricsRecorder,
+    NullMetrics,
+    read_jsonl,
+)
+from shallowspeed_tpu.observability.spans import Span, capture, span
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "JsonlMetrics",
+    "MetricsRecorder",
+    "NullMetrics",
+    "Span",
+    "capture",
+    "read_jsonl",
+    "span",
+]

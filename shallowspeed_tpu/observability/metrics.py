@@ -1,0 +1,296 @@
+"""Structured metrics recording: counters, gauges, timers, histograms, JSONL.
+
+Three recorders share one surface:
+
+- ``NullMetrics``     the default everywhere a ``metrics=`` hook exists.
+                      Every method is a no-op and the hot-path methods
+                      (``counter``/``gauge``/``observe``/``timer``/``span``)
+                      allocate nothing — recording disabled must cost nothing
+                      measurable inside a training loop (tested:
+                      tests/test_observability.py asserts zero net
+                      allocations over thousands of calls).
+- ``MetricsRecorder`` in-memory aggregation (counter sums, last-value
+                      gauges, per-name histogram samples) with a
+                      ``summary()`` snapshot — the base class; also directly
+                      useful in tests and benchmarks.
+- ``JsonlMetrics``    MetricsRecorder + a versioned JSONL sink: one
+                      self-describing JSON object per line, schema pinned by
+                      ``SCHEMA_VERSION`` and stamped both in the header
+                      record and in every record's ``"v"`` field, so a
+                      consumer can hard-fail on records it doesn't
+                      understand instead of misreading them (the BENCH_r0x
+                      lesson: unlabeled records cost more than no records).
+
+Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
+
+    {"v": 1, "ts": ..., "kind": "meta",      "name": "metrics",
+     "schema": "shallowspeed_tpu.metrics", "created": "..."}
+    {"v": 1, "ts": ..., "kind": "counter",   "name": ..., "value": total,
+     "inc": delta}
+    {"v": 1, "ts": ..., "kind": "gauge",     "name": ..., "value": ...}
+    {"v": 1, "ts": ..., "kind": "histogram", "name": ..., "value": sample}
+    {"v": 1, "ts": ..., "kind": "timer",     "name": ..., "seconds": ...}
+    {"v": 1, "ts": ..., "kind": "span",      "name": ..., "path": "a/b",
+     "depth": n, "seconds": ...}
+    {"v": 1, "ts": ..., "kind": "event",     "name": ..., **fields}
+
+The span taxonomy and the metric names the framework itself emits are
+documented in docs/observability.md.
+"""
+
+import json
+import time
+
+from shallowspeed_tpu.observability.spans import Span
+
+SCHEMA_VERSION = 1
+SCHEMA_NAME = "shallowspeed_tpu.metrics"
+
+
+class _NullContext:
+    """Reusable allocation-free no-op context manager (module singleton)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL_CONTEXT = _NullContext()
+
+
+class NullMetrics:
+    """The no-op backend: the hot-path methods take fixed positional
+    arguments (no ``**kwargs`` — an empty kwargs dict is still a dict
+    allocation per call) and return module-level singletons."""
+
+    __slots__ = ()
+    enabled = False
+
+    def counter(self, name, value=1.0):
+        pass
+
+    def gauge(self, name, value):
+        pass
+
+    def observe(self, name, value):
+        pass
+
+    def timer(self, name):
+        return _NULL_CONTEXT
+
+    def span(self, name):
+        return _NULL_CONTEXT
+
+    def event(self, name, **fields):
+        pass
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class MetricsRecorder:
+    """In-memory aggregating recorder (and the sink-backed recorders' base).
+
+    Aggregation semantics:
+    - ``counter``  monotonic per-name sum of increments;
+    - ``gauge``    last value wins;
+    - ``observe``  per-name sample list (a per-step histogram — the summary
+                   reports count/min/max/mean);
+    - ``timer``    a context manager whose wall-clock duration is observed
+                   into the ``<name>.seconds`` histogram (+ a timer record);
+    - ``span``     ``spans.Span`` bound to this recorder: wall-clock + a
+                   ``jax.profiler.TraceAnnotation`` labeling profiler
+                   captures; emits a span record with its nesting path;
+    - ``event``    a free-form named record (arbitrary JSON-able fields) —
+                   the shape the per-epoch training telemetry uses.
+    """
+
+    enabled = True
+
+    def __init__(self):
+        self.counters = {}
+        self.gauges = {}
+        self.histograms = {}
+        self.spans = []  # (path, seconds) in completion order
+
+    # -- recording surface --------------------------------------------------
+
+    def counter(self, name, value=1.0):
+        total = self.counters.get(name, 0.0) + value
+        self.counters[name] = total
+        self._emit({"kind": "counter", "name": name, "value": total, "inc": value})
+
+    def gauge(self, name, value):
+        self.gauges[name] = value
+        self._emit({"kind": "gauge", "name": name, "value": value})
+
+    def observe(self, name, value):
+        self.histograms.setdefault(name, []).append(value)
+        self._emit({"kind": "histogram", "name": name, "value": value})
+
+    def timer(self, name):
+        return _Timer(self, name)
+
+    def span(self, name):
+        return Span(name, metrics=self)
+
+    def event(self, name, **fields):
+        self._emit({"kind": "event", "name": name, **fields})
+
+    # -- recorder-internal hooks --------------------------------------------
+
+    def _record_span(self, span):
+        """Completion hook called by spans.Span.__exit__."""
+        self.spans.append((span.path, span.seconds))
+        self._emit(
+            {
+                "kind": "span",
+                "name": span.name,
+                "path": span.path,
+                "depth": span.depth,
+                "seconds": span.seconds,
+            }
+        )
+
+    def _record_timer(self, name, seconds):
+        self.histograms.setdefault(name + ".seconds", []).append(seconds)
+        self._emit({"kind": "timer", "name": name, "seconds": seconds})
+
+    def _emit(self, record):
+        """Sink hook: the in-memory base discards (aggregation above already
+        happened); JsonlMetrics overrides this with the JSONL write."""
+
+    # -- inspection ---------------------------------------------------------
+
+    def summary(self):
+        """JSON-able aggregate snapshot of everything recorded so far."""
+        return {
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "histograms": {
+                name: {
+                    "count": len(vs),
+                    "min": min(vs),
+                    "max": max(vs),
+                    "mean": sum(vs) / len(vs),
+                }
+                for name, vs in self.histograms.items()
+                if vs
+            },
+            "spans": [{"path": p, "seconds": s} for p, s in self.spans],
+        }
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class _Timer:
+    """Context manager recording one wall-clock duration into a recorder."""
+
+    __slots__ = ("_metrics", "_name", "_t0", "seconds")
+
+    def __init__(self, metrics, name):
+        self._metrics = metrics
+        self._name = name
+        self.seconds = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.seconds = time.perf_counter() - self._t0
+        self._metrics._record_timer(self._name, self.seconds)
+        return False
+
+
+class JsonlMetrics(MetricsRecorder):
+    """MetricsRecorder with a versioned append-only JSONL sink.
+
+    Every record is one line, written (and by default flushed) immediately —
+    a killed run keeps everything recorded up to the kill, and ``tail -f``
+    on the file is a live dashboard. The first line is a ``meta`` header
+    naming the schema; each record also carries ``"v": SCHEMA_VERSION`` so
+    lines stay self-describing when files are concatenated.
+
+    ``flush_every``: flush the OS buffer every N records (1 = every record;
+    per-epoch recording volumes make this free either way).
+    """
+
+    def __init__(self, path, mode="w", flush_every=1):
+        super().__init__()
+        self.path = path
+        self._flush_every = max(1, int(flush_every))
+        self._since_flush = 0
+        self._f = open(path, mode, encoding="utf-8")
+        self._emit(
+            {
+                "kind": "meta",
+                "name": "metrics",
+                "schema": SCHEMA_NAME,
+                "created": time.strftime("%Y-%m-%d %H:%M:%S"),
+            }
+        )
+
+    def _emit(self, record):
+        if self._f is None:
+            raise ValueError(f"JsonlMetrics({self.path!r}) is closed")
+        self._f.write(
+            json.dumps({"v": SCHEMA_VERSION, "ts": time.time(), **record}) + "\n"
+        )
+        self._since_flush += 1
+        if self._since_flush >= self._flush_every:
+            self._f.flush()
+            self._since_flush = 0
+
+    def flush(self):
+        if self._f is not None:
+            self._f.flush()
+            self._since_flush = 0
+
+    def close(self):
+        if self._f is not None:
+            self._f.flush()
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+
+def read_jsonl(path, strict=True):
+    """Load a metrics JSONL file back into a list of record dicts.
+
+    ``strict=True`` (default) raises on records whose schema version is
+    newer than this reader understands — refusing loudly beats silently
+    misreading a future schema (the honesty rule every published record in
+    this repo follows). Blank lines are skipped; malformed lines raise.
+    """
+    records = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if strict and rec.get("v", 0) > SCHEMA_VERSION:
+                raise ValueError(
+                    f"{path}:{i + 1}: record schema v{rec.get('v')} is newer "
+                    f"than this reader (v{SCHEMA_VERSION})"
+                )
+            records.append(rec)
+    return records

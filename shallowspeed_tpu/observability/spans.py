@@ -1,0 +1,129 @@
+"""Profiling spans: wall-clock + jax.profiler annotation context managers.
+
+A span marks a named phase of host-side work — schedule lowering, jit
+compile, device put, an epoch's execution — in BOTH observability planes at
+once:
+
+- wall-clock: the duration lands in the bound metrics recorder as a
+  ``span`` record carrying the span's nesting path (``"train_run/epoch"``)
+  and depth, so phase timings are queryable from the JSONL stream;
+- device traces: the span body runs under ``jax.profiler.TraceAnnotation``,
+  so when a capture is active (``capture(logdir)`` /
+  ``jax.profiler.trace``) the phase appears as a labeled region on the
+  host timeline of the ``*.trace.json.gz`` that
+  ``observability.trace_stats`` analyzes.
+
+Nesting is tracked per-thread: entering a span pushes its name on a
+thread-local stack, so concurrently-profiled threads never corrupt each
+other's paths.
+"""
+
+import contextlib
+import threading
+import time
+
+try:  # jax is a hard dependency of the framework, but spans must degrade to
+    # pure wall-clock timers if the profiler surface is ever unavailable
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:  # pragma: no cover - exercised only on crippled installs
+    _TraceAnnotation = None
+
+_tls = threading.local()
+
+
+def _stack():
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+class Span:
+    """Context manager timing one named phase (optionally into a recorder).
+
+    Usable standalone (``with span("lower"): ...`` then ``.seconds``) or
+    bound to a ``MetricsRecorder`` via ``metrics.span(name)``, which records
+    a ``span`` record on exit. Re-entrant use of one instance is not
+    supported — create one per ``with``.
+    """
+
+    __slots__ = ("name", "metrics", "path", "depth", "seconds", "_t0", "_ann")
+
+    def __init__(self, name, metrics=None):
+        self.name = name
+        self.metrics = metrics
+        self.path = None
+        self.depth = None
+        self.seconds = None
+
+    def __enter__(self):
+        stack = _stack()
+        self.depth = len(stack)
+        self.path = "/".join(stack + [self.name])
+        # enter the annotation BEFORE pushing: if it raises, __exit__ never
+        # runs, and a pushed-but-never-popped name would corrupt every later
+        # span's path in this thread for the rest of the process
+        if _TraceAnnotation is not None:
+            self._ann = _TraceAnnotation(self.name)
+            self._ann.__enter__()
+        else:
+            self._ann = None
+        stack.append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.seconds = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        stack = _stack()
+        # tolerate a corrupted stack (an unexited inner span after an
+        # exception mid-body) rather than raising during unwinding
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        if self.metrics is not None:
+            self.metrics._record_span(self)
+        return False
+
+
+def span(name, metrics=None):
+    """Free-function spelling: ``with span("jit_compile"): ...``."""
+    return Span(name, metrics=metrics)
+
+
+def capture(logdir, metrics=None):
+    """``jax.profiler.trace`` integration: a context manager starting a
+    profiler capture into ``logdir`` (None = no-op, so call sites need no
+    conditional). When a recorder is given, a ``profiler_capture`` event
+    (with the logdir and the capture's wall seconds) is recorded on exit —
+    the metrics stream then names the trace artifact that
+    ``observability.trace_stats`` can analyze.
+    """
+    if not logdir:
+        return contextlib.nullcontext()
+    return _Capture(str(logdir), metrics)
+
+
+class _Capture:
+    __slots__ = ("logdir", "metrics", "_trace", "_t0")
+
+    def __init__(self, logdir, metrics):
+        self.logdir = logdir
+        self.metrics = metrics
+
+    def __enter__(self):
+        import jax.profiler
+
+        self._trace = jax.profiler.trace(self.logdir)
+        self._trace.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        seconds = time.perf_counter() - self._t0
+        out = self._trace.__exit__(exc_type, exc, tb)
+        if self.metrics is not None:
+            self.metrics.event(
+                "profiler_capture", logdir=self.logdir, seconds=seconds
+            )
+        return out

@@ -171,6 +171,28 @@ def clip_scale(grads_sq_sum, clip_norm):
     return jnp.minimum(1.0, clip_norm / jnp.maximum(norm, 1e-12))
 
 
+def tree_sq_sum(tree, cross_device_sum=None):
+    """Sum of squares over every leaf of a pytree, optionally reduced by
+    ``cross_device_sum`` (a callable, e.g. a psum over the axes the tree is
+    sharded across). The shared input of both the clip factor and the
+    grad-norm telemetry (observability aux outputs), so the two always agree
+    on what "the global norm" means."""
+    import jax
+    import jax.numpy as jnp
+
+    sq = sum(jnp.sum(g * g) for g in jax.tree.leaves(tree))
+    if cross_device_sum is not None:
+        sq = cross_device_sum(sq)
+    return sq
+
+
+def global_norm(tree, cross_device_sum=None):
+    """Global L2 norm over every leaf of a pytree (see ``tree_sq_sum``)."""
+    import jax.numpy as jnp
+
+    return jnp.sqrt(tree_sq_sum(tree, cross_device_sum))
+
+
 def clip_tree(grads, clip_norm, cross_device_sum=None):
     """Scale a gradient pytree by the global-norm clip factor. The local
     sum-of-squares is optionally reduced by ``cross_device_sum`` (a callable,
@@ -178,11 +200,8 @@ def clip_tree(grads, clip_norm, cross_device_sum=None):
     factor is computed — the ONE implementation behind the sequential,
     pipeline and ZeRO-1 paths (which differ only in that reduction)."""
     import jax
-    import jax.numpy as jnp
 
-    sq = sum(jnp.sum(g * g) for g in jax.tree.leaves(grads))
-    if cross_device_sum is not None:
-        sq = cross_device_sum(sq)
+    sq = tree_sq_sum(grads, cross_device_sum)
     s = clip_scale(sq, clip_norm)
     return jax.tree.map(lambda g: g * s, grads)
 

@@ -45,10 +45,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from shallowspeed_tpu import ops
 from shallowspeed_tpu.model import ModelSpec, init_model
+from shallowspeed_tpu.parallel.compat import shard_map
 from shallowspeed_tpu.parallel.lowering import OP_BWD, OP_FWD, TickProgram
 
 
@@ -430,6 +430,7 @@ def make_pipeline_step(
     zero1=False,
     clip_norm=None,
     kernel_backend="xla",
+    with_grad_norm=False,
 ):
     """Build the jitted SPMD step executing one TickProgram over the mesh.
 
@@ -453,6 +454,11 @@ def make_pipeline_step(
     sum is psum'd over ``pp`` (and, under zero1, over ``dp`` where the
     summed gradient lives chunked) — padded entries are exactly zero, so the
     stacked norm equals the logical norm.
+
+    ``with_grad_norm`` (training only): telemetry aux — the step returns a
+    FOURTH output, the pre-clip global gradient norm (replicated scalar,
+    same reduction geometry as the clip's). Pure data flow out of the
+    shard_map, so the fused step program is unchanged in structure.
 
     Inference:
         step(stacked, flags, x) -> preds (global_eval_batch, out_width) P('dp')
@@ -484,6 +490,8 @@ def make_pipeline_step(
     training = prog.is_training
     if training and opt is None:
         raise ValueError("training program needs an optimizer")
+    if with_grad_norm and not training:
+        raise ValueError("with_grad_norm applies to training programs only")
     P_ = mesh.shape["pp"]  # devices on the pp axis
     V = prog.num_chunks  # virtual stages per device
     assert prog.num_stages == P_, "program/mesh device-count mismatch"
@@ -682,6 +690,10 @@ def make_pipeline_step(
             gsh = lax.psum_scatter(
                 jnp.pad(gvec, (0, pad)), "dp", scatter_dimension=0, tiled=True
             )
+            if with_grad_norm:
+                # chunks partition the dp-summed gradient across (dp, pp),
+                # so the pre-clip global norm is one cross-axis reduction
+                gnorm = jnp.sqrt(lax.psum(jnp.sum(gsh * gsh), ("dp", "pp")))
             if clip_norm is not None:
                 from shallowspeed_tpu.optimizer import clip_tree
 
@@ -722,13 +734,22 @@ def make_pipeline_step(
                 n = V * o
                 outb.append(new_vec[off : off + n].reshape(V, o))
                 off += n
-            return {"W": tuple(outW), "b": tuple(outb)}, opt_state, loss
+            new_stacked = {"W": tuple(outW), "b": tuple(outb)}
+            if with_grad_norm:
+                return new_stacked, opt_state, loss, gnorm
+            return new_stacked, opt_state, loss
 
         # the BackwardGradAllReduce anchor: one SUM-psum of the whole gradient
         # pytree over dp per batch (reference pipe.py:302-327)
         gW = lax.psum(carry["gW"], "dp")
         gb = lax.psum(carry["gb"], "dp")
         grads = {"W": gW, "b": gb}  # (V, ...) leaves, mirroring the shards
+        if with_grad_norm:
+            from shallowspeed_tpu.optimizer import global_norm
+
+            # each pp device holds its stages' full (dp-summed) gradient;
+            # padded entries are exactly zero so this IS the logical norm
+            gnorm = global_norm(grads, lambda sq: lax.psum(sq, "pp"))
         if clip_norm is not None:
             from shallowspeed_tpu.optimizer import clip_tree
 
@@ -737,6 +758,8 @@ def make_pipeline_step(
             grads = clip_tree(grads, clip_norm, lambda sq: lax.psum(sq, "pp"))
         local = {"W": stacked["W"], "b": stacked["b"]}
         new_local, opt_state = opt.apply(local, grads, opt_state)
+        if with_grad_norm:
+            return new_local, opt_state, loss, gnorm
         return new_local, opt_state, loss
 
     pp = P("pp")
@@ -778,11 +801,14 @@ def make_pipeline_step(
                 state_struct,
             )
 
+        out_specs = (stacked_specs, state_specs, P())
+        if with_grad_norm:
+            out_specs = out_specs + (P(),)  # replicated pre-clip grad norm
         smapped = shard_map(
             per_device,
             mesh=mesh,
             in_specs=(stacked_specs, flags_specs, state_specs, dp_spec, dp_spec),
-            out_specs=(stacked_specs, state_specs, P()),
+            out_specs=out_specs,
             check_vma=False,
         )
 
@@ -819,6 +845,7 @@ def make_pipeline_epoch(
     zero1=False,
     clip_norm=None,
     kernel_backend="xla",
+    with_grad_norm=False,
 ):
     """Scan the pipeline train step over all batches of an epoch: one XLA
     program per epoch. X: (num_batches, global_batch, in_dim), batch axis
@@ -828,30 +855,48 @@ def make_pipeline_epoch(
     identical numerics); ``zero1`` shards the optimizer update over dp;
     ``clip_norm`` clips the global gradient norm before each update;
     ``kernel_backend`` selects the per-slot compute unit (see
-    make_pipeline_step)."""
+    make_pipeline_step); ``with_grad_norm`` appends a telemetry aux dict
+    ``{"grad_norm": mean pre-clip global grad norm}`` as a fourth output
+    (mirrors trainer.make_train_epoch's aux, so TrainingSession records the
+    same scalars on every layout)."""
     step = make_pipeline_step(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
         tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
-        kernel_backend=kernel_backend,
+        kernel_backend=kernel_backend, with_grad_norm=with_grad_norm,
     )
-    return jax.jit(_make_pipeline_epoch_core(step, unroll), donate_argnums=(0, 2))
+    return jax.jit(
+        _make_pipeline_epoch_core(step, unroll, with_grad_norm),
+        donate_argnums=(0, 2),
+    )
 
 
-def _make_pipeline_epoch_core(step, unroll):
+def _make_pipeline_epoch_core(step, unroll, with_grad_norm=False):
     """The one batch-scan epoch body shared by make_pipeline_epoch and
     make_pipeline_run: ``core(stacked, flags, opt_state, X, Y) ->
-    (stacked, opt_state, mean_loss)``."""
+    (stacked, opt_state, mean_loss)`` — plus an aux dict
+    ``{"grad_norm": mean}`` when ``with_grad_norm``. One scan body serves
+    both arities: the grad-norm slot always rides the carry (zero when the
+    aux is off) and XLA dead-code-eliminates it from the uninstrumented
+    program."""
 
     def epoch_core(stacked, flags, opt_state, X, Y):
         def body(carry, xy):
-            stacked, opt_state, loss_sum = carry
-            stacked, opt_state, loss = step(stacked, flags, opt_state, xy[0], xy[1])
-            return (stacked, opt_state, loss_sum + loss), None
+            stacked, opt_state, loss_sum, gn_sum = carry
+            out = step(stacked, flags, opt_state, xy[0], xy[1])
+            stacked, opt_state, loss = out[0], out[1], out[2]
+            gn = out[3] if with_grad_norm else jnp.zeros(())
+            return (stacked, opt_state, loss_sum + loss, gn_sum + gn), None
 
-        (stacked, opt_state, loss_sum), _ = lax.scan(
-            body, (stacked, opt_state, jnp.zeros(())), (X, Y), unroll=unroll
+        (stacked, opt_state, loss_sum, gn_sum), _ = lax.scan(
+            body,
+            (stacked, opt_state, jnp.zeros(()), jnp.zeros(())),
+            (X, Y),
+            unroll=unroll,
         )
-        return stacked, opt_state, loss_sum / X.shape[0]
+        nb = X.shape[0]
+        if with_grad_norm:
+            return stacked, opt_state, loss_sum / nb, {"grad_norm": gn_sum / nb}
+        return stacked, opt_state, loss_sum / nb
 
     return epoch_core
 

@@ -109,6 +109,42 @@ def utilization(prog):
     return active / (prog.num_ticks * prog.num_stages)
 
 
+def program_stats(prog):
+    """Static per-program telemetry: everything a metrics consumer needs to
+    reason about a lowered schedule without replaying it — tick count, send
+    volume, mailbox/stash footprints, per-device occupancy and the bubble
+    fraction. Computed from the ACTUAL tick tables at lowering time (the
+    executor's runtime per-tick behaviour is fully determined by them), so
+    recording this once per program is the per-tick story with zero runtime
+    cost. All values are plain Python scalars/lists — JSON-serializable as-is
+    (the observability JSONL sink emits this dict verbatim)."""
+    cells = prog.num_ticks * prog.num_stages
+    util = utilization(prog)
+    # per-device occupancy: the fraction of ticks each pp device computes —
+    # the per-row view of the pebble diagram (ramp devices idle longest)
+    occupancy = [
+        float(np.sum(prog.op[:, s] != OP_NOOP) / prog.num_ticks)
+        for s in range(prog.num_stages)
+    ]
+    return {
+        "num_ticks": int(prog.num_ticks),
+        "num_stages": int(prog.num_stages),
+        "num_micro_batches": int(prog.num_micro_batches),
+        "num_chunks": int(prog.num_chunks),
+        "is_training": bool(prog.is_training),
+        "active_cells": int(np.sum(prog.op != OP_NOOP)),
+        "total_cells": int(cells),
+        "sends_fwd": int(np.sum(prog.send_fwd)),
+        "sends_bwd": int(np.sum(prog.send_bwd)),
+        "fwd_mail_slots": int(prog.n_fwd_slots),
+        "bwd_mail_slots": int(prog.n_bwd_slots),
+        "stash_slots": int(prog.n_stash_slots),
+        "stage_occupancy": occupancy,
+        "utilization": float(util),
+        "bubble_fraction": float(1.0 - util),
+    }
+
+
 def parse_stage_stream(commands, stage_id, num_stages, training=True, num_chunks=1):
     """Flatten one device's instruction stream into WorkItems + validate.
 

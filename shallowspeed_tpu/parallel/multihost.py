@@ -34,6 +34,22 @@ tested surface is the executor itself.
 import jax
 
 
+def _distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` with a fallback for jax 0.4.x,
+    where the predicate doesn't exist yet: the distributed client handle on
+    ``jax._src.distributed.global_state`` (not re-exported at
+    ``jax.distributed`` on those versions) is the same signal that function
+    reads."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    try:
+        from jax._src.distributed import global_state
+    except ImportError:  # pragma: no cover - neither API: assume fresh
+        return False
+    return getattr(global_state, "client", None) is not None
+
+
 def initialize(coordinator_address=None, num_processes=None, process_id=None):
     """Join the global JAX runtime; must run BEFORE any other JAX call that
     initializes a backend (jax.devices(), first jit, ...). No-op when the
@@ -46,7 +62,7 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
     """
     # NOTE: deliberately no jax.devices()/process_count() probe here — those
     # initialize the XLA backend and would make distributed init impossible.
-    if jax.distributed.is_initialized():
+    if _distributed_is_initialized():
         return
     kwargs = {}
     if coordinator_address is not None:

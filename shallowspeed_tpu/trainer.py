@@ -28,12 +28,15 @@ from shallowspeed_tpu.model import ModelSpec, model_backward, model_forward
 
 def _make_batch_step(
     spec: ModelSpec, opt, precision, fuse_mubatches=False, clip_norm=None,
-    megakernel=False,
+    megakernel=False, with_grad_norm=False,
 ):
     """The shared per-batch body: microbatch gradient accumulation + optimizer
     apply. Used by both the per-batch step and the epoch scan.
     ``clip_norm``: optional global-norm gradient clipping (over ALL params)
     applied to the accumulated batch gradient before the optimizer.
+    ``with_grad_norm``: also return the PRE-clip global gradient norm as a
+    fourth output — an aux scalar for training telemetry (it rides the scan
+    as data flow, never a host callback, so jit fusion is untouched).
 
     ``fuse_mubatches=True`` computes the whole batch in ONE forward/backward
     instead of scanning microbatches. This is the same training computation:
@@ -55,6 +58,11 @@ def _make_batch_step(
     roofline) and one op per batch is the shortest possible serial chain.
     """
     if megakernel:
+        if with_grad_norm:
+            raise ValueError(
+                "with_grad_norm is unavailable on the kernel paths: the "
+                "gradient never leaves the Pallas kernel's VMEM"
+            )
         sspec = _validate_megakernel(spec, opt, fuse_mubatches)
 
         def mega_step(params, opt_state, xb, yb):
@@ -75,9 +83,22 @@ def _make_batch_step(
 
         return clip_tree(grads, clip_norm)
 
+    def finish(params, opt_state, grads, loss):
+        """Shared tail: (optional) pre-clip norm aux, clip, apply."""
+        if with_grad_norm:
+            from shallowspeed_tpu.optimizer import global_norm
+
+            gnorm = global_norm(grads)
+            params, opt_state = opt.apply(params, clipped(grads), opt_state)
+            return params, opt_state, loss, gnorm
+        params, opt_state = opt.apply(params, clipped(grads), opt_state)
+        return params, opt_state, loss
+
     def batch_step(params, opt_state, xb, yb):
         """Returns (params, opt_state, batch_loss) — the loss is the global-
-        batch-scaled MSE of the batch under the pre-update params."""
+        batch-scaled MSE of the batch under the pre-update params. With
+        ``with_grad_norm`` a fourth output carries the pre-clip global
+        gradient norm."""
         if fuse_mubatches:
             rows = xb.shape[1]
             x = xb.reshape(-1, xb.shape[-1])
@@ -89,8 +110,7 @@ def _make_batch_step(
                 params, spec, res, y, precision=precision, head_group_rows=rows
             )
             loss = ops.mse_loss(out, y, spec.global_batch_size)
-            params, opt_state = opt.apply(params, clipped(grads), opt_state)
-            return params, opt_state, loss
+            return finish(params, opt_state, grads, loss)
 
         def accumulate(carry, mxy):
             acc, loss = carry
@@ -104,8 +124,7 @@ def _make_batch_step(
         (grads, loss), _ = lax.scan(
             accumulate, (zeros, jnp.zeros(())), (xb, yb)
         )
-        params, opt_state = opt.apply(params, clipped(grads), opt_state)
-        return params, opt_state, loss
+        return finish(params, opt_state, grads, loss)
 
     return batch_step
 
@@ -263,6 +282,7 @@ def make_train_epoch(
     clip_norm=None,
     megakernel=False,
     epoch_kernel=False,
+    with_grad_norm=False,
 ):
     """Whole-epoch scan: ``epoch(params, opt_state, X, Y) -> (params,
     opt_state, mean_loss)`` with X: (num_batches, M, mubatch, in_dim). One
@@ -277,35 +297,58 @@ def make_train_epoch(
     batch). ``epoch_kernel``: run the ENTIRE epoch as one Pallas kernel
     (the batch axis is the kernel grid, params stay VMEM-resident — see
     _make_epoch_kernel_core; identical numerics, one op per epoch).
+    ``with_grad_norm``: telemetry aux — the epoch returns a FOURTH output,
+    an aux dict ``{"grad_norm": mean pre-clip global grad norm}``. The aux
+    is an ordinary scan output (data flow, not a host callback), so the
+    epoch stays one fused XLA program; unavailable on the kernel paths
+    (the gradient never leaves VMEM there).
     """
     if epoch_kernel:
         if megakernel:
             raise ValueError("megakernel and epoch_kernel are exclusive")
+        if with_grad_norm:
+            raise ValueError(
+                "with_grad_norm is unavailable on the kernel paths: the "
+                "gradient never leaves the Pallas kernel's VMEM"
+            )
         epoch_core = _make_epoch_kernel_core(
             spec, opt, precision, fuse_mubatches, clip_norm
         )
     else:
         batch_step = _make_batch_step(
-            spec, opt, precision, fuse_mubatches, clip_norm, megakernel
+            spec, opt, precision, fuse_mubatches, clip_norm, megakernel,
+            with_grad_norm,
         )
-        epoch_core = _make_epoch_core(batch_step, unroll)
+        epoch_core = _make_epoch_core(batch_step, unroll, with_grad_norm)
     return jax.jit(epoch_core, donate_argnums=(0, 1))
 
 
-def _make_epoch_core(batch_step, unroll):
+def _make_epoch_core(batch_step, unroll, with_grad_norm=False):
     """The one epoch-scan body shared by make_train_epoch and make_train_run:
-    ``core(params, opt_state, X, Y) -> (params, opt_state, mean_loss)``."""
+    ``core(params, opt_state, X, Y) -> (params, opt_state, mean_loss)`` —
+    plus an aux dict ``{"grad_norm": mean}`` when ``with_grad_norm``. One
+    scan body serves both arities: the grad-norm slot always rides the
+    carry (zero when the aux is off) and XLA dead-code-eliminates it from
+    the uninstrumented program."""
 
     def epoch_core(params, opt_state, X, Y):
         def body(carry, xy):
-            params, opt_state, loss_sum = carry
-            params, opt_state, loss = batch_step(params, opt_state, *xy)
-            return (params, opt_state, loss_sum + loss), None
+            params, opt_state, loss_sum, gn_sum = carry
+            out = batch_step(params, opt_state, *xy)
+            params, opt_state, loss = out[0], out[1], out[2]
+            gn = out[3] if with_grad_norm else jnp.zeros(())
+            return (params, opt_state, loss_sum + loss, gn_sum + gn), None
 
-        (params, opt_state, loss_sum), _ = lax.scan(
-            body, (params, opt_state, jnp.zeros(())), (X, Y), unroll=unroll
+        (params, opt_state, loss_sum, gn_sum), _ = lax.scan(
+            body,
+            (params, opt_state, jnp.zeros(()), jnp.zeros(())),
+            (X, Y),
+            unroll=unroll,
         )
-        return params, opt_state, loss_sum / X.shape[0]
+        nb = X.shape[0]
+        if with_grad_norm:
+            return params, opt_state, loss_sum / nb, {"grad_norm": gn_sum / nb}
+        return params, opt_state, loss_sum / nb
 
     return epoch_core
 
@@ -321,6 +364,7 @@ def make_train_run(
     megakernel=False,
     epoch_kernel=False,
     run_kernel=False,
+    with_grad_norm=False,
 ):
     """Whole-RUN scan: every epoch (and its validation accuracy) in ONE program.
 
@@ -349,7 +393,17 @@ def make_train_run(
     reference's whole outermost loop). Bit-identical to looping the epoch
     kernel. Per-epoch eval needs per-epoch params, so the evaluated run
     keeps the epochs-outer scan.
+
+    ``with_grad_norm=True`` (telemetry aux, scan paths only): the run
+    returns one EXTRA trailing output, an aux dict whose ``"grad_norm"``
+    is the (n_epochs,) vector of per-epoch mean pre-clip global gradient
+    norms — ordinary scan outputs, so the run stays one fused program.
     """
+    if with_grad_norm and (megakernel or epoch_kernel or run_kernel):
+        raise ValueError(
+            "with_grad_norm is unavailable on the kernel paths: the "
+            "gradient never leaves the Pallas kernel's VMEM"
+        )
     if run_kernel:
         if megakernel or epoch_kernel:
             raise ValueError(
@@ -389,27 +443,39 @@ def make_train_run(
         )
     else:
         batch_step = _make_batch_step(
-            spec, opt, precision, fuse_mubatches, clip_norm, megakernel
+            spec, opt, precision, fuse_mubatches, clip_norm, megakernel,
+            with_grad_norm,
         )
-        epoch_core = _make_epoch_core(batch_step, unroll)
+        epoch_core = _make_epoch_core(batch_step, unroll, with_grad_norm)
+
+    def run_epoch(params, opt_state, X, Y):
+        """Uniform (params, opt_state, loss, gnorm) view of the epoch core
+        (gnorm 0 when the aux is off — dropped again before returning)."""
+        if with_grad_norm:
+            params, opt_state, mean_loss, aux = epoch_core(params, opt_state, X, Y)
+            return params, opt_state, mean_loss, aux["grad_norm"]
+        params, opt_state, mean_loss = epoch_core(params, opt_state, X, Y)
+        return params, opt_state, mean_loss, jnp.zeros(())
 
     if with_eval:
 
         @partial(jax.jit, static_argnums=(6,), donate_argnums=(0, 1))
         def run(params, opt_state, X, Y, vx, vy, n_epochs):
             def epoch_body(carry, _):
-                params, opt_state, mean_loss = epoch_core(*carry, X, Y)
+                params, opt_state, mean_loss, gn = run_epoch(*carry, X, Y)
                 preds, _ = model_forward(params, spec, vx, precision=precision)
                 acc = jnp.mean(
                     (jnp.argmax(preds, axis=1) == jnp.argmax(vy, axis=1)).astype(
                         jnp.float32
                     )
                 )
-                return (params, opt_state), (mean_loss, acc)
+                return (params, opt_state), (mean_loss, acc, gn)
 
-            (params, opt_state), (losses, accs) = lax.scan(
+            (params, opt_state), (losses, accs, gns) = lax.scan(
                 epoch_body, (params, opt_state), None, length=n_epochs
             )
+            if with_grad_norm:
+                return params, opt_state, losses, accs, {"grad_norm": gns}
             return params, opt_state, losses, accs
 
     else:
@@ -417,12 +483,14 @@ def make_train_run(
         @partial(jax.jit, static_argnums=(4,), donate_argnums=(0, 1))
         def run(params, opt_state, X, Y, n_epochs):
             def epoch_body(carry, _):
-                params, opt_state, mean_loss = epoch_core(*carry, X, Y)
-                return (params, opt_state), mean_loss
+                params, opt_state, mean_loss, gn = run_epoch(*carry, X, Y)
+                return (params, opt_state), (mean_loss, gn)
 
-            (params, opt_state), losses = lax.scan(
+            (params, opt_state), (losses, gns) = lax.scan(
                 epoch_body, (params, opt_state), None, length=n_epochs
             )
+            if with_grad_norm:
+                return params, opt_state, losses, {"grad_norm": gns}
             return params, opt_state, losses
 
     return run

@@ -47,7 +47,9 @@ def assert_dp_replicas_in_sync(arr) -> None:
         by_index = {}
         for shard in x.addressable_shards:
             h = sha1(np.ascontiguousarray(shard.data).tobytes()).hexdigest()
-            prev = by_index.setdefault(shard.index, h)
+            # key by the index's string form: shard.index is a tuple of
+            # slice objects, which are unhashable on Python < 3.12
+            prev = by_index.setdefault(str(shard.index), h)
             if prev != h:
                 mismatches.append((shard.device, shard.index))
 

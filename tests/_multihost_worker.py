@@ -62,8 +62,10 @@ def main():
     )
 
     import numpy as np
-    from jax import lax, shard_map
+    from jax import lax
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from shallowspeed_tpu.parallel.compat import shard_map
 
     from shallowspeed_tpu import model as Mo
     from shallowspeed_tpu import schedules as S

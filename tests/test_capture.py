@@ -510,6 +510,21 @@ def test_capture_resume_skips_captured_phases(tmp_path, monkeypatch, capture_mod
     # the LATE-completed trace was invalidated and re-measured fresh
     assert result["trace"] == {"n_files": 1}
 
+    # second --resume, now against the BANKED artifact: run 1 renamed the
+    # .partial into CAP.json, so resume must load the final artifact too
+    # (ADVICE r05 — previously only <out>.partial was consulted and a banked
+    # capture was re-measured from scratch and overwritten)
+    assert out.is_file() and not (tmp_path / "CAP.json.partial").is_file()
+    calls.clear()
+    tc.main()
+    result2 = json.loads(out.read_text())
+    # every phase captured in run 1 was loaded from the banked artifact,
+    # not re-measured (convergence ran in run 1; it must not run again)
+    assert "convergence" not in calls
+    assert "headline_sweep" not in calls
+    assert result2["convergence"] == {"epochs": 5}
+    assert result2["headline_best_sps"] == 800.0
+
 
 def test_resume_ignores_corrupt_and_mismatched_artifacts(tmp_path, capture_mod):
     """A truncated .partial (killed mid-checkpoint) or one captured under a

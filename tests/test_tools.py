@@ -319,6 +319,12 @@ def test_bench_publishes_before_spending_tunnel_patience(monkeypatch, capsys):
     assert len(pre_lines) == 2
     assert pre_lines[0]["preliminary"] is True and pre_lines[0]["value"] is None
     assert "stub" in pre_lines[0]["tunnel"]["state"]
+    # ADVICE r05: the stub is machine-readably a stub — a neutral
+    # _STUB_NOT_MEASURED tag (the tunnel was never probed at that point,
+    # so no _CPU_FALLBACK_TUNNEL_UNRESPONSIVE claim) plus "stub": true
+    assert pre_lines[0]["stub"] is True
+    assert pre_lines[0]["metric"].endswith("_STUB_NOT_MEASURED")
+    assert "UNRESPONSIVE" not in pre_lines[0]["metric"]
     assert pre_lines[1]["preliminary"] is True and pre_lines[1]["value"] == 5e4
     # the final (last) line is the authoritative record with diagnostics
     post_lines = [
@@ -370,7 +376,9 @@ def test_bench_healthy_probe_upgrades_to_chip_record(monkeypatch, capsys):
     ]
     assert len(lines) == 4  # stub, preliminary, interim, final
     assert lines[0]["preliminary"] and lines[0]["value"] is None
+    assert lines[0]["stub"] is True and "_STUB_NOT_MEASURED" in lines[0]["metric"]
     assert lines[1]["preliminary"] and "UNRESPONSIVE" in lines[1]["metric"]
+    assert "stub" not in lines[1]  # only the phase-0 line is a stub
     assert lines[2]["preliminary"] and "WEDGED_MIDRUN" in lines[2]["metric"]
     assert lines[2]["tunnel"]["probes"][0]["outcome"] == "ok"
     final = lines[-1]

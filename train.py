@@ -100,6 +100,14 @@ def main():
         help="write a jax.profiler trace of one training epoch to this directory",
     )
     ap.add_argument(
+        "--metrics-out",
+        default=None,
+        help="record structured training telemetry (per-epoch loss, "
+        "samples/s, grad-norm when clipping, compile/lowering spans, "
+        "pipeline program stats) to this JSONL file — see "
+        "docs/observability.md for the schema",
+    )
+    ap.add_argument(
         "--fuse-mubatches",
         action="store_true",
         help="sequential path only: one full-batch forward/backward per step "
@@ -179,8 +187,11 @@ def main():
     import jax
 
     from shallowspeed_tpu.api import TrainingSession
+    from shallowspeed_tpu.observability import JsonlMetrics, capture
 
+    metrics = JsonlMetrics(args.metrics_out) if args.metrics_out else None
     run = TrainingSession(
+        metrics=metrics,
         dp=args.dp,
         pp=args.pp,
         schedule=args.schedule,
@@ -219,9 +230,11 @@ def main():
     )
 
     def profiled(i):
-        # trace one post-compile epoch when asked
+        # trace one post-compile epoch when asked (observability.capture =
+        # jax.profiler.trace + a profiler_capture record in the metrics
+        # stream naming the trace artifact)
         if args.profile_dir and i == min(1, args.epochs - 1):
-            return jax.profiler.trace(args.profile_dir)
+            return capture(args.profile_dir, metrics)
         return contextlib.nullcontext()
 
     t0 = time.time()
@@ -239,11 +252,7 @@ def main():
             # AOT-compile first so the trace holds steady-state execution,
             # not compilation (mirrors the loop mode's post-compile trace)
             run.warm_run(args.epochs, with_eval=not args.no_eval)
-        with (
-            jax.profiler.trace(args.profile_dir)
-            if args.profile_dir
-            else contextlib.nullcontext()
-        ):
+        with capture(args.profile_dir, metrics):
             losses, accs = run.train_run(args.epochs, with_eval=not args.no_eval)
         for e, loss in enumerate(losses):
             print(f"Epoch: {start + e}, mean train loss: {loss:.5f}")
@@ -273,6 +282,9 @@ def main():
     if args.dp > 1:
         print("DP replicas in sync ✓")
     print("final model hash:", run.model_hash())
+    if metrics is not None:
+        metrics.close()
+        print(f"telemetry written: {args.metrics_out}")
 
 
 if __name__ == "__main__":
