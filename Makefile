@@ -6,8 +6,8 @@ CPU_MESH = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # verify needs bash (pipefail / PIPESTATUS)
 SHELL := /bin/bash
 
-.PHONY: test verify metrics-smoke data train train-mesh bench bench-scaling \
-        schedules clean
+.PHONY: test verify metrics-smoke report-smoke data train train-mesh bench \
+        bench-scaling schedules clean
 
 test:
 	python -m pytest tests/ -q
@@ -23,6 +23,17 @@ metrics-smoke:
 	rm -f /tmp/metrics.jsonl
 	$(CPU_MESH) python train.py --epochs 1 --no-eval --metrics-out /tmp/metrics.jsonl
 	python -c "import json; lines = [json.loads(l) for l in open('/tmp/metrics.jsonl') if l.strip()]; assert lines, 'metrics file is empty'; assert any(r.get('kind') == 'event' and r.get('name') == 'epoch' for r in lines), 'no per-epoch record'; print(f'metrics-smoke OK: {len(lines)} valid JSONL records')"
+
+# run-report end-to-end smoke: 1 CPU epoch with telemetry + health
+# recording, then render the run report (throughput, MFU, span breakdown,
+# step-loss sparkline, health verdict) — a nonzero report exit fails the
+# target, which is the CI gate contract (needs data, like metrics-smoke)
+report-smoke:
+	rm -f /tmp/report_smoke.jsonl
+	$(CPU_MESH) python train.py --epochs 1 --no-eval --health record \
+	    --metrics-out /tmp/report_smoke.jsonl
+	python -m shallowspeed_tpu.observability.report /tmp/report_smoke.jsonl \
+	    --format md
 
 data:
 	python prepare_data.py

@@ -161,8 +161,12 @@ N_SAMPLES = 59392  # MNIST train size after drop-last to 128-multiples
 
 
 def flops_per_sample():
-    """~FLOPs per training sample: fwd 2P + bwd 4P for P = sum(in*out)."""
-    return 6 * sum(SIZES[i] * SIZES[i + 1] for i in range(len(SIZES) - 1))
+    """~FLOPs per training sample: fwd 2P + bwd 4P for P = sum(in*out).
+    Delegates to the observability cost model so the benchmark, the MFU
+    gauges and the run reports can never disagree on the definition."""
+    from shallowspeed_tpu.observability.costmodel import mlp_train_flops_per_sample
+
+    return mlp_train_flops_per_sample(SIZES)
 
 
 def sync_readback(tree):
@@ -910,6 +914,11 @@ def build_record(
     - per-cell provenance fields (value_backend, same_window): a
       same_window=false pair's RATIO is untrustworthy even when both
       values are;
+    - MFU companions (``mfu``, ``mfu_fp32_highest``): each cell's model-
+      FLOP utilization against ITS backend's per-chip peak
+      (observability/costmodel.py), with the peak and its source recorded
+      alongside — an MFU computed against the nominal CPU default is
+      self-describing, never mistakable for a datasheet number;
     - ``tunnel``: probe diagnostics (per-probe outcome/seconds, failure
       mode) embedded in the record itself so a fallback round is
       self-describing; ``preliminary``: marks the phase-1 record printed
@@ -966,11 +975,31 @@ def build_record(
             f"headline {value:,.0f} samples/s exceeds 2x the whole-run "
             f"wall-clock cross-check ({crosscheck:,.0f}); tagging metric"
         )
+    def _mfu(v, cell_meta, precision):
+        """(mfu, peak, source) for one cell against its OWN backend's
+        per-chip peak; (None, None, reason) when no peak is known."""
+        if v is None:
+            return None, None, None
+        from shallowspeed_tpu.observability.costmodel import peak_flops_per_chip
+
+        peak, source = peak_flops_per_chip(
+            cell_meta.get("backend") or "unknown", precision
+        )
+        if not peak:
+            return None, None, source
+        return round(v * flops_per_sample() / peak, 6), peak, source
+
+    mfu, mfu_peak, mfu_src = _mfu(value, meta.get("default", {}), "default")
+    mfu32, _, _ = _mfu(value_fp32, meta.get("highest", {}), "highest")
     record = {
         "metric": metric,
         "value": None if value is None else round(value, 1),
         "unit": "samples/s",
         "vs_baseline": None if value is None else round(value / baseline, 2),
+        "mfu": mfu,
+        "mfu_fp32_highest": mfu32,
+        "mfu_peak_flops": mfu_peak,
+        "mfu_peak_source": mfu_src,
         "config": "fused+default_precision (bf16-input MXU, fp32 accum; "
         "convergence-verified vs fp32 recipe)",
         "value_fp32_highest": (

@@ -30,7 +30,9 @@ from shallowspeed_tpu import schedules as S
 from shallowspeed_tpu import trainer, utils
 from shallowspeed_tpu.checkpoint import load_checkpoint, save_checkpoint
 from shallowspeed_tpu.data import Dataset, default_data_dir
-from shallowspeed_tpu.observability import NullMetrics
+from shallowspeed_tpu.observability import NullMetrics, costmodel
+from shallowspeed_tpu.observability.flight import FlightRecorder
+from shallowspeed_tpu.observability.health import make_monitor
 from shallowspeed_tpu.optimizer import (
     is_stateless,
     join_state,
@@ -39,7 +41,7 @@ from shallowspeed_tpu.optimizer import (
 )
 from shallowspeed_tpu.parallel import executor as E
 from shallowspeed_tpu.parallel import lower_schedule, make_mesh
-from shallowspeed_tpu.parallel.lowering import program_stats
+from shallowspeed_tpu.parallel.lowering import program_flops, program_stats
 
 # The reference's canonical training configuration (train.py:56-59,98,107) —
 # the single source of truth for every benchmark script in this repo.
@@ -86,12 +88,22 @@ class TrainingSession:
         run_kernel=False,
         kernel_backend="xla",
         metrics=None,
+        health=None,
+        record_steps=None,
     ):
         # telemetry hook (observability package): None -> the zero-overhead
         # null backend. Everything the session emits — construction spans,
-        # jit-compile spans, per-epoch training records, pipeline program
-        # stats — flows through this one recorder (docs/observability.md).
+        # jit-compile spans, per-epoch training records, per-step flight
+        # records, MFU gauges, pipeline program stats — flows through this
+        # one recorder (docs/observability.md).
         self._metrics = metrics if metrics is not None else NullMetrics()
+        # numerics health monitor: None, a policy string ("record" / "warn"
+        # / "halt"), or a HealthMonitor instance (observability/health.py).
+        # Checks run on host against the fused per-step aux after each
+        # epoch's readback; under "halt" a finding raises HealthError AFTER
+        # the epoch's update has been applied (the monitor observes the
+        # fused program's outputs, it cannot unwind them).
+        self._health = make_monitor(health)
         if global_batch_size % dp != 0:
             raise ValueError("global batch size must be divisible by dp")
         local_batch = global_batch_size // dp
@@ -104,6 +116,7 @@ class TrainingSession:
             raise ValueError(
                 f"precision must be one of {sorted(PRECISIONS)}, got {precision!r}"
             )
+        self._precision_name = precision  # the MFU peak is precision-classed
         if schedule not in S.SCHEDULES:
             raise ValueError(
                 f"schedule must be one of {sorted(S.SCHEDULES)}, got {schedule!r}"
@@ -259,17 +272,37 @@ class TrainingSession:
         # telemetry aux: when recording AND clipping, the epoch/run programs
         # also return the pre-clip global gradient norm (ordinary fused
         # outputs — never host callbacks inside the scan). The kernel paths
-        # keep gradients in VMEM, so the aux is unavailable there; the mesh
-        # fused run (make_pipeline_run) doesn't thread it either.
-        aux_gnorm = (
-            self._metrics.enabled
-            and clip_norm is not None
-            and not (megakernel or epoch_kernel or run_kernel)
-        )
+        # keep gradients in VMEM, so the aux is unavailable there; both
+        # layouts' fused runs thread it (trainer.make_train_run and
+        # executor.make_pipeline_run).
+        kernel_path = megakernel or epoch_kernel or run_kernel
+        aux_gnorm = self._metrics.enabled and clip_norm is not None and not kernel_path
         self._epoch_aux = aux_gnorm
-        self._run_aux = aux_gnorm and self._sequential
+        self._run_aux = aux_gnorm
+        # flight-recorder aux: per-step (per-batch) loss / pre-clip grad
+        # norm / post-update param norm vectors out of the SAME fused epoch
+        # program. ``record_steps=None`` (default) auto-enables whenever
+        # anything will consume them (a metrics recorder or a health
+        # monitor); ``False`` opts a metrics session back out (epoch-level
+        # telemetry only — the PR1 cost profile: no per-step param-norm in
+        # the program, no per-step JSONL lines; health falls back to
+        # epoch-granular checks); ``True`` forces the flight ring on even
+        # without a recorder. The NullMetrics default without a monitor
+        # keeps the uninstrumented program, so recording disabled stays
+        # zero-overhead on the hot path.
+        if record_steps is None:
+            record_steps = self._metrics.enabled or self._health is not None
+        elif record_steps and kernel_path:
+            raise ValueError(
+                "record_steps is unavailable on the kernel paths: the "
+                "gradient never leaves the Pallas kernel's VMEM"
+            )
+        self._step_aux = bool(record_steps) and not kernel_path
+        self.flight = FlightRecorder() if self._step_aux else None
         self._epoch_compiled = False  # compile-span already recorded?
         self._epoch_dispatched = False  # first train_epoch includes compile
+        self._cost_recorded = False  # cost_model event already emitted?
+        self._cost_xla_recorded = False  # ... with the XLA cross-check leg?
 
         if self._sequential:
             with self._metrics.span("device_put"):
@@ -294,6 +327,7 @@ class TrainingSession:
                 clip_norm=clip_norm, megakernel=megakernel,
                 epoch_kernel=epoch_kernel or run_kernel,
                 with_grad_norm=self._epoch_aux,
+                with_step_stats=self._step_aux,
             )
             self._predict = trainer.make_predict(self.spec, precision=self.precision)
             self._run_kwargs = dict(
@@ -358,6 +392,7 @@ class TrainingSession:
                 unroll=scan_unroll, tick_unroll=tick_unroll,
                 clip_norm=clip_norm, kernel_backend=kernel_backend,
                 with_grad_norm=self._epoch_aux,
+                with_step_stats=self._step_aux,
             )
             self._prog = prog
             self._mubatch_local = local_batch // mubatches
@@ -367,6 +402,29 @@ class TrainingSession:
                 clip_norm=clip_norm, kernel_backend=kernel_backend,
             )
             self._eval_step = None  # built lazily, sized to the val split
+
+        # analytical cost model + MFU accounting (observability/costmodel):
+        # the model-FLOP numerator is known at construction; the XLA
+        # cost_analysis cross-check attaches at jit time
+        # (_ensure_epoch_compiled / warm_run). On mesh layouts the padded
+        # hardware FLOPs come from the lowered tick tables
+        # (lowering.program_flops), so the padding tax is recorded per
+        # layout, not guessed.
+        if self._sequential:
+            platform = jax.devices()[0].platform
+            padded = None
+        else:
+            platform = self.mesh.devices.flat[0].platform
+            padded = program_flops(self._prog, self.spec, self._mubatch_local) * dp
+        self._cost_model = costmodel.CostModel(
+            sizes=self.spec.sizes,
+            global_batch=self.B,
+            batches_per_epoch=self.batches_per_epoch,
+            n_devices=1 if self._sequential else dp * pp,
+            platform=platform,
+            precision=self._precision_name,
+            padded_flops_per_batch=padded,
+        )
 
     # -- training -----------------------------------------------------------
 
@@ -392,9 +450,64 @@ class TrainingSession:
         if not self._metrics.enabled or self._epoch_compiled:
             return
         with self._metrics.span("jit_compile"):
-            self._epoch_fn.lower(*self._epoch_args()).compile()
+            compiled = self._epoch_fn.lower(*self._epoch_args()).compile()
         self._metrics.counter("jit_compiles")
         self._epoch_compiled = True
+        # cost-model cross-check at jit time: pull the compiled epoch
+        # program's XLA-reported FLOPs/bytes next to the analytical count
+        self._cost_model.attach_compiled(compiled)
+        self._record_cost_model()
+
+    def _record_cost_model(self):
+        """Emit the cost_model event + model_flops gauge. Emitted once per
+        session — except that a record written BEFORE the XLA cross-check
+        attached (a warm_run-first session) is re-emitted once the compiled
+        epoch program's cost_analysis exists, so the flops_ratio signal is
+        never silently lost (consumers keep the last event)."""
+        if not self._metrics.enabled:
+            return
+        has_xla = self._cost_model.xla_flops_per_epoch is not None
+        if self._cost_recorded and (self._cost_xla_recorded or not has_xla):
+            return
+        self._metrics.event("cost_model", **self._cost_model.as_record())
+        self._metrics.gauge("model_flops", self._cost_model.flops_per_epoch)
+        self._cost_recorded = True
+        self._cost_xla_recorded = has_xla
+
+    def _record_utilization(self, samples_per_sec):
+        """Per-dispatch MFU accounting: achieved model-FLOP/s and MFU
+        gauges (docs/observability.md). Returns the MFU (None when no peak
+        is known for this platform)."""
+        self._metrics.gauge(
+            "achieved_flops_per_sec",
+            self._cost_model.achieved_flops_per_sec(samples_per_sec),
+        )
+        mfu = self._cost_model.mfu(samples_per_sec)
+        if mfu is not None:
+            self._metrics.gauge("mfu", mfu)
+        return mfu
+
+    def _record_flight(self, epoch_index, aux):
+        """Host side of the step-level flight recorder: read the fused
+        per-step aux back (one readback per epoch, after the dispatch),
+        ring-buffer it, stream schema-v2 ``step`` records, and run the
+        numerics health checks (which may raise HealthError under
+        policy='halt' — after this epoch's update was applied)."""
+        losses = np.asarray(aux["step_loss"], np.float64)
+        gns = np.asarray(aux["step_grad_norm"], np.float64)
+        pns = np.asarray(aux["step_param_norm"], np.float64)
+        first = self.flight.total_steps  # the ring owns the global numbering
+        samples = self.flight.record_epoch(
+            epoch_index, losses, gns, pns, first_step=first
+        )
+        if self._metrics.enabled:
+            for s in samples:
+                self._metrics.step("train", **s)
+        if self._health is not None:
+            findings = self._health.check_epoch(
+                epoch_index, losses, gns, pns, first_step=first
+            )
+            self._health.dispatch(findings, self._metrics)
 
     def train_epoch(self) -> float:
         """One epoch over the training shard; returns the mean batch training
@@ -409,6 +522,7 @@ class TrainingSession:
         includes compilation and must not be read as steady-state."""
         first_dispatch = self._metrics.enabled and not self._epoch_dispatched
         self._ensure_epoch_compiled()
+        epoch_index = self.epoch
         t0 = time.perf_counter()
         with self._metrics.span("train_epoch"):
             out = self._epoch_fn(*self._epoch_args())
@@ -417,20 +531,28 @@ class TrainingSession:
             else:
                 self._stacked, self._opt_state, mean_loss = out[0], out[1], out[2]
             loss = float(mean_loss)  # forces device completion
+        aux = out[3] if (self._epoch_aux or self._step_aux) else None
         if self._metrics.enabled:
             wall = time.perf_counter() - t0
             samples = self.batches_per_epoch * self.B
+            sps = samples / wall if wall > 0 else 0.0
             record = dict(
-                epoch=self.epoch,
+                epoch=epoch_index,
                 loss=loss,
-                samples_per_sec=samples / wall if wall > 0 else 0.0,
+                samples_per_sec=sps,
                 wall_s=wall,
             )
             if self._epoch_aux:
-                record["grad_norm"] = float(out[3]["grad_norm"])
+                record["grad_norm"] = float(aux["grad_norm"])
             if first_dispatch:
                 # the jit call cache was cold: this wall includes compile
                 record["includes_compile"] = True
+            mfu = self._record_utilization(sps)
+            if mfu is not None:
+                # stamped on the record too, so per-epoch MFU survives the
+                # gauge's last-value-wins semantics (the first record's MFU
+                # inherits its includes_compile caveat)
+                record["mfu"] = mfu
             self._metrics.event("epoch", **record)
             if not first_dispatch:  # steady-state only, per the histogram's use
                 self._metrics.observe("epoch.seconds", wall)
@@ -438,6 +560,17 @@ class TrainingSession:
             self._metrics.counter("samples_trained", samples)
         self._epoch_dispatched = True
         self.epoch += 1
+        # flight recording + health checks LAST: session state is already
+        # consistent when a 'halt' policy raises out of here
+        if self._step_aux:
+            self._record_flight(epoch_index, aux)
+        elif self._health is not None:
+            # no per-step aux (kernel paths can't thread it — gradients
+            # never leave VMEM — or record_steps=False opted out): fall
+            # back to epoch-granular loss checks
+            self._health.dispatch(
+                self._health.check_epoch(epoch_index, [loss]), self._metrics
+            )
         return loss
 
     def train_run(self, epochs: int, with_eval: bool = True):
@@ -486,13 +619,14 @@ class TrainingSession:
             self._stacked = state
         self._opt_state = opt_state
         self.epoch += epochs
+        gns = None if aux is None else np.asarray(aux["grad_norm"])
         if self._metrics.enabled:
             wall = time.perf_counter() - t0
             samples = self.batches_per_epoch * self.B
             # one fused dispatch -> per-epoch wall clocks don't exist; the
             # run-mean samples/s is attributed to every epoch record
             sps = epochs * samples / wall if wall > 0 else 0.0
-            gns = None if aux is None else np.asarray(aux["grad_norm"])
+            mfu = self._record_utilization(sps)
             for e, loss in enumerate(losses):
                 record = dict(
                     epoch=start + e,
@@ -505,10 +639,19 @@ class TrainingSession:
                     record["accuracy"] = accs_f[e]
                 if gns is not None:
                     record["grad_norm"] = float(gns[e])
+                if mfu is not None:
+                    record["mfu"] = mfu
                 self._metrics.event("epoch", **record)
             self._metrics.observe("run.seconds", wall)
             self._metrics.counter("epochs_trained", epochs)
             self._metrics.counter("samples_trained", epochs * samples)
+        if self._health is not None:
+            # the fused run returns in one dispatch: epoch-granular checks
+            # (per-epoch mean loss + mean grad norm when threaded)
+            findings = self._health.check_run(
+                start, losses, None if gns is None else [float(v) for v in gns]
+            )
+            self._health.dispatch(findings, self._metrics)
         return losses, accs_f
 
     def warm_run(self, epochs: int, with_eval: bool = True):
@@ -531,6 +674,10 @@ class TrainingSession:
                     .compile()
                 )
             self._metrics.counter("jit_compiles")
+            # fused-run-only sessions still get the cost_model event (the
+            # analytical leg; the XLA cross-check stays tied to the EPOCH
+            # program so its per-epoch FLOPs aren't diluted by fused eval)
+            self._record_cost_model()
 
     def _fused_run_fn(self, with_eval):
         """Build (once per with_eval) the layout's fused whole-run program."""
@@ -558,7 +705,8 @@ class TrainingSession:
                     )
                 self._run_fns[with_eval] = E.make_pipeline_run(
                     self.mesh, self.spec, self._prog, self._mubatch_local,
-                    self._opt, **self._run_kwargs, **eval_kwargs,
+                    self._opt, with_grad_norm=self._run_aux,
+                    **self._run_kwargs, **eval_kwargs,
                 )
         return self._run_fns[with_eval]
 

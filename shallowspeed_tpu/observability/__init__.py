@@ -18,15 +18,38 @@ evidence trail instead of prose:
                    ``jax.profiler.trace``;
 - ``trace_stats``  the chrome-trace analyzer behind docs/performance.md's
                    roofline numbers (promoted from scripts/ to an importable,
-                   tested module; the script remains as a thin shim).
+                   tested module; the script remains as a thin shim);
+- ``flight``       the step-level flight recorder: a bounded ring buffer of
+                   per-step (loss, grad-norm, param-norm) samples, fed by
+                   the fused epoch programs' aux outputs (never host
+                   callbacks inside the scan) and emitted as schema-v2
+                   ``step`` records;
+- ``health``       the numerics health monitor: NaN/Inf, rolling-window
+                   loss-divergence and grad-spike checks over the flight
+                   aux, with a record/warn/halt policy
+                   (``TrainingSession(health=...)``, ``train.py --health``);
+- ``costmodel``    analytical MLP FLOPs + ``Compiled.cost_analysis()``
+                   cross-check + MFU accounting (``model_flops``,
+                   ``achieved_flops_per_sec``, ``mfu`` gauges per layout);
+- ``report``       the run-report CLI
+                   (``python -m shallowspeed_tpu.observability.report``):
+                   throughput, MFU, span breakdown, bubble fraction,
+                   step-loss sparkline, health verdict, and a
+                   ``--baseline`` regression gate for CI/bench.
 
 Wiring: ``TrainingSession(metrics=JsonlMetrics(path))`` records per-epoch
-training telemetry (loss, samples/s, grad-norm when clipping), compile-time
-spans, and — on mesh layouts — the lowered pipeline program's static tick
-stats (ticks, sends, stage occupancy, bubble fraction). The CLI flag is
-``train.py --metrics-out FILE``. See docs/observability.md.
+training telemetry (loss, samples/s, grad-norm when clipping), per-step
+flight records, MFU gauges, compile-time spans, and — on mesh layouts — the
+lowered pipeline program's static tick stats (ticks, sends, stage occupancy,
+bubble fraction). The CLI flags are ``train.py --metrics-out FILE`` and
+``--health record|warn|halt``. See docs/observability.md.
 """
 
+from shallowspeed_tpu.observability.flight import FlightRecorder
+from shallowspeed_tpu.observability.health import (
+    HealthError,
+    HealthMonitor,
+)
 from shallowspeed_tpu.observability.metrics import (
     SCHEMA_VERSION,
     JsonlMetrics,
@@ -38,6 +61,9 @@ from shallowspeed_tpu.observability.spans import Span, capture, span
 
 __all__ = [
     "SCHEMA_VERSION",
+    "FlightRecorder",
+    "HealthError",
+    "HealthMonitor",
     "JsonlMetrics",
     "MetricsRecorder",
     "NullMetrics",

@@ -1,0 +1,197 @@
+"""Analytical cost model + XLA cost_analysis cross-check + MFU accounting.
+
+"As fast as the hardware allows" is a ratio, and this module owns both of
+its legs:
+
+- the NUMERATOR is model FLOPs — the analytical count of useful training
+  arithmetic (fwd ``2P`` + bwd ``4P`` per sample for ``P = sum(in*out)``,
+  the standard MLP ledger; ``bench.flops_per_sample`` delegates here so the
+  benchmark and the telemetry can never disagree on it). For pipeline
+  layouts the PADDED hardware FLOPs (what the stacked-slot executor
+  actually multiplies, computed from the lowered tick tables) are tracked
+  alongside, so the padding tax is a recorded number, not folklore;
+- the XLA leg: ``Compiled.cost_analysis()`` FLOPs/bytes pulled from the
+  jit-compiled epoch program. The analytical count is CROSS-CHECKED against
+  it (``flops_ratio``) — if the two diverge wildly, either the analytical
+  model or the lowering regressed, and a consumer can see which epoch
+  program to distrust;
+- the DENOMINATOR is peak FLOP/s: per-chip datasheet numbers for the TPU
+  precision classes (matching bench.py's physical-plausibility ceilings), a
+  clearly-labeled NOMINAL figure for host CPU (there is no single honest
+  CPU peak; the source tag says so), or the ``SHALLOWSPEED_PEAK_FLOPS`` env
+  override for any other hardware. Every MFU record carries the peak AND
+  its source, so a number computed against the nominal CPU default cannot
+  be misread as a datasheet MFU.
+
+``MFU = samples_per_sec * model_flops_per_sample / (peak_per_chip * chips)``
+— model FLOPs in the numerator (the Chowdhery et al. PaLM convention), so
+padding and recomputation make MFU WORSE, never better.
+"""
+
+import os
+
+# Per-chip peak model FLOP/s by (platform, matmul-precision class). The TPU
+# rows are the same v5e-class ceilings bench.py's plausibility guard uses
+# (fp32-accumulate fp32-input ~100 TF/s, bf16-input MXU passes ~200 TF/s).
+# The CPU row is a NOMINAL single-socket figure (order 100 GFLOP/s fp32) —
+# labeled as such in the source tag; override with SHALLOWSPEED_PEAK_FLOPS.
+PEAK_FLOPS_PER_CHIP = {
+    ("tpu", "highest"): 100e12,
+    ("tpu", "default"): 200e12,
+    ("cpu", "highest"): 2e11,
+    ("cpu", "default"): 2e11,
+}
+
+ENV_PEAK = "SHALLOWSPEED_PEAK_FLOPS"
+
+
+def mlp_train_flops_per_sample(sizes):
+    """Analytical training FLOPs per sample: fwd 2P + bwd 4P (dgrad 2P +
+    wgrad 2P) for P = sum(in*out) — bias adds, relu and the softmax head
+    are O(width) noise against the O(width^2) matmuls and are not counted.
+    The single source of truth (bench.flops_per_sample delegates here)."""
+    sizes = tuple(sizes)
+    return 6 * sum(sizes[i] * sizes[i + 1] for i in range(len(sizes) - 1))
+
+
+def peak_flops_per_chip(platform, precision="highest"):
+    """-> ``(peak_flops, source)`` for one chip; ``(None, source)`` when the
+    platform is unknown. ``platform`` accepts jax device platform strings
+    ('tpu', 'axon' — the tunnel's TPU — or 'cpu')."""
+    env = os.environ.get(ENV_PEAK)
+    if env:
+        return float(env), f"env:{ENV_PEAK}"
+    plat = "tpu" if platform in ("tpu", "axon") else platform
+    key = (plat, precision)
+    if key not in PEAK_FLOPS_PER_CHIP:
+        return None, f"unknown-platform:{platform}"
+    source = "datasheet-v5e" if plat == "tpu" else "nominal-cpu-default"
+    return PEAK_FLOPS_PER_CHIP[key], source
+
+
+def compiled_flops(compiled):
+    """Pull ``(flops, bytes_accessed)`` from a jax ``Compiled``'s
+    ``cost_analysis()`` across jax versions (dict in newer jax, a one-dict
+    list in 0.4.x; either field may be absent — e.g. some backends report
+    no bytes). Returns ``(None, None)`` when the backend offers nothing:
+    cost analysis is a cross-check, never a hard dependency."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — backend-optional surface
+        return None, None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None, None
+
+    def _get(key):
+        v = ca.get(key)
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            return None
+        return v if v > 0 else None
+
+    return _get("flops"), _get("bytes accessed")
+
+
+class CostModel:
+    """One session's FLOP ledger: analytical model FLOPs, optional padded
+    pipeline FLOPs, the XLA-compiled cross-check, and the MFU peak."""
+
+    def __init__(
+        self,
+        sizes,
+        global_batch,
+        batches_per_epoch,
+        n_devices=1,
+        platform="cpu",
+        precision="highest",
+        padded_flops_per_batch=None,
+    ):
+        self.sizes = tuple(sizes)
+        self.global_batch = int(global_batch)
+        self.batches_per_epoch = int(batches_per_epoch)
+        self.n_devices = int(n_devices)
+        self.platform = platform
+        self.precision = precision
+        self.flops_per_sample = mlp_train_flops_per_sample(sizes)
+        self.flops_per_batch = self.flops_per_sample * self.global_batch
+        self.flops_per_epoch = self.flops_per_batch * self.batches_per_epoch
+        # hardware work actually dispatched per batch on padded-stack
+        # layouts (lowering.program_flops x dp); None on the sequential path
+        # where logical == padded
+        self.padded_flops_per_batch = (
+            None if padded_flops_per_batch is None else float(padded_flops_per_batch)
+        )
+        self.peak_flops_per_chip, self.peak_source = peak_flops_per_chip(
+            platform, precision
+        )
+        self.xla_flops_per_epoch = None
+        self.xla_bytes_per_epoch = None
+
+    def attach_compiled(self, compiled):
+        """Record the compiled epoch program's cost_analysis numbers;
+        returns True when the backend reported FLOPs."""
+        flops, nbytes = compiled_flops(compiled)
+        if flops is not None:
+            self.xla_flops_per_epoch = flops
+        if nbytes is not None:
+            self.xla_bytes_per_epoch = nbytes
+        return flops is not None
+
+    @property
+    def flops_ratio(self):
+        """XLA-reported / analytical epoch FLOPs (the cross-check); None
+        until a compiled program has been attached. This is a STRUCTURAL
+        cross-check, not an equality: XLA's cost analysis counts each
+        ``lax.scan`` body once regardless of trip count (observed on the
+        CPU and TPU backends), so a whole-epoch program's ratio lands
+        around ``1 / (batches x microbatches)``, padded pipeline layouts
+        land higher by the padding tax, and a sudden order-of-magnitude
+        MOVE of the ratio for the same layout is what flags a lowering or
+        analytical-model regression. Recorded, never asserted blindly."""
+        if self.xla_flops_per_epoch is None or self.flops_per_epoch <= 0:
+            return None
+        return self.xla_flops_per_epoch / self.flops_per_epoch
+
+    @property
+    def padded_ratio(self):
+        """Padded / logical FLOPs per batch (the pipeline padding tax)."""
+        if self.padded_flops_per_batch is None or self.flops_per_batch <= 0:
+            return None
+        return self.padded_flops_per_batch / self.flops_per_batch
+
+    def achieved_flops_per_sec(self, samples_per_sec):
+        """Model-FLOP throughput at an observed samples/s."""
+        return samples_per_sec * self.flops_per_sample
+
+    def mfu(self, samples_per_sec):
+        """Model FLOP utilization against the layout's total peak (peak per
+        chip x participating devices); None when no peak is known."""
+        if not self.peak_flops_per_chip or samples_per_sec is None:
+            return None
+        total_peak = self.peak_flops_per_chip * max(1, self.n_devices)
+        return self.achieved_flops_per_sec(samples_per_sec) / total_peak
+
+    def as_record(self):
+        """JSON-able snapshot — the ``cost_model`` event's field set."""
+        rec = {
+            "flops_per_sample": self.flops_per_sample,
+            "flops_per_batch": self.flops_per_batch,
+            "flops_per_epoch": self.flops_per_epoch,
+            "batches_per_epoch": self.batches_per_epoch,
+            "global_batch": self.global_batch,
+            "n_devices": self.n_devices,
+            "platform": self.platform,
+            "precision": self.precision,
+            "peak_flops_per_chip": self.peak_flops_per_chip,
+            "peak_source": self.peak_source,
+            "xla_flops_per_epoch": self.xla_flops_per_epoch,
+            "xla_bytes_per_epoch": self.xla_bytes_per_epoch,
+            "flops_ratio": self.flops_ratio,
+        }
+        if self.padded_flops_per_batch is not None:
+            rec["padded_flops_per_batch"] = self.padded_flops_per_batch
+            rec["padded_ratio"] = self.padded_ratio
+        return rec

@@ -23,27 +23,47 @@ Three recorders share one surface:
 
 Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
 
-    {"v": 1, "ts": ..., "kind": "meta",      "name": "metrics",
+    {"v": 2, "ts": ..., "kind": "meta",      "name": "metrics",
      "schema": "shallowspeed_tpu.metrics", "created": "..."}
-    {"v": 1, "ts": ..., "kind": "counter",   "name": ..., "value": total,
+    {"v": 2, "ts": ..., "kind": "counter",   "name": ..., "value": total,
      "inc": delta}
-    {"v": 1, "ts": ..., "kind": "gauge",     "name": ..., "value": ...}
-    {"v": 1, "ts": ..., "kind": "histogram", "name": ..., "value": sample}
-    {"v": 1, "ts": ..., "kind": "timer",     "name": ..., "seconds": ...}
-    {"v": 1, "ts": ..., "kind": "span",      "name": ..., "path": "a/b",
+    {"v": 2, "ts": ..., "kind": "gauge",     "name": ..., "value": ...}
+    {"v": 2, "ts": ..., "kind": "histogram", "name": ..., "value": sample}
+    {"v": 2, "ts": ..., "kind": "timer",     "name": ..., "seconds": ...}
+    {"v": 2, "ts": ..., "kind": "span",      "name": ..., "path": "a/b",
      "depth": n, "seconds": ...}
-    {"v": 1, "ts": ..., "kind": "event",     "name": ..., **fields}
+    {"v": 2, "ts": ..., "kind": "event",     "name": ..., **fields}
+    {"v": 2, "ts": ..., "kind": "step",      "name": ..., "step": i,
+     "epoch": e, "loss": ..., "grad_norm": ..., "param_norm": ...}   [v2+]
+    {"v": 2, "ts": ..., "kind": "health",    "name": <check>, "epoch": e,
+     "step": i|null, "action": "record"|"warn"|"halt", **finding}    [v2+]
+
+Schema compatibility rules (SCHEMA_VERSION history):
+
+- v1  initial schema: meta/counter/gauge/histogram/timer/span/event.
+- v2  ADDITIVE: the ``step`` (flight-recorder per-step sample) and
+  ``health`` (numerics-monitor finding) kinds. No v1 kind or field
+  changed meaning, so a v2 READER accepts v1 files unchanged (and the
+  ``read_jsonl`` strict check is one-directional: it refuses records
+  NEWER than the reader, never older). A v1 reader fed a v2 file will
+  refuse it loudly — that is the point of the stamp.
+
+The contract for future bumps: additive kinds/fields bump the version and
+must keep old records readable; any change to an EXISTING kind's meaning
+requires a new kind name instead. Consumers must ignore unknown fields on
+known kinds.
 
 The span taxonomy and the metric names the framework itself emits are
 documented in docs/observability.md.
 """
 
 import json
+import math
 import time
 
 from shallowspeed_tpu.observability.spans import Span
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 SCHEMA_NAME = "shallowspeed_tpu.metrics"
 
 
@@ -88,6 +108,12 @@ class NullMetrics:
     def event(self, name, **fields):
         pass
 
+    def step(self, name, **fields):
+        pass
+
+    def health(self, name, **fields):
+        pass
+
     def flush(self):
         pass
 
@@ -109,7 +135,13 @@ class MetricsRecorder:
                    ``jax.profiler.TraceAnnotation`` labeling profiler
                    captures; emits a span record with its nesting path;
     - ``event``    a free-form named record (arbitrary JSON-able fields) —
-                   the shape the per-epoch training telemetry uses.
+                   the shape the per-epoch training telemetry uses;
+    - ``step``     one flight-recorder per-step sample (schema v2): free
+                   fields like ``event`` under its own kind so step-level
+                   streams are filterable without name conventions;
+    - ``health``   one numerics-monitor finding (schema v2), named by the
+                   check that fired (``non_finite``/``loss_divergence``/
+                   ``grad_spike``).
     """
 
     enabled = True
@@ -143,6 +175,12 @@ class MetricsRecorder:
 
     def event(self, name, **fields):
         self._emit({"kind": "event", "name": name, **fields})
+
+    def step(self, name, **fields):
+        self._emit({"kind": "step", "name": name, **fields})
+
+    def health(self, name, **fields):
+        self._emit({"kind": "health", "name": name, **fields})
 
     # -- recorder-internal hooks --------------------------------------------
 
@@ -214,6 +252,26 @@ class _Timer:
         return False
 
 
+def _json_safe(value):
+    """Strict-JSON sanitizer: non-finite floats become the strings "NaN" /
+    "Infinity" / "-Infinity" (recursively through dicts/lists). The step and
+    health records exist precisely to carry blow-up evidence, and bare NaN
+    tokens from ``json.dumps``'s default ``allow_nan=True`` would make
+    exactly those lines unparseable to any strict-JSON consumer (jq on the
+    live ``tail -f`` dashboard, non-Python ingests) — the one-JSON-object-
+    per-line contract must hold hardest on the records that matter most.
+    Consumers treat the strings as non-finite (the report does)."""
+    if isinstance(value, float) and not math.isfinite(value):
+        if math.isnan(value):
+            return "NaN"
+        return "Infinity" if value > 0 else "-Infinity"
+    if isinstance(value, dict):
+        return {k: _json_safe(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_json_safe(v) for v in value]
+    return value
+
+
 class JsonlMetrics(MetricsRecorder):
     """MetricsRecorder with a versioned append-only JSONL sink.
 
@@ -246,7 +304,11 @@ class JsonlMetrics(MetricsRecorder):
         if self._f is None:
             raise ValueError(f"JsonlMetrics({self.path!r}) is closed")
         self._f.write(
-            json.dumps({"v": SCHEMA_VERSION, "ts": time.time(), **record}) + "\n"
+            json.dumps(
+                _json_safe({"v": SCHEMA_VERSION, "ts": time.time(), **record}),
+                allow_nan=False,  # enforced: every line is STRICT JSON
+            )
+            + "\n"
         )
         self._since_flush += 1
         if self._since_flush >= self._flush_every:

@@ -1,0 +1,461 @@
+"""Run report generator: render a metrics JSONL into a human/CI report.
+
+    python -m shallowspeed_tpu.observability.report run.jsonl \
+        [--baseline other.jsonl|BENCH.json] [--format md|text|json] \
+        [--threshold 0.10]
+
+Reads a schema-v1 or -v2 metrics stream (``read_jsonl`` — a v2 reader
+accepts v1 files; see metrics.py's compatibility rules) and reports what a
+human or a bench gate actually asks of a run:
+
+- steady-state training throughput (epoch records flagged
+  ``includes_compile`` are excluded — their wall clock is compile, not
+  training; if ONLY such records exist the report says so rather than
+  silently quoting a compile-polluted number);
+- MFU + achieved FLOP/s and the cost-model cross-check (analytical vs
+  XLA-reported FLOPs), with the peak's provenance so a nominal-CPU MFU
+  cannot pass for a datasheet one;
+- the span breakdown (where the host-side wall time went);
+- the pipeline program's bubble fraction (mesh layouts);
+- a step-loss sparkline from the flight-recorder ``step`` records;
+- the numerics health verdict (ok / N findings / halted-at-step).
+
+``--baseline`` compares throughput against another run's JSONL or a
+bench-style JSON record (``{"value": ..., "unit": "samples/s"}``, or a
+tpu_capture artifact's ``headline_best_sps``). A regression beyond
+``--threshold`` (default 10%) exits **2** — the CI/bench gate contract;
+malformed inputs exit 1; a clean report exits 0.
+"""
+
+import argparse
+import json
+import math
+import sys
+from pathlib import Path
+
+from shallowspeed_tpu.observability.metrics import read_jsonl
+
+BLOCKS = "▁▂▃▄▅▆▇█"  # ▁▂▃▄▅▆▇█
+
+
+def _finite(v):
+    return isinstance(v, (int, float)) and math.isfinite(v)
+
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    if n == 0:
+        return None
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def sparkline(values, width=60):
+    """Unicode sparkline, mean-pooled down to ``width`` buckets; non-finite
+    samples render as ``x`` (a blown-up step must be visible, not blank)."""
+    values = list(values)
+    if not values:
+        return ""
+    if len(values) > width:
+        # mean-pool each bucket; a bucket with any non-finite sample is x
+        buckets = []
+        for b in range(width):
+            lo = b * len(values) // width
+            hi = max(lo + 1, (b + 1) * len(values) // width)
+            chunk = values[lo:hi]
+            buckets.append(
+                sum(chunk) / len(chunk) if all(_finite(v) for v in chunk)
+                else float("nan")
+            )
+        values = buckets
+    finite = [v for v in values if _finite(v)]
+    if not finite:
+        return "x" * len(values)
+    vmin, vmax = min(finite), max(finite)
+    span = vmax - vmin
+    out = []
+    for v in values:
+        if not _finite(v):
+            out.append("x")
+        elif span <= 0:
+            out.append(BLOCKS[3])
+        else:
+            out.append(BLOCKS[int((v - vmin) / span * (len(BLOCKS) - 1))])
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# report assembly
+# ---------------------------------------------------------------------------
+
+
+def build_report(records, source=""):
+    """Fold a record stream into the JSON-able report dict every renderer
+    (and the baseline comparison) consumes."""
+    epochs = [
+        r for r in records if r.get("kind") == "event" and r.get("name") == "epoch"
+    ]
+    steady = [r for r in epochs if not r.get("includes_compile")]
+    pool = steady or epochs
+    sps = [r["samples_per_sec"] for r in pool if _finite(r.get("samples_per_sec"))]
+    throughput = _median(sps)
+
+    gauges = {}
+    for r in records:
+        if r.get("kind") == "gauge":
+            gauges[r.get("name")] = r.get("value")  # last value wins
+
+    spans = {}
+    for r in records:
+        if r.get("kind") == "span" and _finite(r.get("seconds")):
+            agg = spans.setdefault(r.get("name"), {"count": 0, "total_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += r["seconds"]
+    span_rows = sorted(
+        (
+            {"name": n, "count": a["count"], "total_s": round(a["total_s"], 4)}
+            for n, a in spans.items()
+        ),
+        key=lambda row: -row["total_s"],
+    )
+
+    steps = [r for r in records if r.get("kind") == "step"]
+    step_losses = [r.get("loss") for r in steps]
+    finite_losses = [v for v in step_losses if _finite(v)]
+
+    cost = None
+    for r in records:
+        if r.get("kind") == "event" and r.get("name") == "cost_model":
+            cost = {
+                k: v for k, v in r.items() if k not in ("v", "ts", "kind", "name")
+            }
+
+    prog = None
+    for r in records:
+        if r.get("kind") == "event" and r.get("name") == "pipeline_program":
+            prog = r
+    bubble = (
+        prog.get("bubble_fraction") if prog else gauges.get("pipeline.bubble_fraction")
+    )
+
+    findings = [r for r in records if r.get("kind") == "health"]
+    halted = [f for f in findings if f.get("action") == "halt"]
+    by_check = {}
+    for f in findings:
+        by_check[f.get("name")] = by_check.get(f.get("name"), 0) + 1
+    if halted:
+        f = halted[0]
+        where = f"epoch {f.get('epoch')}"
+        if f.get("step") is not None:
+            where += f", step {f.get('step')}"
+        verdict = f"HALTED: {f.get('name')} at {where}"
+    elif findings:
+        verdict = f"{len(findings)} finding(s): " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(by_check.items())
+        )
+    else:
+        verdict = "ok"
+
+    # MFU: prefer the last steady epoch record's own field (per-epoch
+    # truth), fall back to the last gauge; when only compile-polluted
+    # records exist the MFU inherits their caveat (rendered alongside)
+    mfu = None
+    for r in pool:
+        if _finite(r.get("mfu")):
+            mfu = r["mfu"]
+    if mfu is None and _finite(gauges.get("mfu")):
+        mfu = gauges["mfu"]
+    mfu_includes_compile = mfu is not None and bool(epochs) and not steady
+
+    last_epoch = epochs[-1] if epochs else {}
+    accuracy = last_epoch.get("accuracy")
+    if accuracy is None:
+        accuracy = gauges.get("val_accuracy")
+
+    return {
+        "source": source,
+        "schema_versions": sorted({r.get("v", 0) for r in records}),
+        "epochs": len(epochs),
+        "steady_epochs": len(steady),
+        "throughput_samples_per_sec": throughput,
+        "throughput_includes_compile": bool(epochs) and not steady,
+        "final_loss": last_epoch.get("loss"),
+        "final_accuracy": accuracy,
+        "mfu": mfu,
+        "mfu_includes_compile": mfu_includes_compile,
+        "achieved_flops_per_sec": gauges.get("achieved_flops_per_sec"),
+        "cost_model": cost,
+        "bubble_fraction": bubble,
+        "spans": span_rows,
+        "steps": len(steps),
+        "step_loss_sparkline": sparkline(step_losses) if steps else None,
+        "step_loss": (
+            {
+                "first": step_losses[0],
+                "last": step_losses[-1],
+                "min": min(finite_losses) if finite_losses else None,
+                "max": max(finite_losses) if finite_losses else None,
+                "non_finite": len(step_losses) - len(finite_losses),
+            }
+            if steps
+            else None
+        ),
+        "health": {
+            "verdict": verdict,
+            "findings": len(findings),
+            "by_check": by_check,
+            "halted": bool(halted),
+        },
+    }
+
+
+def baseline_throughput(path):
+    """-> ``(samples_per_sec, label)`` from a baseline file, or ``(None,
+    reason)``. ``.jsonl`` is another metrics stream (same steady-state
+    rules); ``.json`` accepts a bench record (``value`` + samples/s unit)
+    or a tpu_capture artifact (``headline_best_sps``)."""
+    p = Path(path)
+    if p.suffix == ".jsonl":
+        base = build_report(read_jsonl(p), source=str(p))
+        tp = base["throughput_samples_per_sec"]
+        if tp is None:
+            return None, f"{p}: no epoch throughput records"
+        if base["throughput_includes_compile"]:
+            # refusing beats silently trusting an understated baseline: a
+            # compile-polluted baseline would let real regressions pass
+            return None, (
+                f"{p}: only compile-polluted throughput records (no "
+                "steady-state epoch) — not usable as a regression baseline"
+            )
+        return tp, f"{p} (median steady-state)"
+    data = json.loads(p.read_text())
+    if isinstance(data, dict):
+        if _finite(data.get("value")) and data.get("unit") == "samples/s":
+            return float(data["value"]), f"{p} ({data.get('metric', 'value')})"
+        if _finite(data.get("headline_best_sps")):
+            return float(data["headline_best_sps"]), f"{p} (headline_best_sps)"
+        if _finite(data.get("samples_per_sec")):
+            return float(data["samples_per_sec"]), f"{p} (samples_per_sec)"
+    return None, f"{p}: no recognizable throughput field"
+
+
+def compare(report, base_tp, base_label, threshold):
+    """Throughput-vs-baseline verdict; ``regression`` drives the exit
+    code. Positive ``delta_fraction`` = faster than baseline. A run whose
+    only throughput records include compile time (a 1-epoch job) is NOT
+    gated — compile wall clock vs a steady-state baseline would flag a
+    spurious regression on every short run; the comparison is still
+    rendered, marked ``compile_polluted``."""
+    cur = report["throughput_samples_per_sec"]
+    delta = (cur - base_tp) / base_tp if base_tp else None
+    polluted = bool(report["throughput_includes_compile"])
+    return {
+        "baseline": base_label,
+        "baseline_samples_per_sec": base_tp,
+        "delta_fraction": delta,
+        "threshold": threshold,
+        "compile_polluted": polluted,
+        "regression": not polluted and delta is not None and delta < -threshold,
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt_num(v, unit="", pct=False):
+    if v is None:
+        return "n/a"
+    if not isinstance(v, (int, float)) or not math.isfinite(v):
+        return str(v)  # the sink's sanitized non-finite markers ("NaN", ...)
+    if pct:
+        return f"{v * 100:.2f}%"
+    if abs(v) >= 1e9:
+        return f"{v / 1e9:,.2f} G{unit}"
+    if abs(v) >= 1e6:
+        return f"{v / 1e6:,.2f} M{unit}"
+    return f"{v:,.2f} {unit}".rstrip()
+
+
+def _rows(report):
+    tp = report["throughput_samples_per_sec"]
+    rows = [
+        ("epochs recorded", str(report["epochs"])),
+        (
+            "throughput",
+            _fmt_num(tp, "samples/s")
+            + (
+                "  (includes compile — no steady-state epoch recorded)"
+                if report["throughput_includes_compile"]
+                else ""
+            ),
+        ),
+        (
+            "MFU",
+            _fmt_num(report["mfu"], pct=True)
+            + (
+                "  (includes compile)"
+                if report.get("mfu_includes_compile")
+                else ""
+            ),
+        ),
+        ("achieved FLOP/s", _fmt_num(report["achieved_flops_per_sec"], "FLOP/s")),
+        ("final loss", _fmt_num(report["final_loss"])),
+    ]
+    if report["final_accuracy"] is not None:
+        rows.append(("final accuracy", _fmt_num(report["final_accuracy"], pct=True)))
+    if report["bubble_fraction"] is not None:
+        rows.append(("pipeline bubble", _fmt_num(report["bubble_fraction"], pct=True)))
+    rows.append(("health", report["health"]["verdict"]))
+    return rows
+
+
+def _cost_lines(cost):
+    if not cost:
+        return ["cost model: not recorded"]
+    lines = [
+        f"cost model: {_fmt_num(cost.get('flops_per_sample'), 'FLOP')}/sample "
+        f"analytical; peak {_fmt_num(cost.get('peak_flops_per_chip'), 'FLOP/s')}"
+        f"/chip x {cost.get('n_devices')} ({cost.get('peak_source')})"
+    ]
+    ratio = cost.get("flops_ratio")
+    if ratio is not None:
+        lines.append(
+            f"  XLA cross-check: {_fmt_num(cost.get('xla_flops_per_epoch'), 'FLOP')}"
+            f"/epoch compiled = {ratio:.3g}x analytical (scan bodies counted "
+            "once by XLA's analysis — watch for MOVES, not 1.0)"
+        )
+    if cost.get("padded_ratio") is not None:
+        lines.append(f"  padding tax: {cost['padded_ratio']:.2f}x logical FLOPs")
+    return lines
+
+
+def render(report, fmt, comparison=None):
+    if fmt == "json":
+        out = dict(report)
+        if comparison is not None:
+            out["baseline_comparison"] = comparison
+        return json.dumps(out, indent=2)
+    md = fmt == "md"
+    lines = []
+    title = f"Run report: {report['source']}"
+    lines.append(f"# {title}" if md else title)
+    lines.append("")
+    if md:
+        lines.append("| metric | value |")
+        lines.append("|---|---|")
+        lines.extend(f"| {k} | {v} |" for k, v in _rows(report))
+    else:
+        width = max(len(k) for k, _ in _rows(report))
+        lines.extend(f"{k.ljust(width)}  {v}" for k, v in _rows(report))
+    lines.append("")
+    lines.extend(_cost_lines(report["cost_model"]))
+    lines.append("")
+    header = "## Span breakdown" if md else "span breakdown:"
+    lines.append(header)
+    if report["spans"]:
+        for row in report["spans"]:
+            lines.append(
+                f"- {row['name']}: {row['total_s']:.3f}s over {row['count']} span(s)"
+            )
+    else:
+        lines.append("- (no spans recorded)")
+    lines.append("")
+    if report["step_loss_sparkline"]:
+        sl = report["step_loss"]
+        lines.append("## Step loss" if md else "step loss:")
+        lines.append(
+            f"{report['steps']} steps, first {_fmt_num(sl['first'])} -> "
+            f"last {_fmt_num(sl['last'])}"
+            + (f", {sl['non_finite']} NON-FINITE" if sl["non_finite"] else "")
+        )
+        lines.append(report["step_loss_sparkline"])
+        lines.append("")
+    if comparison is not None:
+        lines.append("## Baseline" if md else "baseline:")
+        delta = comparison["delta_fraction"]
+        if comparison.get("compile_polluted"):
+            verdict = (
+                "regression gate SKIPPED — this run's only throughput "
+                "records include compile time"
+            )
+        elif comparison["regression"]:
+            verdict = (
+                f"REGRESSION beyond {comparison['threshold'] * 100:.0f}% threshold"
+            )
+        else:
+            verdict = f"within {comparison['threshold'] * 100:.0f}% threshold"
+        lines.append(
+            f"vs {comparison['baseline']}: "
+            f"{_fmt_num(comparison['baseline_samples_per_sec'], 'samples/s')} "
+            f"baseline, {'+' if delta is not None and delta >= 0 else ''}"
+            f"{_fmt_num(delta, pct=True)} ({verdict})"
+        )
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m shallowspeed_tpu.observability.report",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("run", help="metrics JSONL of the run to report on")
+    ap.add_argument(
+        "--baseline",
+        default=None,
+        help="metrics JSONL or bench/capture JSON to compare throughput "
+        "against (regression beyond --threshold exits 2)",
+    )
+    ap.add_argument("--format", choices=("md", "text", "json"), default="md")
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.10,
+        help="relative throughput-regression gate (default 0.10 = 10%%)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        records = read_jsonl(args.run)
+    except (OSError, ValueError) as e:
+        print(f"report: cannot read {args.run}: {e}", file=sys.stderr)
+        return 1
+    report = build_report(records, source=args.run)
+    comparison = None
+    if args.baseline:
+        try:
+            base_tp, label = baseline_throughput(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"report: cannot read baseline {args.baseline}: {e}", file=sys.stderr)
+            return 1
+        if base_tp is None:
+            print(f"report: {label}", file=sys.stderr)
+            return 1
+        if report["throughput_samples_per_sec"] is None:
+            print(
+                f"report: {args.run} has no throughput records to compare",
+                file=sys.stderr,
+            )
+            return 1
+        comparison = compare(report, base_tp, label, args.threshold)
+    print(render(report, args.format, comparison))
+    if comparison is not None and comparison.get("compile_polluted"):
+        print(
+            "report: regression gate skipped — no steady-state epoch record "
+            "(this run's throughput includes compile time)",
+            file=sys.stderr,
+        )
+    if comparison is not None and comparison["regression"]:
+        print(
+            f"report: THROUGHPUT REGRESSION beyond {args.threshold * 100:.0f}% "
+            f"({comparison['delta_fraction'] * 100:.1f}% vs baseline)",
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -431,6 +431,7 @@ def make_pipeline_step(
     clip_norm=None,
     kernel_backend="xla",
     with_grad_norm=False,
+    with_step_stats=False,
 ):
     """Build the jitted SPMD step executing one TickProgram over the mesh.
 
@@ -459,6 +460,13 @@ def make_pipeline_step(
     FOURTH output, the pre-clip global gradient norm (replicated scalar,
     same reduction geometry as the clip's). Pure data flow out of the
     shard_map, so the fused step program is unchanged in structure.
+
+    ``with_step_stats`` (training only; implies the grad-norm output): the
+    flight-recorder aux — a FIFTH output, the post-update global parameter
+    norm (replicated scalar; padded entries are exactly zero, so the
+    stacked norm IS the logical norm, psum'd over ``pp``). Together with
+    the per-step loss these are the scalars the numerics health monitor
+    checks on host after each epoch's single readback.
 
     Inference:
         step(stacked, flags, x) -> preds (global_eval_batch, out_width) P('dp')
@@ -490,8 +498,12 @@ def make_pipeline_step(
     training = prog.is_training
     if training and opt is None:
         raise ValueError("training program needs an optimizer")
-    if with_grad_norm and not training:
-        raise ValueError("with_grad_norm applies to training programs only")
+    if (with_grad_norm or with_step_stats) and not training:
+        raise ValueError(
+            "with_grad_norm/with_step_stats apply to training programs only"
+        )
+    if with_step_stats:
+        with_grad_norm = True  # step stats carry the grad norm per step
     P_ = mesh.shape["pp"]  # devices on the pp axis
     V = prog.num_chunks  # virtual stages per device
     assert prog.num_stages == P_, "program/mesh device-count mismatch"
@@ -735,9 +747,16 @@ def make_pipeline_step(
                 outb.append(new_vec[off : off + n].reshape(V, o))
                 off += n
             new_stacked = {"W": tuple(outW), "b": tuple(outb)}
+            outs = (new_stacked, opt_state, loss)
             if with_grad_norm:
-                return new_stacked, opt_state, loss, gnorm
-            return new_stacked, opt_state, loss
+                outs += (gnorm,)
+            if with_step_stats:
+                from shallowspeed_tpu.optimizer import global_norm as gnorm_of
+
+                # post-update param norm: padded entries are exactly zero,
+                # so the pp-psum'd stacked norm IS the logical norm
+                outs += (gnorm_of(new_stacked, lambda sq: lax.psum(sq, "pp")),)
+            return outs
 
         # the BackwardGradAllReduce anchor: one SUM-psum of the whole gradient
         # pytree over dp per batch (reference pipe.py:302-327)
@@ -758,9 +777,14 @@ def make_pipeline_step(
             grads = clip_tree(grads, clip_norm, lambda sq: lax.psum(sq, "pp"))
         local = {"W": stacked["W"], "b": stacked["b"]}
         new_local, opt_state = opt.apply(local, grads, opt_state)
+        outs = (new_local, opt_state, loss)
         if with_grad_norm:
-            return new_local, opt_state, loss, gnorm
-        return new_local, opt_state, loss
+            outs += (gnorm,)
+        if with_step_stats:
+            from shallowspeed_tpu.optimizer import global_norm as gnorm_of
+
+            outs += (gnorm_of(new_local, lambda sq: lax.psum(sq, "pp")),)
+        return outs
 
     pp = P("pp")
     dp_spec = P("dp")
@@ -804,6 +828,8 @@ def make_pipeline_step(
         out_specs = (stacked_specs, state_specs, P())
         if with_grad_norm:
             out_specs = out_specs + (P(),)  # replicated pre-clip grad norm
+        if with_step_stats:
+            out_specs = out_specs + (P(),)  # replicated post-update param norm
         smapped = shard_map(
             per_device,
             mesh=mesh,
@@ -846,6 +872,7 @@ def make_pipeline_epoch(
     clip_norm=None,
     kernel_backend="xla",
     with_grad_norm=False,
+    with_step_stats=False,
 ):
     """Scan the pipeline train step over all batches of an epoch: one XLA
     program per epoch. X: (num_batches, global_batch, in_dim), batch axis
@@ -856,47 +883,60 @@ def make_pipeline_epoch(
     ``clip_norm`` clips the global gradient norm before each update;
     ``kernel_backend`` selects the per-slot compute unit (see
     make_pipeline_step); ``with_grad_norm`` appends a telemetry aux dict
-    ``{"grad_norm": mean pre-clip global grad norm}`` as a fourth output
-    (mirrors trainer.make_train_epoch's aux, so TrainingSession records the
-    same scalars on every layout)."""
+    ``{"grad_norm": mean pre-clip global grad norm}`` as a fourth output;
+    ``with_step_stats`` adds per-step ``step_loss``/``step_grad_norm``/
+    ``step_param_norm`` vectors to that aux (both mirror
+    trainer.make_train_epoch's aux, so TrainingSession records the same
+    scalars on every layout)."""
     step = make_pipeline_step(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
         tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
         kernel_backend=kernel_backend, with_grad_norm=with_grad_norm,
+        with_step_stats=with_step_stats,
     )
     return jax.jit(
-        _make_pipeline_epoch_core(step, unroll, with_grad_norm),
+        _make_pipeline_epoch_core(step, unroll, with_grad_norm, with_step_stats),
         donate_argnums=(0, 2),
     )
 
 
-def _make_pipeline_epoch_core(step, unroll, with_grad_norm=False):
+def _make_pipeline_epoch_core(step, unroll, with_grad_norm=False, with_step_stats=False):
     """The one batch-scan epoch body shared by make_pipeline_epoch and
     make_pipeline_run: ``core(stacked, flags, opt_state, X, Y) ->
-    (stacked, opt_state, mean_loss)`` — plus an aux dict
-    ``{"grad_norm": mean}`` when ``with_grad_norm``. One scan body serves
-    both arities: the grad-norm slot always rides the carry (zero when the
-    aux is off) and XLA dead-code-eliminates it from the uninstrumented
-    program."""
+    (stacked, opt_state, mean_loss)`` — plus an aux dict when instrumented
+    (``grad_norm`` mean under ``with_grad_norm``; stacked per-step
+    ``step_loss``/``step_grad_norm``/``step_param_norm`` vectors under
+    ``with_step_stats``, as ordinary scan ys). One scan body serves every
+    arity: the grad-norm slot always rides the carry (zero when the aux is
+    off) and XLA dead-code-eliminates it from the uninstrumented program."""
+    track_gn = with_grad_norm or with_step_stats
 
     def epoch_core(stacked, flags, opt_state, X, Y):
         def body(carry, xy):
             stacked, opt_state, loss_sum, gn_sum = carry
             out = step(stacked, flags, opt_state, xy[0], xy[1])
             stacked, opt_state, loss = out[0], out[1], out[2]
-            gn = out[3] if with_grad_norm else jnp.zeros(())
-            return (stacked, opt_state, loss_sum + loss, gn_sum + gn), None
+            gn = out[3] if track_gn else jnp.zeros(())
+            carry = (stacked, opt_state, loss_sum + loss, gn_sum + gn)
+            if with_step_stats:
+                return carry, (loss, gn, out[4])
+            return carry, None
 
-        (stacked, opt_state, loss_sum, gn_sum), _ = lax.scan(
+        (stacked, opt_state, loss_sum, gn_sum), ys = lax.scan(
             body,
             (stacked, opt_state, jnp.zeros(()), jnp.zeros(())),
             (X, Y),
             unroll=unroll,
         )
         nb = X.shape[0]
+        if not (with_grad_norm or with_step_stats):
+            return stacked, opt_state, loss_sum / nb
+        aux = {}
         if with_grad_norm:
-            return stacked, opt_state, loss_sum / nb, {"grad_norm": gn_sum / nb}
-        return stacked, opt_state, loss_sum / nb
+            aux["grad_norm"] = gn_sum / nb
+        if with_step_stats:
+            aux["step_loss"], aux["step_grad_norm"], aux["step_param_norm"] = ys
+        return stacked, opt_state, loss_sum / nb, aux
 
     return epoch_core
 
@@ -915,6 +955,7 @@ def make_pipeline_run(
     eval_prog=None,
     eval_mubatch_size=None,
     kernel_backend="xla",
+    with_grad_norm=False,
 ):
     """Epochs-outer scan around the pipeline epoch: the whole multi-epoch run
     as ONE XLA program over the mesh (the pipeline counterpart of
@@ -930,12 +971,18 @@ def make_pipeline_run(
     epoch (vy_labels: (n_val,) int labels, unpadded — the static slice
     drops the padded rows).
 
+    ``with_grad_norm``: telemetry aux, mirroring trainer.make_train_run's —
+    one EXTRA trailing output, an aux dict whose ``"grad_norm"`` is the
+    (n_epochs,) vector of per-epoch mean pre-clip global gradient norms
+    (ordinary scan outputs, so the run stays one fused program; this closes
+    the mesh-fused-run gap docs/observability.md used to document).
+
     ``n_epochs`` is static (one compile per value).
     """
     step = make_pipeline_step(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
         tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
-        kernel_backend=kernel_backend,
+        kernel_backend=kernel_backend, with_grad_norm=with_grad_norm,
     )
     eval_step = None
     if eval_prog is not None:
@@ -944,7 +991,18 @@ def make_pipeline_run(
             jit=False, kernel_backend=kernel_backend,
         )
     out_dim = spec.out_dim
-    epoch_core = _make_pipeline_epoch_core(step, unroll)
+    epoch_core = _make_pipeline_epoch_core(step, unroll, with_grad_norm)
+
+    def run_epoch(stacked, flags, opt_state, X, Y):
+        """Uniform (stacked, opt_state, loss, gnorm) view of the epoch core
+        (gnorm 0 when the aux is off — dropped again before returning)."""
+        if with_grad_norm:
+            stacked, opt_state, mean_loss, aux = epoch_core(
+                stacked, flags, opt_state, X, Y
+            )
+            return stacked, opt_state, mean_loss, aux["grad_norm"]
+        stacked, opt_state, mean_loss = epoch_core(stacked, flags, opt_state, X, Y)
+        return stacked, opt_state, mean_loss, jnp.zeros(())
 
     if eval_step is None:
 
@@ -952,14 +1010,16 @@ def make_pipeline_run(
         def run(stacked, flags, opt_state, X, Y, n_epochs):
             def epoch_body(carry, _):
                 stacked, opt_state = carry
-                stacked, opt_state, mean_loss = epoch_core(
+                stacked, opt_state, mean_loss, gn = run_epoch(
                     stacked, flags, opt_state, X, Y
                 )
-                return (stacked, opt_state), mean_loss
+                return (stacked, opt_state), (mean_loss, gn)
 
-            (stacked, opt_state), losses = lax.scan(
+            (stacked, opt_state), (losses, gns) = lax.scan(
                 epoch_body, (stacked, opt_state), None, length=n_epochs
             )
+            if with_grad_norm:
+                return stacked, opt_state, losses, {"grad_norm": gns}
             return stacked, opt_state, losses
 
         return run
@@ -970,16 +1030,18 @@ def make_pipeline_run(
 
         def epoch_body(carry, _):
             stacked, opt_state = carry
-            stacked, opt_state, mean_loss = epoch_core(
+            stacked, opt_state, mean_loss, gn = run_epoch(
                 stacked, flags, opt_state, X, Y
             )
             preds = eval_step(stacked, flags, vx_padded)[:n_val, :out_dim]
             acc = jnp.mean((jnp.argmax(preds, axis=1) == vy_labels).astype(jnp.float32))
-            return (stacked, opt_state), (mean_loss, acc)
+            return (stacked, opt_state), (mean_loss, acc, gn)
 
-        (stacked, opt_state), (losses, accs) = lax.scan(
+        (stacked, opt_state), (losses, accs, gns) = lax.scan(
             epoch_body, (stacked, opt_state), None, length=n_epochs
         )
+        if with_grad_norm:
+            return stacked, opt_state, losses, accs, {"grad_norm": gns}
         return stacked, opt_state, losses, accs
 
     return run

@@ -145,6 +145,30 @@ def program_stats(prog):
     }
 
 
+def program_flops(prog, spec, mubatch_size):
+    """Analytical PADDED FLOPs for ONE execution of this tick program on one
+    pp-group: the hardware-work leg of the observability cost model
+    (observability/costmodel.py; the logical model-FLOP leg is
+    ``mlp_train_flops_per_sample``).
+
+    Every computing cell runs the SPMD executor's full padded slot stack —
+    a forward is ``2 * mb * sum(o_l * i_l)`` over the PADDED per-slot dims
+    (executor.slot_shapes), a backward twice that (dgrad + wgrad) —
+    regardless of the stage's logical widths; that uniformity is exactly
+    what makes the program SPMD, and exactly why padded FLOPs exceed
+    logical FLOPs. Computed from the ACTUAL tick tables (counts of
+    OP_FWD/OP_BWD cells), so the padding-tax number is an artifact of the
+    real lowered program, not a formula that can drift from it. Multiply by
+    ``dp`` for the whole mesh (each replica runs the program on its shard).
+    """
+    from shallowspeed_tpu.parallel.executor import slot_shapes
+
+    padded_p = sum(o * i for o, i in slot_shapes(spec))
+    n_fwd = int(np.sum(prog.op == OP_FWD))
+    n_bwd = int(np.sum(prog.op == OP_BWD))
+    return (2 * n_fwd + 4 * n_bwd) * mubatch_size * padded_p
+
+
 def parse_stage_stream(commands, stage_id, num_stages, training=True, num_chunks=1):
     """Flatten one device's instruction stream into WorkItems + validate.
 

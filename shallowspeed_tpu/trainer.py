@@ -283,6 +283,7 @@ def make_train_epoch(
     megakernel=False,
     epoch_kernel=False,
     with_grad_norm=False,
+    with_step_stats=False,
 ):
     """Whole-epoch scan: ``epoch(params, opt_state, X, Y) -> (params,
     opt_state, mean_loss)`` with X: (num_batches, M, mubatch, in_dim). One
@@ -302,14 +303,20 @@ def make_train_epoch(
     is an ordinary scan output (data flow, not a host callback), so the
     epoch stays one fused XLA program; unavailable on the kernel paths
     (the gradient never leaves VMEM there).
+    ``with_step_stats``: the flight-recorder aux — the aux dict also
+    carries per-STEP (per-batch) vectors ``step_loss`` /
+    ``step_grad_norm`` (pre-clip) / ``step_param_norm`` (post-update), as
+    ordinary stacked scan outputs of the same fused program. Same kernel-
+    path restriction as ``with_grad_norm``.
     """
     if epoch_kernel:
         if megakernel:
             raise ValueError("megakernel and epoch_kernel are exclusive")
-        if with_grad_norm:
+        if with_grad_norm or with_step_stats:
             raise ValueError(
-                "with_grad_norm is unavailable on the kernel paths: the "
-                "gradient never leaves the Pallas kernel's VMEM"
+                "with_grad_norm/with_step_stats are unavailable on the "
+                "kernel paths: the gradient never leaves the Pallas "
+                "kernel's VMEM"
             )
         epoch_core = _make_epoch_kernel_core(
             spec, opt, precision, fuse_mubatches, clip_norm
@@ -317,38 +324,56 @@ def make_train_epoch(
     else:
         batch_step = _make_batch_step(
             spec, opt, precision, fuse_mubatches, clip_norm, megakernel,
-            with_grad_norm,
+            with_grad_norm or with_step_stats,
         )
-        epoch_core = _make_epoch_core(batch_step, unroll, with_grad_norm)
+        epoch_core = _make_epoch_core(
+            batch_step, unroll, with_grad_norm, with_step_stats
+        )
     return jax.jit(epoch_core, donate_argnums=(0, 1))
 
 
-def _make_epoch_core(batch_step, unroll, with_grad_norm=False):
+def _make_epoch_core(batch_step, unroll, with_grad_norm=False, with_step_stats=False):
     """The one epoch-scan body shared by make_train_epoch and make_train_run:
     ``core(params, opt_state, X, Y) -> (params, opt_state, mean_loss)`` —
-    plus an aux dict ``{"grad_norm": mean}`` when ``with_grad_norm``. One
-    scan body serves both arities: the grad-norm slot always rides the
-    carry (zero when the aux is off) and XLA dead-code-eliminates it from
-    the uninstrumented program."""
+    plus an aux dict when instrumented: ``{"grad_norm": mean}`` under
+    ``with_grad_norm``, and per-step stacked vectors ``step_loss`` /
+    ``step_grad_norm`` / ``step_param_norm`` under ``with_step_stats``
+    (ordinary scan ys — data flow, never host callbacks, so the epoch stays
+    one fused XLA program). One scan body serves every arity: the grad-norm
+    slot always rides the carry (zero when the aux is off) and XLA
+    dead-code-eliminates it from the uninstrumented program."""
+    track_gn = with_grad_norm or with_step_stats
 
     def epoch_core(params, opt_state, X, Y):
         def body(carry, xy):
             params, opt_state, loss_sum, gn_sum = carry
             out = batch_step(params, opt_state, *xy)
             params, opt_state, loss = out[0], out[1], out[2]
-            gn = out[3] if with_grad_norm else jnp.zeros(())
-            return (params, opt_state, loss_sum + loss, gn_sum + gn), None
+            gn = out[3] if track_gn else jnp.zeros(())
+            carry = (params, opt_state, loss_sum + loss, gn_sum + gn)
+            if with_step_stats:
+                from shallowspeed_tpu.optimizer import global_norm
 
-        (params, opt_state, loss_sum, gn_sum), _ = lax.scan(
+                # post-update param norm: the "did the step blow the
+                # weights up" scalar the health monitor watches
+                return carry, (loss, gn, global_norm(params))
+            return carry, None
+
+        (params, opt_state, loss_sum, gn_sum), ys = lax.scan(
             body,
             (params, opt_state, jnp.zeros(()), jnp.zeros(())),
             (X, Y),
             unroll=unroll,
         )
         nb = X.shape[0]
+        if not (with_grad_norm or with_step_stats):
+            return params, opt_state, loss_sum / nb
+        aux = {}
         if with_grad_norm:
-            return params, opt_state, loss_sum / nb, {"grad_norm": gn_sum / nb}
-        return params, opt_state, loss_sum / nb
+            aux["grad_norm"] = gn_sum / nb
+        if with_step_stats:
+            aux["step_loss"], aux["step_grad_norm"], aux["step_param_norm"] = ys
+        return params, opt_state, loss_sum / nb, aux
 
     return epoch_core
 

@@ -273,8 +273,15 @@ def test_capture_budget_skips_forward(tmp_path, monkeypatch, capture_mod):
         tc.main()
     finally:
         hang.set()  # release the hung worker thread
-    assert out.is_file()
-    result = json.loads(out.read_text())
+    # the full-capture rename gate (ADVICE r05, matching tier-0): a
+    # budget-skipped phase keeps the artifact a .partial — tunnel_watch.sh
+    # must keep watching and retry with --resume instead of exiting on a
+    # wedged partial capture
+    assert not out.exists()
+    partial = Path(str(out) + ".partial")
+    assert partial.is_file()
+    result = json.loads(partial.read_text())
+    assert "completed_at" not in result
     skipped = [e["phase"] for e in result["phases_skipped_by_budget"]]
     assert skipped == ["3-convergence"]
     assert "convergence" not in result
@@ -283,7 +290,6 @@ def test_capture_budget_skips_forward(tmp_path, monkeypatch, capture_mod):
         "megakernel_convergence", "epoch_kernel_convergence", "trace",
         "trace_headline", "matrix", "matrix_full_epoch_fused",
         "executor_kernel_backends", "executor_api_path", "adam_kernel_cells",
-        "completed_at",
     ):
         assert key in result, f"later phase result missing {key!r}"
     # honesty: every phase that ran while the abandoned worker was still
@@ -363,6 +369,83 @@ def test_phase_runner_late_merge(capture_mod):
     assert result["existing"] == "kept"  # setdefault semantics: no clobber
     assert result["phases_late_completed"] == ["unit-test-phase"]
     tc.PHASE_BUDGET_S.pop("unit-test-phase", None)
+
+
+def test_phase_runner_done_detection_requires_delivery(capture_mod):
+    """Resume done-detection (ADVICE r05): a phase counts as captured only
+    when its primary key is NON-EMPTY and no matching ``*_unresolved`` key
+    exists; a clean re-run clears the stale unresolved marker, a still-
+    unresolved re-run keeps its fresh one."""
+    tc = capture_mod
+    assert set(tc.PHASE_UNRESOLVED_KEYS) <= set(tc.PHASE_DONE_KEYS)
+    calls = []
+
+    def phase():
+        calls.append(1)
+        return {"adam_kernel_cells": {"adam+default+xla": 1.0}}
+
+    # empty primary key (the phase ran but delivered nothing) -> re-run
+    result = {"adam_kernel_cells": {}}
+    runner = tc._PhaseRunner(result, lambda: None)
+    assert runner.run("6-adam-cells", phase) is True
+    assert calls == [1]
+    assert result["adam_kernel_cells"] == {"adam+default+xla": 1.0}
+
+    # unresolved marker present -> re-run; the clean re-run clears it
+    calls.clear()
+    result = {
+        "adam_kernel_cells": {"adam+default+xla": 9.0},
+        "adam_kernel_cells_unresolved": {"adam+default+mega": "timeout"},
+    }
+    runner = tc._PhaseRunner(result, lambda: None)
+    assert runner.run("6-adam-cells", phase) is True
+    assert calls == [1]
+    assert "adam_kernel_cells_unresolved" not in result
+    assert result["adam_kernel_cells"] == {"adam+default+xla": 1.0}
+
+    # delivered + no unresolved marker -> skipped, not re-measured
+    calls.clear()
+    runner2 = tc._PhaseRunner(dict(result), lambda: None)
+    assert runner2.run("6-adam-cells", phase) is True
+    assert calls == []
+
+    # a re-run that is STILL partially unresolved keeps its FRESH marker
+    calls.clear()
+
+    def phase_unresolved():
+        calls.append(1)
+        return {
+            "adam_kernel_cells": {"adam+default+xla": 2.0},
+            "adam_kernel_cells_unresolved": {"adam+default+epoch": "x"},
+        }
+
+    result = {
+        "adam_kernel_cells": {"adam+default+xla": 9.0},
+        "adam_kernel_cells_unresolved": {"old": "marker"},
+    }
+    runner3 = tc._PhaseRunner(result, lambda: None)
+    assert runner3.run("6-adam-cells", phase_unresolved) is True
+    assert calls == [1]
+    assert result["adam_kernel_cells_unresolved"] == {"adam+default+epoch": "x"}
+
+
+def test_capture_complete_gates_on_skips_and_unresolved(capture_mod):
+    """The rename-into-place eligibility: budget skips and *_unresolved
+    cell markers (both retryable via --resume) block the rename;
+    deterministic phase errors do not."""
+    tc = capture_mod
+    assert tc.capture_complete({"matrix": {"a": 1.0}}) is True
+    assert tc.capture_complete(
+        {"phases_skipped_by_budget": [{"phase": "5-matrix"}]}
+    ) is False
+    assert tc.capture_complete(
+        {"adam_kernel_cells": {}, "adam_kernel_cells_unresolved": {"c": "t"}}
+    ) is False
+    # errors alone do NOT gate: retrying them fails identically, and a
+    # banked artifact with recorded errors beats an endless watch loop
+    assert tc.capture_complete(
+        {"phase_errors": [{"phase": "6b-adam-convergence", "error": "x"}]}
+    ) is True
 
 
 def test_capture_aborts_cleanly_on_wedged_tunnel(tmp_path, monkeypatch, capture_mod):

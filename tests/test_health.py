@@ -1,0 +1,225 @@
+"""Numerics health monitor: unit checks (NaN/Inf, divergence regression,
+grad spikes), policy behaviour (record/warn/halt), and the TrainingSession
+integration — a NaN-poisoned batch is detected and halted with a ``health``
+record naming the step, while the NullMetrics default stays uninstrumented.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu.observability import JsonlMetrics, read_jsonl
+from shallowspeed_tpu.observability.health import (
+    HealthError,
+    HealthMonitor,
+    make_monitor,
+)
+from shallowspeed_tpu.observability.metrics import MetricsRecorder
+
+SIZES = (24, 20, 18, 16, 14, 12, 11, 10)
+N, GBS = 256, 64  # 4 batches per epoch
+
+
+@pytest.fixture()
+def data_dir(tmp_path):
+    rng = np.random.RandomState(0)
+    for suffix, n in (("train", N), ("val", 96)):
+        x = rng.randn(n, SIZES[0]).astype(np.float32)
+        y = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], n)]
+        np.save(tmp_path / f"x_{suffix}.npy", x)
+        np.save(tmp_path / f"y_{suffix}.npy", y)
+    return tmp_path
+
+
+def _poison_batch(data_dir, batch):
+    """Inject one NaN feature into the given global batch (the data layer
+    is deliberately unshuffled, so batch identity is deterministic)."""
+    x = np.load(data_dir / "x_train.npy")
+    x[batch * GBS + 3, 5] = np.nan
+    np.save(data_dir / "x_train.npy", x)
+
+
+# ---------------------------------------------------------------------------
+# monitor unit behaviour
+# ---------------------------------------------------------------------------
+
+
+def test_non_finite_detection_names_step_and_field():
+    m = HealthMonitor(policy="record", min_history=2, window=8)
+    found = m.check_epoch(
+        0,
+        losses=[1.0, float("nan"), 1.0],
+        grad_norms=[0.1, 0.1, float("inf")],
+        first_step=10,
+    )
+    assert [(f["check"], f["step"], f["field"]) for f in found] == [
+        ("non_finite", 11, "loss"),
+        ("non_finite", 12, "grad_norm"),
+    ]
+    assert m.findings == found
+
+
+def test_nan_does_not_poison_rolling_windows():
+    """A NaN step is reported but NOT ingested: the next finite step is
+    judged against a finite baseline, not a NaN-poisoned one."""
+    m = HealthMonitor(policy="record", min_history=2, window=8)
+    m.check_epoch(0, [1.0, 1.0, float("nan"), 1.0], first_step=0)
+    assert all(math.isfinite(v) for v in m._losses)
+
+
+def test_loss_divergence_regression():
+    m = HealthMonitor(
+        policy="record", min_history=4, window=8, divergence_factor=3.0
+    )
+    # flat losses: no finding
+    assert m.check_epoch(0, [1.0] * 8, first_step=0) == []
+    # DEcreasing losses never diverge even across a big range
+    m2 = HealthMonitor(policy="record", min_history=4, window=8)
+    assert m2.check_epoch(0, [9.0, 7.0, 5.0, 3.0, 2.0, 1.0], first_step=0) == []
+    # geometric growth crosses 3x the window min with a positive slope
+    found = m.check_epoch(1, [1.2, 1.5, 2.0, 3.5, 6.0], first_step=8)
+    assert any(f["check"] == "loss_divergence" for f in found)
+    f = next(f for f in found if f["check"] == "loss_divergence")
+    assert f["slope"] > 0 and f["step"] is not None
+
+
+def test_grad_spike_detection():
+    m = HealthMonitor(policy="record", min_history=4, window=8, spike_factor=10.0)
+    gns = [1.0, 1.1, 0.9, 1.0, 1.05, 50.0]
+    found = m.check_epoch(0, [0.5] * len(gns), grad_norms=gns, first_step=0)
+    spikes = [f for f in found if f["check"] == "grad_spike"]
+    assert len(spikes) == 1 and spikes[0]["step"] == 5
+    assert spikes[0]["value"] == 50.0
+
+
+def test_policy_dispatch_record_warn_halt(capsys):
+    rec = MetricsRecorder()
+    emitted = []
+    rec._emit = emitted.append
+    m = HealthMonitor(policy="record", min_history=2, window=4)
+    findings = m.check_epoch(0, [float("nan")], first_step=0)
+    m.dispatch(findings, rec)  # record: emits, no raise, no print
+    assert [e["kind"] for e in emitted] == ["health"]
+    assert emitted[0]["name"] == "non_finite" and emitted[0]["action"] == "record"
+    assert "step" in emitted[0] and "epoch" in emitted[0]
+
+    warn = HealthMonitor(policy="warn", min_history=2, window=4)
+    warn.dispatch(warn.check_epoch(0, [float("inf")], first_step=3), None)
+    assert "non_finite" in capsys.readouterr().err
+
+    halt = HealthMonitor(policy="halt", min_history=2, window=4)
+    with pytest.raises(HealthError, match="step 7"):
+        halt.dispatch(halt.check_epoch(2, [float("nan")], first_step=7), rec)
+
+
+def test_monitor_constructor_validation_and_make_monitor():
+    with pytest.raises(ValueError, match="policy"):
+        HealthMonitor(policy="explode")
+    with pytest.raises(ValueError, match="window"):
+        HealthMonitor(window=2, min_history=8)
+    assert make_monitor(None) is None
+    m = HealthMonitor(policy="warn")
+    assert make_monitor(m) is m
+    assert make_monitor("halt").policy == "halt"
+
+
+def test_check_run_epoch_granularity():
+    """Fused runs only have per-epoch scalars: findings carry the epoch and
+    a null step."""
+    m = HealthMonitor(policy="record", min_history=2, window=4)
+    found = m.check_run(5, [0.5, float("nan"), 0.5])
+    assert [(f["check"], f["epoch"], f["step"]) for f in found] == [
+        ("non_finite", 6, None)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# TrainingSession integration
+# ---------------------------------------------------------------------------
+
+
+def test_session_halts_on_nan_batch_with_health_record(data_dir, tmp_path):
+    """The acceptance contract: a NaN-poisoned batch halts the run under
+    health='halt' and the JSONL carries a health record naming the step —
+    flushed BEFORE the raise, so the evidence survives the abort."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    _poison_batch(data_dir, 1)
+    path = tmp_path / "halt.jsonl"
+    with JsonlMetrics(path) as m:
+        run = TrainingSession(
+            sizes=SIZES, global_batch_size=GBS, lr=0.01, data_dir=data_dir,
+            metrics=m, health="halt",
+        )
+        with pytest.raises(HealthError, match="step 1"):
+            run.train_epoch()
+    recs = read_jsonl(path)
+    health = [r for r in recs if r["kind"] == "health"]
+    assert health, "no health record survived the halt"
+    assert health[0]["name"] == "non_finite"
+    assert health[0]["step"] == 1 and health[0]["action"] == "halt"
+    # the flight ring holds the poisoned step for post-mortem
+    sample = run.flight.last(run.batches_per_epoch)[1]
+    assert sample["step"] == 1 and math.isnan(sample["loss"])
+
+
+def test_session_warn_policy_does_not_halt(data_dir, tmp_path, capsys):
+    from shallowspeed_tpu.api import TrainingSession
+
+    _poison_batch(data_dir, 2)
+    path = tmp_path / "warn.jsonl"
+    with JsonlMetrics(path) as m:
+        run = TrainingSession(
+            sizes=SIZES, global_batch_size=GBS, lr=0.01, data_dir=data_dir,
+            metrics=m, health="warn",
+        )
+        run.train_epoch()  # must NOT raise
+    assert "non_finite" in capsys.readouterr().err
+    health = [r for r in read_jsonl(path) if r["kind"] == "health"]
+    assert health and health[0]["step"] == 2 and health[0]["action"] == "warn"
+
+
+def test_session_health_works_without_metrics(data_dir):
+    """health= alone (NullMetrics default) still detects and halts: the
+    monitor consumes the fused aux directly, recording is orthogonal."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    _poison_batch(data_dir, 0)
+    run = TrainingSession(
+        sizes=SIZES, global_batch_size=GBS, lr=0.01, data_dir=data_dir,
+        health="halt",
+    )
+    assert run._step_aux  # the aux is threaded for the monitor
+    with pytest.raises(HealthError, match="step 0"):
+        run.train_epoch()
+
+
+def test_default_session_stays_uninstrumented(data_dir):
+    """NullMetrics default + no health monitor: no step aux, no flight
+    recorder — the hot path builds the exact 3-output epoch program."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    run = TrainingSession(
+        sizes=SIZES, global_batch_size=GBS, lr=0.01, data_dir=data_dir
+    )
+    assert run._step_aux is False and run.flight is None
+    out = run._epoch_fn(*run._epoch_args())
+    assert len(out) == 3  # params, opt_state, loss — no aux slot
+
+
+def test_session_mesh_halts_on_nan_batch(data_dir, tmp_path):
+    """Same detection through the SPMD pipeline executor's fused aux."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    _poison_batch(data_dir, 1)
+    path = tmp_path / "mesh.jsonl"
+    with JsonlMetrics(path) as m:
+        run = TrainingSession(
+            sizes=SIZES, global_batch_size=GBS, lr=0.01, data_dir=data_dir,
+            metrics=m, dp=2, pp=2, schedule="gpipe", health="halt",
+        )
+        with pytest.raises(HealthError, match="step 1"):
+            run.train_epoch()
+    health = [r for r in read_jsonl(path) if r["kind"] == "health"]
+    assert health and health[0]["step"] == 1

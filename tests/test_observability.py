@@ -457,6 +457,231 @@ def test_session_fused_run_emits_per_epoch_records(data_dir, tmp_path):
     )
 
 
+def test_jsonl_stays_strict_json_under_non_finite_values(tmp_path):
+    """The blow-up evidence must stay parseable: non-finite floats are
+    sanitized to "NaN"/"Infinity"/"-Infinity" strings so every line is
+    STRICT JSON (json.dumps's default would write bare NaN tokens exactly
+    on the records the health feature exists to produce)."""
+    path = tmp_path / "nan.jsonl"
+    with JsonlMetrics(path) as m:
+        m.step("train", step=0, epoch=0, loss=float("nan"),
+               grad_norm=float("inf"), param_norm=-float("inf"))
+        m.health("non_finite", epoch=0, step=0, value=float("nan"),
+                 action="halt", detail="loss is nan")
+        m.event("weird", nested={"a": [1.0, float("nan")]})
+
+    def no_constants(name):  # bare NaN/Infinity tokens are a parse error
+        raise ValueError(f"non-strict JSON token {name!r}")
+
+    lines = path.read_text().splitlines()
+    recs = [json.loads(l, parse_constant=no_constants) for l in lines]
+    step = recs[1]
+    assert step["loss"] == "NaN" and step["grad_norm"] == "Infinity"
+    assert step["param_norm"] == "-Infinity"
+    assert recs[2]["value"] == "NaN"
+    assert recs[3]["nested"]["a"] == [1.0, "NaN"]
+
+
+def test_schema_v2_step_and_health_kinds(tmp_path):
+    """Schema v2: the step/health record kinds round-trip with the version
+    stamp, and NullMetrics no-ops them."""
+    assert SCHEMA_VERSION == 2
+    path = tmp_path / "v2.jsonl"
+    with JsonlMetrics(path) as m:
+        m.step("train", step=0, epoch=0, loss=0.5, grad_norm=0.1, param_norm=9.0)
+        m.health("non_finite", epoch=0, step=3, action="warn", detail="x")
+    recs = read_jsonl(path)
+    assert [r["kind"] for r in recs] == ["meta", "step", "health"]
+    assert all(r["v"] == 2 for r in recs)
+    assert recs[1]["step"] == 0 and recs[1]["param_norm"] == 9.0
+    assert recs[2]["name"] == "non_finite" and recs[2]["action"] == "warn"
+    n = NullMetrics()
+    n.step("train", loss=0.5)
+    n.health("non_finite", step=1)
+
+
+@pytest.mark.parametrize(
+    "kw", [dict(), dict(dp=2, pp=2, schedule="gpipe")], ids=["seq", "dp2pp2"]
+)
+def test_session_emits_step_records(data_dir, tmp_path, kw):
+    """The flight recorder: one schema-v2 step record per optimizer step on
+    BOTH layouts, globally numbered, with finite loss/grad/param norms, and
+    the ring buffer holding the same samples."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    path = tmp_path / "steps.jsonl"
+    with JsonlMetrics(path) as m:
+        run = TrainingSession(
+            sizes=SIZES, global_batch_size=GBS, lr=0.01, data_dir=data_dir,
+            metrics=m, **kw,
+        )
+        for _ in range(2):
+            run.train_epoch()
+    recs = read_jsonl(path)
+    steps = [r for r in recs if r["kind"] == "step"]
+    nb = run.batches_per_epoch
+    assert len(steps) == 2 * nb
+    assert [s["step"] for s in steps] == list(range(2 * nb))
+    assert steps[nb]["epoch"] == 1
+    for s in steps:
+        assert np.isfinite(s["loss"])
+        assert np.isfinite(s["grad_norm"]) and s["grad_norm"] > 0
+        assert np.isfinite(s["param_norm"]) and s["param_norm"] > 0
+    assert len(run.flight) == 2 * nb and run.flight.total_steps == 2 * nb
+    assert run.flight.last(1)[0]["step"] == 2 * nb - 1
+
+
+def test_record_steps_false_opts_out_of_flight_aux(data_dir, tmp_path):
+    """record_steps=False keeps a metrics session at the PR1 cost profile:
+    epoch events only, no step records, no per-step aux in the program."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    path = tmp_path / "optout.jsonl"
+    with JsonlMetrics(path) as m:
+        run = TrainingSession(
+            sizes=SIZES, global_batch_size=GBS, lr=0.01, data_dir=data_dir,
+            metrics=m, record_steps=False,
+        )
+        run.train_epoch()
+    assert run._step_aux is False and run.flight is None
+    recs = read_jsonl(path)
+    assert [r for r in recs if r["kind"] == "step"] == []
+    assert len(_epoch_events(recs)) == 1
+    # record_steps=True forces the flight ring on even without a recorder
+    run2 = TrainingSession(
+        sizes=SIZES, global_batch_size=GBS, lr=0.01, data_dir=data_dir,
+        record_steps=True,
+    )
+    run2.train_epoch()
+    assert len(run2.flight) == run2.batches_per_epoch
+
+
+def test_warm_run_first_session_still_records_xla_crosscheck(data_dir, tmp_path):
+    """A train_run-before-train_epoch session must not lose the XLA
+    cost_analysis leg: the early (analytical-only) cost_model event is
+    upgraded once the epoch program compiles (last event wins)."""
+    from shallowspeed_tpu.api import TrainingSession
+    from shallowspeed_tpu.observability.costmodel import compiled_flops
+
+    path = tmp_path / "runfirst.jsonl"
+    with JsonlMetrics(path) as m:
+        run = TrainingSession(
+            sizes=SIZES, global_batch_size=GBS, lr=0.01, data_dir=data_dir,
+            metrics=m,
+        )
+        run.train_run(1, with_eval=False)
+        run.train_epoch()
+    events = [r for r in read_jsonl(path) if r.get("name") == "cost_model"]
+    if compiled_flops(run._epoch_fn.lower(*run._epoch_args()).compile())[0] is None:
+        pytest.skip("backend exposes no cost_analysis flops")
+    assert events[0]["xla_flops_per_epoch"] is None  # pre-compile record
+    assert events[-1]["xla_flops_per_epoch"] > 0  # upgraded record
+    assert events[-1]["flops_ratio"] > 0
+
+
+def test_step_aux_matches_epoch_mean(data_dir, tmp_path):
+    """The per-step vectors are the same numbers the epoch aggregates: the
+    mean of step losses IS the epoch loss record."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    path = tmp_path / "agg.jsonl"
+    with JsonlMetrics(path) as m:
+        run = TrainingSession(
+            sizes=SIZES, global_batch_size=GBS, lr=0.01, data_dir=data_dir,
+            metrics=m,
+        )
+        loss = run.train_epoch()
+    steps = [r for r in read_jsonl(path) if r["kind"] == "step"]
+    np.testing.assert_allclose(
+        np.mean([s["loss"] for s in steps]), loss, rtol=1e-6
+    )
+
+
+def test_session_emits_cost_model_and_mfu(data_dir, tmp_path):
+    """MFU accounting: the cost_model event (analytical + XLA cross-check
+    legs, peak provenance) and per-epoch mfu/achieved_flops gauges."""
+    from shallowspeed_tpu.api import TrainingSession
+    from shallowspeed_tpu.observability.costmodel import (
+        mlp_train_flops_per_sample,
+    )
+
+    path = tmp_path / "mfu.jsonl"
+    with JsonlMetrics(path) as m:
+        run = TrainingSession(
+            sizes=SIZES, global_batch_size=GBS, lr=0.01, data_dir=data_dir,
+            metrics=m, dp=2, pp=2, schedule="gpipe",
+        )
+        run.train_epoch()
+    recs = read_jsonl(path)
+    (cost,) = [r for r in recs if r.get("name") == "cost_model"]
+    fps = mlp_train_flops_per_sample(SIZES)
+    assert cost["flops_per_sample"] == fps
+    assert cost["flops_per_epoch"] == fps * GBS * run.batches_per_epoch
+    assert cost["n_devices"] == 4 and cost["peak_flops_per_chip"] > 0
+    assert "peak_source" in cost
+    # padded pipeline FLOPs from the actual tick tables: >= logical
+    assert cost["padded_ratio"] >= 1.0
+    gauges = {r["name"]: r["value"] for r in recs if r["kind"] == "gauge"}
+    assert gauges["model_flops"] == cost["flops_per_epoch"]
+    assert gauges["achieved_flops_per_sec"] > 0
+    assert 0 < gauges["mfu"] < 1.5  # a utilization, not a raw FLOP count
+    (ep,) = _epoch_events(recs)
+    assert np.isfinite(ep["mfu"])
+
+
+def test_mesh_fused_run_reports_grad_norm(data_dir, tmp_path):
+    """The satellite contract: make_pipeline_run now threads the grad-norm
+    aux, so MESH fused-run epoch records carry grad_norm too (this was the
+    documented gap in docs/observability.md)."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    path = tmp_path / "meshrun.jsonl"
+    with JsonlMetrics(path) as m:
+        run = TrainingSession(
+            sizes=SIZES, global_batch_size=GBS, lr=0.01, clip_norm=1.0,
+            data_dir=data_dir, metrics=m, dp=2, pp=2, schedule="gpipe",
+        )
+        losses, accs = run.train_run(2)
+    epochs = _epoch_events(read_jsonl(path))
+    assert len(epochs) == 2
+    for e, r in enumerate(epochs):
+        assert r["fused_run"] is True and r["loss"] == losses[e]
+        assert np.isfinite(r["grad_norm"]) and r["grad_norm"] > 0
+
+
+def test_executor_step_stats_param_norm_matches_unstacked():
+    """The mesh per-step param norm is the LOGICAL norm: padded entries are
+    exactly zero, so the stacked pp-psum'd norm equals the norm of the
+    unstacked parameters."""
+    import jax
+    import jax.numpy as jnp
+
+    from shallowspeed_tpu import model as Mo
+    from shallowspeed_tpu import schedules as S
+    from shallowspeed_tpu.optimizer import SGD, global_norm
+    from shallowspeed_tpu.parallel import executor as E
+    from shallowspeed_tpu.parallel import lower_schedule, make_mesh
+
+    B, M = 32, 4
+    rng = np.random.RandomState(7)
+    Xb = rng.randn(B, SIZES[0]).astype(np.float32)
+    Yb = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], B)]
+    mesh = make_mesh(2, 2)
+    spec = Mo.make_model_spec(SIZES, 2, B)
+    prog = lower_schedule(S.GPipeSchedule, M, 2)
+    stacked, flags = E.init_stacked(spec, mesh)
+    step = E.make_pipeline_step(
+        mesh, spec, prog, B // 2 // M, SGD(0.01), with_step_stats=True
+    )
+    new_stacked, _, loss, gnorm, pnorm = step(
+        stacked, flags, (), jnp.asarray(Xb), jnp.asarray(Yb)
+    )
+    logical = E.unstack_params(new_stacked, spec)
+    expect = float(global_norm(jax.tree.map(jnp.asarray, logical)))
+    np.testing.assert_allclose(float(pnorm), expect, rtol=2e-5)
+    assert np.isfinite(float(gnorm)) and float(gnorm) > 0
+
+
 def test_session_metrics_do_not_change_training(data_dir, tmp_path):
     """Telemetry is observation only: the recorded run trains to the exact
     same weights as the unrecorded one."""

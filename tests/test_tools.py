@@ -265,6 +265,32 @@ def test_build_record_honesty_rules():
     assert "tunnel" not in r
 
 
+def test_build_record_mfu_companions():
+    """The record lines carry MFU alongside samples/s: each cell against
+    its OWN backend's per-chip peak, with the peak + source recorded so a
+    nominal-CPU MFU is self-describing."""
+    bench = _import_bench()
+    tpu = {"interleaved": True, "backend": "tpu"}
+    cpu = {"interleaved": True, "backend": "cpu"}
+    fps = bench.flops_per_sample()
+    r, _ = bench.build_record(
+        {"default": 5e6, "highest": 3e6}, {"default": tpu, "highest": tpu},
+        1000.0, "", True,
+    )
+    assert abs(r["mfu"] - 5e6 * fps / 200e12) < 1e-6  # rounded to 6 places
+    assert abs(r["mfu_fp32_highest"] - 3e6 * fps / 100e12) < 1e-6
+    assert r["mfu_peak_flops"] == 200e12
+    assert r["mfu_peak_source"] == "datasheet-v5e"
+    # cpu cells get the clearly-tagged nominal peak
+    r, _ = bench.build_record({"default": 5e4}, {"default": cpu}, 1000.0, "", False)
+    assert r["mfu_peak_source"] == "nominal-cpu-default" and r["mfu"] > 0
+    # the phase-0 stub stays null-valued but record-shaped
+    r, _ = bench.build_record(
+        {}, {}, 1000.0, "_STUB_NOT_MEASURED", True, stub=True
+    )
+    assert r["mfu"] is None and r["mfu_peak_flops"] is None
+
+
 def test_bench_publishes_before_spending_tunnel_patience(monkeypatch, capsys):
     """The round-3 regression, bounded out: with the tunnel env active,
     bench.main must print a complete preliminary record BEFORE the first

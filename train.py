@@ -28,6 +28,7 @@ On a single-chip host, multi-device layouts run on emulated CPU devices:
 
 import argparse
 import contextlib
+import sys
 import time
 
 
@@ -103,9 +104,20 @@ def main():
         "--metrics-out",
         default=None,
         help="record structured training telemetry (per-epoch loss, "
-        "samples/s, grad-norm when clipping, compile/lowering spans, "
-        "pipeline program stats) to this JSONL file — see "
-        "docs/observability.md for the schema",
+        "samples/s, MFU, grad-norm when clipping, per-step flight records, "
+        "compile/lowering spans, pipeline program stats) to this JSONL "
+        "file — see docs/observability.md for the schema; render it with "
+        "`python -m shallowspeed_tpu.observability.report FILE`",
+    )
+    ap.add_argument(
+        "--health",
+        choices=["record", "warn", "halt"],
+        default=None,
+        help="numerics health monitor over the per-step flight aux "
+        "(NaN/Inf, rolling-window loss divergence, grad-norm spikes): "
+        "'record' emits health records into --metrics-out, 'warn' also "
+        "prints them, 'halt' additionally aborts the run (exit 3) at the "
+        "first finding, naming the blown-up step",
     )
     ap.add_argument(
         "--fuse-mubatches",
@@ -187,11 +199,12 @@ def main():
     import jax
 
     from shallowspeed_tpu.api import TrainingSession
-    from shallowspeed_tpu.observability import JsonlMetrics, capture
+    from shallowspeed_tpu.observability import HealthError, JsonlMetrics, capture
 
     metrics = JsonlMetrics(args.metrics_out) if args.metrics_out else None
     run = TrainingSession(
         metrics=metrics,
+        health=args.health,
         dp=args.dp,
         pp=args.pp,
         schedule=args.schedule,
@@ -238,42 +251,52 @@ def main():
         return contextlib.nullcontext()
 
     t0 = time.time()
-    if args.fused_run and args.epochs > 0:
-        # same accuracy semantics as the loop below — the "Epoch: N ...
-        # Accuracy" line reports the model's accuracy BEFORE epoch N trains
-        # (the initial one costs a single pre-run dispatch; the rest come
-        # out of the fused program's per-epoch accuracies). No per-epoch
-        # "Time Spent" here: all lines print after the single dispatch
-        # returns, so a per-line cumulative clock would be misleading.
-        if not args.no_eval:
-            print(f"Epoch: {run.epoch}, Accuracy: {run.accuracy() * 100:.2f}%")
-        start = run.epoch
-        if args.profile_dir:
-            # AOT-compile first so the trace holds steady-state execution,
-            # not compilation (mirrors the loop mode's post-compile trace)
-            run.warm_run(args.epochs, with_eval=not args.no_eval)
-        with capture(args.profile_dir, metrics):
-            losses, accs = run.train_run(args.epochs, with_eval=not args.no_eval)
-        for e, loss in enumerate(losses):
-            print(f"Epoch: {start + e}, mean train loss: {loss:.5f}")
-            if not args.no_eval and e < len(losses) - 1:
-                print(f"Epoch: {start + e + 1}, Accuracy: {accs[e] * 100:.2f}%")
-        if args.checkpoint:
-            run.save(args.checkpoint)
-        final_acc = accs[-1] if accs else run.accuracy()
-    else:
-        for i in range(args.epochs):
+    try:
+        if args.fused_run and args.epochs > 0:
+            # same accuracy semantics as the loop below — the "Epoch: N ...
+            # Accuracy" line reports the model's accuracy BEFORE epoch N trains
+            # (the initial one costs a single pre-run dispatch; the rest come
+            # out of the fused program's per-epoch accuracies). No per-epoch
+            # "Time Spent" here: all lines print after the single dispatch
+            # returns, so a per-line cumulative clock would be misleading.
             if not args.no_eval:
-                print(
-                    f"Epoch: {run.epoch}, Time Spent: {time.time() - t0:.2f}s, "
-                    f"Accuracy: {run.accuracy() * 100:.2f}%"
-                )
-            with profiled(i):
-                loss = run.train_epoch()
-            print(f"Epoch: {run.epoch - 1}, mean train loss: {loss:.5f}")
+                print(f"Epoch: {run.epoch}, Accuracy: {run.accuracy() * 100:.2f}%")
+            start = run.epoch
+            if args.profile_dir:
+                # AOT-compile first so the trace holds steady-state execution,
+                # not compilation (mirrors the loop mode's post-compile trace)
+                run.warm_run(args.epochs, with_eval=not args.no_eval)
+            with capture(args.profile_dir, metrics):
+                losses, accs = run.train_run(args.epochs, with_eval=not args.no_eval)
+            for e, loss in enumerate(losses):
+                print(f"Epoch: {start + e}, mean train loss: {loss:.5f}")
+                if not args.no_eval and e < len(losses) - 1:
+                    print(f"Epoch: {start + e + 1}, Accuracy: {accs[e] * 100:.2f}%")
             if args.checkpoint:
                 run.save(args.checkpoint)
-        final_acc = run.accuracy()
+            final_acc = accs[-1] if accs else run.accuracy()
+        else:
+            for i in range(args.epochs):
+                if not args.no_eval:
+                    print(
+                        f"Epoch: {run.epoch}, Time Spent: {time.time() - t0:.2f}s, "
+                        f"Accuracy: {run.accuracy() * 100:.2f}%"
+                    )
+                with profiled(i):
+                    loss = run.train_epoch()
+                print(f"Epoch: {run.epoch - 1}, mean train loss: {loss:.5f}")
+                if args.checkpoint:
+                    run.save(args.checkpoint)
+            final_acc = run.accuracy()
+    except HealthError as e:
+        # --health halt fired: the finding is already recorded (and the
+        # JSONL flushed) by the monitor; stop with a distinct exit code so
+        # drivers can tell "numerics blew up" from an infrastructure crash
+        print(f"HEALTH HALT: {e}", file=sys.stderr)
+        if metrics is not None:
+            metrics.close()
+            print(f"telemetry written: {args.metrics_out}")
+        sys.exit(3)
     print(
         f"Epoch: {run.epoch}, Time Spent: {time.time() - t0:.2f}s, "
         f"Accuracy: {final_acc * 100:.2f}%"
