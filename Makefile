@@ -6,8 +6,8 @@ CPU_MESH = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # verify needs bash (pipefail / PIPESTATUS)
 SHELL := /bin/bash
 
-.PHONY: test verify metrics-smoke report-smoke data train train-mesh bench \
-        bench-scaling schedules clean
+.PHONY: test verify metrics-smoke report-smoke audit-smoke data train \
+        train-mesh bench bench-scaling schedules clean
 
 test:
 	python -m pytest tests/ -q
@@ -34,6 +34,31 @@ report-smoke:
 	    --metrics-out /tmp/report_smoke.jsonl
 	python -m shallowspeed_tpu.observability.report /tmp/report_smoke.jsonl \
 	    --format md
+
+# XLA program audit end-to-end: 1 CPU epoch per layout (sequential, DP,
+# gpipe pipeline, ZeRO-1) with --audit — train.py itself raises (nonzero
+# exit) if the compiled collective census violates the layout contract —
+# then assert the schema-v3 xla_audit record landed census-clean and the
+# report CLI renders the Memory + Comms sections with exit 0 (needs data,
+# like metrics-smoke)
+audit-smoke:
+	rm -f /tmp/audit_seq.jsonl /tmp/audit_dp.jsonl /tmp/audit_pp.jsonl \
+	    /tmp/audit_z1.jsonl
+	$(CPU_MESH) python train.py --epochs 1 --no-eval --audit \
+	    --metrics-out /tmp/audit_seq.jsonl
+	$(CPU_MESH) python train.py --epochs 1 --no-eval --audit --dp 2 \
+	    --metrics-out /tmp/audit_dp.jsonl
+	$(CPU_MESH) python train.py --epochs 1 --no-eval --audit --pp 4 \
+	    --schedule gpipe --metrics-out /tmp/audit_pp.jsonl
+	$(CPU_MESH) python train.py --epochs 1 --no-eval --audit --dp 2 --pp 2 \
+	    --schedule gpipe --zero1 --metrics-out /tmp/audit_z1.jsonl
+	set -e; for f in /tmp/audit_seq /tmp/audit_dp /tmp/audit_pp /tmp/audit_z1; do \
+	  python -c "import json,sys; p=sys.argv[1]; recs=[json.loads(l) for l in open(p) if l.strip()]; a=[r for r in recs if r.get('kind')=='xla_audit']; assert a, p+': no xla_audit record'; assert all(r.get('census_ok') for r in a), p+': census mismatch'; print(p+': collective census matches the layout contract')" $$f.jsonl; \
+	  python -m shallowspeed_tpu.observability.report $$f.jsonl --format md > $$f.report.md; \
+	  grep -q "Memory (compiled program)" $$f.report.md; \
+	  grep -q "Comms (XLA program audit)" $$f.report.md; \
+	done
+	@echo "audit-smoke OK: census + memory + comms sections on all 4 layouts"
 
 data:
 	python prepare_data.py

@@ -3,7 +3,9 @@
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "samples/s", "vs_baseline": N,
      "config": <headline-config label>,
-     "value_fp32_highest": N|null, "vs_baseline_fp32_highest": N|null}
+     "value_fp32_highest": N|null, "vs_baseline_fp32_highest": N|null,
+     "peak_hbm_bytes": N|null  (compiled headline program's peak memory,
+      via the shared observability/program_audit.memory_stats path)}
 
 The headline ``value`` is the fused+DEFAULT-precision config
 (convergence-verified against the fp32 recipe — see main()); the
@@ -602,6 +604,26 @@ def _measure_child(precisions):
         except Exception as e:  # noqa: BLE001 — the cross-check is optional
             print(f"bench child: whole-run cross-check failed ({e!r})",
                   file=sys.stderr)
+        try:
+            # memory audit of the headline epoch program — the SAME shared
+            # memory_analysis path the capture script and the session
+            # audits read (observability/program_audit.memory_stats), so
+            # the published peak_hbm_bytes cannot drift from theirs.
+            # This is an extra AOT compile (the jit cache's executable is
+            # not reachable from here), run LAST on purpose: every
+            # measurement line is already flushed, so a watchdog kill
+            # during this compile loses only the memory field
+            from shallowspeed_tpu.observability.program_audit import memory_stats
+
+            epoch, params, X, Y = _jax_epoch_setup("default")
+            mem = memory_stats(epoch.lower(params, (), X, Y).compile())
+            if mem and mem.get("peak_hbm_bytes") is not None:
+                print(
+                    json.dumps({"peak_hbm_bytes": mem["peak_hbm_bytes"]}),
+                    flush=True,
+                )
+        except Exception as e:  # noqa: BLE001 — the audit is optional
+            print(f"bench child: memory audit failed ({e!r})", file=sys.stderr)
         sys.exit(0)
     except Exception as e:  # noqa: BLE001 — isolate cells below
         print(
@@ -690,6 +712,8 @@ def _run_measurements(precisions, timeout_s, attempts=2, force_cpu=False):
                     continue  # JSON-shaped noise (bare numbers/strings)
                 if "crosscheck_whole_run_sps" in rec:
                     results["_crosscheck"] = rec["crosscheck_whole_run_sps"]
+                elif "peak_hbm_bytes" in rec:
+                    results["_peak_hbm_bytes"] = rec["peak_hbm_bytes"]
                 elif "sps" in rec:
                     results[rec["precision"]] = rec["sps"]
                     meta[rec["precision"]] = {
@@ -1000,6 +1024,10 @@ def build_record(
         "mfu_fp32_highest": mfu32,
         "mfu_peak_flops": mfu_peak,
         "mfu_peak_source": mfu_src,
+        # compiled headline epoch program's peak memory, from the shared
+        # program_audit.memory_analysis path (null when the child's audit
+        # failed or a stub/preliminary record never measured)
+        "peak_hbm_bytes": results.get("_peak_hbm_bytes"),
         "config": "fused+default_precision (bf16-input MXU, fp32 accum; "
         "convergence-verified vs fp32 recipe)",
         "value_fp32_highest": (

@@ -795,16 +795,14 @@ def epoch_kernel_vmem_analysis(sizes=None, B=None, M=None):
         )
         params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
         compiled = epoch.lower(params, opt.init(params), X, Y).compile()
-        ma = compiled.memory_analysis()
+        # the ONE shared memory_analysis path (observability/program_audit):
+        # same field set as before plus the peak_hbm_bytes estimate, and the
+        # same helper TrainingSession audits and bench.py records use — the
+        # three byte accountings can never disagree
+        from shallowspeed_tpu.observability.program_audit import memory_stats
+
         rec = {"compiled_ok": True}
-        for field in (
-            "argument_size_in_bytes", "output_size_in_bytes",
-            "temp_size_in_bytes", "alias_size_in_bytes",
-            "generated_code_size_in_bytes",
-        ):
-            val = getattr(ma, field, None)
-            if val is not None:
-                rec[field] = int(val)
+        rec.update(memory_stats(compiled) or {})
         rec["predicted_kernel_bytes"] = pallas_ops._kernel_bytes(
             B, SIZES, state_mirrors=mirrors
         )

@@ -30,7 +30,7 @@ from shallowspeed_tpu import schedules as S
 from shallowspeed_tpu import trainer, utils
 from shallowspeed_tpu.checkpoint import load_checkpoint, save_checkpoint
 from shallowspeed_tpu.data import Dataset, default_data_dir
-from shallowspeed_tpu.observability import NullMetrics, costmodel
+from shallowspeed_tpu.observability import NullMetrics, costmodel, program_audit
 from shallowspeed_tpu.observability.flight import FlightRecorder
 from shallowspeed_tpu.observability.health import make_monitor
 from shallowspeed_tpu.optimizer import (
@@ -90,6 +90,7 @@ class TrainingSession:
         metrics=None,
         health=None,
         record_steps=None,
+        audit=False,
     ):
         # telemetry hook (observability package): None -> the zero-overhead
         # null backend. Everything the session emits — construction spans,
@@ -97,6 +98,15 @@ class TrainingSession:
         # records, MFU gauges, pipeline program stats — flows through this
         # one recorder (docs/observability.md).
         self._metrics = metrics if metrics is not None else NullMetrics()
+        # compiled-program audit (observability/program_audit.py): with a
+        # metrics recorder attached, the jit-time collective census +
+        # memory analysis is ALWAYS recorded (schema-v3 xla_audit record).
+        # ``audit=True`` additionally ENFORCES the layout's comms contract:
+        # the epoch/run program is compiled (even without metrics) and an
+        # AuditMismatchError is raised when its collective census violates
+        # the layout's analytical contract.
+        self._audit_strict = bool(audit)
+        self._audit_done = set()  # program names already audited
         # numerics health monitor: None, a policy string ("record" / "warn"
         # / "halt"), or a HealthMonitor instance (observability/health.py).
         # Checks run on host against the fused per-step aux after each
@@ -425,6 +435,20 @@ class TrainingSession:
             precision=self._precision_name,
             padded_flops_per_batch=padded,
         )
+        # the layout's analytical comms contract (required/forbidden
+        # collective kinds + bytes/step per mesh axis, derived from the
+        # lowered tick tables) — what the compiled program's collective
+        # census is audited against at jit time
+        self._expected_comms = program_audit.expected_comms(
+            self.spec,
+            dp,
+            pp,
+            prog=None if self._sequential else self._prog,
+            zero1=self._zero1,
+            mubatch_size=None if self._sequential else self._mubatch_local,
+            platform=platform,
+            precision=self._precision_name,
+        )
 
     # -- training -----------------------------------------------------------
 
@@ -446,17 +470,57 @@ class TrainingSession:
         second compile — a deliberate one-time cost for an isolated
         compile-time record, and the reason the first ``epoch`` event is
         stamped ``includes_compile`` (its wall/samples_per_sec are NOT
-        steady-state; consumers must not read them as such)."""
-        if not self._metrics.enabled or self._epoch_compiled:
+        steady-state; consumers must not read them as such).
+
+        ``audit=True`` also forces this compile (even metrics-less): the
+        program audit needs the compiled object to verify the layout's
+        collective contract before the first dispatch."""
+        if self._epoch_compiled or not (self._metrics.enabled or self._audit_strict):
             return
         with self._metrics.span("jit_compile"):
             compiled = self._epoch_fn.lower(*self._epoch_args()).compile()
         self._metrics.counter("jit_compiles")
-        self._epoch_compiled = True
         # cost-model cross-check at jit time: pull the compiled epoch
         # program's XLA-reported FLOPs/bytes next to the analytical count
         self._cost_model.attach_compiled(compiled)
+        # audit BEFORE latching the compiled flag: a strict mismatch must
+        # leave the session un-warmed, so a caller that catches the error
+        # and retries is re-audited (and re-refused), never silently
+        # trained on the mislowered program
+        self._record_audit(compiled, "epoch_program")
+        self._epoch_compiled = True
         self._record_cost_model()
+
+    def _record_audit(self, compiled, program, dedup=None):
+        """Jit-time XLA program audit (observability/program_audit.py):
+        census the compiled program's collectives, pull its memory
+        analysis, and emit one schema-v3 ``xla_audit`` record per DISTINCT
+        compiled program (``dedup`` names the compile variant; defaults to
+        the program label). Under ``audit=True`` a census that violates
+        the layout's analytical comms contract raises AuditMismatchError —
+        BEFORE the first dispatch, so a mislowered layout never trains a
+        step (the program is marked audited only on a pass: a
+        caught-and-retried failure re-audits and re-raises; its evidence
+        records duplicate, which is the honest trade)."""
+        dedup = dedup if dedup is not None else program
+        if dedup in self._audit_done:
+            return
+        rec = program_audit.audit_compiled(
+            compiled,
+            expected=self._expected_comms,
+            platform=self._cost_model.platform,
+            n_devices=self._cost_model.n_devices,
+        )
+        if self._metrics.enabled:
+            self._metrics.audit(program, **rec)
+            self._metrics.flush()  # the mismatch evidence must hit disk first
+        if self._audit_strict and rec.get("census_ok") is False:
+            raise program_audit.AuditMismatchError(
+                f"{program}: compiled collective census disagrees with the "
+                f"layout contract (dp={self.dp}, pp={self.pp}, "
+                f"zero1={self._zero1}): " + "; ".join(rec["mismatches"])
+            )
+        self._audit_done.add(dedup)
 
     def _record_cost_model(self):
         """Emit the cost_model event + model_flops gauge. Emitted once per
@@ -588,9 +652,11 @@ class TrainingSession:
             raise ValueError("epochs must be positive")
         if with_eval and self._vx is None:
             self._load_val()
-        if self._metrics.enabled:
+        if self._metrics.enabled or self._audit_strict:
             # AOT-compile first (inside warm_run's jit_compile span) so the
-            # recorded dispatch wall time is steady-state execution
+            # recorded dispatch wall time is steady-state execution — and,
+            # under audit=True, so the run program's collective census is
+            # verified before it ever dispatches
             self.warm_run(epochs, with_eval=with_eval)
         start = self.epoch
         t0 = time.perf_counter()
@@ -668,12 +734,22 @@ class TrainingSession:
         key = (with_eval, epochs)
         if key not in self._compiled_runs:
             with self._metrics.span("jit_compile"):
-                self._compiled_runs[key] = (
+                compiled = (
                     self._fused_run_fn(with_eval)
                     .lower(*self._fused_run_args(with_eval), epochs)
                     .compile()
                 )
             self._metrics.counter("jit_compiles")
+            # run-program audit BEFORE caching the executable: same layout
+            # contract as the epoch program (the fused run is the same
+            # collectives scanned over epochs, plus the eval relay) — a
+            # fused-run-only session still gets its census verified, and a
+            # strict mismatch leaves nothing cached for a retry to dispatch.
+            # Dedup per (with_eval, epochs) VARIANT: each distinct compile
+            # is a distinct program and every one that can dispatch must
+            # have been audited
+            self._record_audit(compiled, "run_program", dedup=("run", key))
+            self._compiled_runs[key] = compiled
             # fused-run-only sessions still get the cost_model event (the
             # analytical leg; the XLA cross-check stays tied to the EPOCH
             # program so its per-epoch FLOPs aren't diluted by fused eval)

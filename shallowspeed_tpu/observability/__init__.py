@@ -31,6 +31,13 @@ evidence trail instead of prose:
 - ``costmodel``    analytical MLP FLOPs + ``Compiled.cost_analysis()``
                    cross-check + MFU accounting (``model_flops``,
                    ``achieved_flops_per_sec``, ``mfu`` gauges per layout);
+- ``program_audit`` the XLA program audit: collective census parsed from
+                   ``Compiled.as_text()``, ``memory_analysis()`` through
+                   one shared helper, the analytical comms model derived
+                   from the layout + lowered tick tables, and the
+                   census-vs-contract cross-check that fails loudly
+                   (``TrainingSession(audit=True)`` / ``train.py --audit``;
+                   schema-v3 ``xla_audit`` records);
 - ``report``       the run-report CLI
                    (``python -m shallowspeed_tpu.observability.report``):
                    throughput, MFU, span breakdown, bubble fraction,
@@ -57,10 +64,12 @@ from shallowspeed_tpu.observability.metrics import (
     NullMetrics,
     read_jsonl,
 )
+from shallowspeed_tpu.observability.program_audit import AuditMismatchError
 from shallowspeed_tpu.observability.spans import Span, capture, span
 
 __all__ = [
     "SCHEMA_VERSION",
+    "AuditMismatchError",
     "FlightRecorder",
     "HealthError",
     "HealthMonitor",

@@ -37,6 +37,9 @@ Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
      "epoch": e, "loss": ..., "grad_norm": ..., "param_norm": ...}   [v2+]
     {"v": 2, "ts": ..., "kind": "health",    "name": <check>, "epoch": e,
      "step": i|null, "action": "record"|"warn"|"halt", **finding}    [v2+]
+    {"v": 3, "ts": ..., "kind": "xla_audit", "name": <program>,
+     "census": {...}, "memory": {...}, "expected": {...},
+     "census_ok": bool|null, **audit}                                [v3+]
 
 Schema compatibility rules (SCHEMA_VERSION history):
 
@@ -47,23 +50,36 @@ Schema compatibility rules (SCHEMA_VERSION history):
   ``read_jsonl`` strict check is one-directional: it refuses records
   NEWER than the reader, never older). A v1 reader fed a v2 file will
   refuse it loudly — that is the point of the stamp.
+- v3  ADDITIVE: the ``xla_audit`` kind (compiled-program collective
+  census + memory analysis + comms-contract verdict, emitted at jit
+  time — observability/program_audit.py). Again no existing kind or
+  field changed meaning, so the v3 reader accepts v1 AND v2 files
+  unchanged and the strict refusal stays one-directional.
 
 The contract for future bumps: additive kinds/fields bump the version and
 must keep old records readable; any change to an EXISTING kind's meaning
 requires a new kind name instead. Consumers must ignore unknown fields on
 known kinds.
 
+Multihost: a ``JsonlMetrics`` constructed under ``jax.process_count() > 1``
+appends a ``.p{process_index}`` suffix to its path — concurrent hosts
+each own one shard and can never interleave writes into one file.
+``read_jsonl`` accepts a glob (``run.jsonl.p*``) and, given a bare path
+that does not exist, falls back to its ``.p*`` shards automatically.
+
 The span taxonomy and the metric names the framework itself emits are
 documented in docs/observability.md.
 """
 
+import glob as _glob
 import json
 import math
+import os
 import time
 
 from shallowspeed_tpu.observability.spans import Span
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 SCHEMA_NAME = "shallowspeed_tpu.metrics"
 
 
@@ -114,6 +130,9 @@ class NullMetrics:
     def health(self, name, **fields):
         pass
 
+    def audit(self, name, **fields):
+        pass
+
     def flush(self):
         pass
 
@@ -141,7 +160,12 @@ class MetricsRecorder:
                    streams are filterable without name conventions;
     - ``health``   one numerics-monitor finding (schema v2), named by the
                    check that fired (``non_finite``/``loss_divergence``/
-                   ``grad_spike``).
+                   ``grad_spike``);
+    - ``audit``    one compiled-program audit (schema v3, kind
+                   ``xla_audit``), named by the program it describes
+                   (``epoch_program``/``run_program``): collective census,
+                   memory analysis, comms-contract verdict
+                   (observability/program_audit.py).
     """
 
     enabled = True
@@ -181,6 +205,9 @@ class MetricsRecorder:
 
     def health(self, name, **fields):
         self._emit({"kind": "health", "name": name, **fields})
+
+    def audit(self, name, **fields):
+        self._emit({"kind": "xla_audit", "name": name, **fields})
 
     # -- recorder-internal hooks --------------------------------------------
 
@@ -283,14 +310,20 @@ class JsonlMetrics(MetricsRecorder):
 
     ``flush_every``: flush the OS buffer every N records (1 = every record;
     per-epoch recording volumes make this free either way).
+
+    Multihost: under ``jax.process_count() > 1`` the path gains a
+    ``.p{process_index}`` suffix — every host owns its shard, so
+    concurrent processes can never interleave half-lines into one file
+    (``self.path`` reports the EFFECTIVE path; ``read_jsonl`` reads the
+    shard set back via glob or the automatic ``.p*`` fallback).
     """
 
     def __init__(self, path, mode="w", flush_every=1):
         super().__init__()
-        self.path = path
+        self.path = _shard_path(path)
         self._flush_every = max(1, int(flush_every))
         self._since_flush = 0
-        self._f = open(path, mode, encoding="utf-8")
+        self._f = open(self.path, mode, encoding="utf-8")
         self._emit(
             {
                 "kind": "meta",
@@ -334,8 +367,64 @@ class JsonlMetrics(MetricsRecorder):
         return False
 
 
+def _shard_path(path):
+    """The process-local JSONL path: ``path.p{process_index}`` when more
+    than one jax process is live (multihost runs must never share one
+    append target), the path unchanged otherwise — including when jax is
+    absent or uninitialized (the sink must not force a jax dependency).
+
+    The probe checks the DISTRIBUTED runtime state first (multihost
+    compat helper) and only asks ``jax.process_count()`` — which
+    initializes the backend as a side effect — once distributed is known
+    to be up. Consequence: construct the sink AFTER
+    ``jax.distributed.initialize()`` / ``parallel.multihost.initialize()``
+    — a sink constructed before it cannot see the process set and will
+    not shard."""
+    path = os.fspath(path)
+    try:
+        from shallowspeed_tpu.parallel.multihost import (
+            _distributed_is_initialized,
+        )
+
+        if not _distributed_is_initialized():
+            return path  # single-process, or distributed not up yet
+        import jax
+
+        if jax.process_count() > 1:
+            return f"{path}.p{jax.process_index()}"
+    except Exception:  # noqa: BLE001 — best-effort probe, never a crash
+        pass
+    return path
+
+
+def _expand_shards(path):
+    """``read_jsonl`` path resolution: an existing file is read as-is
+    (even when its name contains glob metacharacters); otherwise an
+    explicit glob expands to its sorted matches, and a bare path falls
+    back to its multihost ``.p*`` shards (what ``JsonlMetrics`` wrote
+    under ``process_count() > 1``)."""
+    s = os.fspath(path)
+    if os.path.exists(s):
+        return [s]
+    if any(c in s for c in "*?["):
+        shards = sorted(_glob.glob(s))
+        if not shards:
+            raise FileNotFoundError(f"no metrics files match glob {s!r}")
+        return shards
+    # only writer-shaped shards (".p" + digits) — a neighbor like
+    # "run.jsonl.partial" must never be silently merged as a shard
+    shards = sorted(_glob.glob(_glob.escape(s) + ".p[0-9]*"))
+    if shards:
+        return shards
+    return [s]
+
+
 def read_jsonl(path, strict=True):
     """Load a metrics JSONL file back into a list of record dicts.
+
+    ``path`` may be a single file, a glob (``run.jsonl.p*`` — multihost
+    shards are read in sorted order and concatenated), or a bare path whose
+    ``.p*`` shards exist (the multihost auto-fallback).
 
     ``strict=True`` (default) raises on records whose schema version is
     newer than this reader understands — refusing loudly beats silently
@@ -343,16 +432,17 @@ def read_jsonl(path, strict=True):
     this repo follows). Blank lines are skipped; malformed lines raise.
     """
     records = []
-    with open(path, encoding="utf-8") as f:
-        for i, line in enumerate(f):
-            line = line.strip()
-            if not line:
-                continue
-            rec = json.loads(line)
-            if strict and rec.get("v", 0) > SCHEMA_VERSION:
-                raise ValueError(
-                    f"{path}:{i + 1}: record schema v{rec.get('v')} is newer "
-                    f"than this reader (v{SCHEMA_VERSION})"
-                )
-            records.append(rec)
+    for shard in _expand_shards(path):
+        with open(shard, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if strict and rec.get("v", 0) > SCHEMA_VERSION:
+                    raise ValueError(
+                        f"{shard}:{i + 1}: record schema v{rec.get('v')} is "
+                        f"newer than this reader (v{SCHEMA_VERSION})"
+                    )
+                records.append(rec)
     return records

@@ -12,6 +12,12 @@ human or a bench gate actually asks of a run:
   ``includes_compile`` are excluded — their wall clock is compile, not
   training; if ONLY such records exist the report says so rather than
   silently quoting a compile-polluted number);
+- the compiled-program audit (schema-v3 ``xla_audit`` records,
+  ``train.py --audit``): a MEMORY section (peak HBM vs per-chip capacity
+  -> headroom, or an OOM forecast when the program exceeds it) and a
+  COMMS section (collective census vs the layout contract, analytical
+  bytes/step per device, bandwidth-bound lower-bound step time vs the
+  compute lower bound -> comms- vs compute-bound verdict);
 - MFU + achieved FLOP/s and the cost-model cross-check (analytical vs
   XLA-reported FLOPs), with the peak's provenance so a nominal-CPU MFU
   cannot pass for a datasheet one;
@@ -34,6 +40,7 @@ import sys
 from pathlib import Path
 
 from shallowspeed_tpu.observability.metrics import read_jsonl
+from shallowspeed_tpu.observability.program_audit import format_bytes
 
 BLOCKS = "▁▂▃▄▅▆▇█"  # ▁▂▃▄▅▆▇█
 
@@ -130,6 +137,18 @@ def build_report(records, source=""):
                 k: v for k, v in r.items() if k not in ("v", "ts", "kind", "name")
             }
 
+    audit = None
+    audit_is_epoch = False
+    for r in records:
+        if r.get("kind") == "xla_audit":
+            # last record wins, but prefer the epoch program over the fused
+            # run (its census is the canonical per-step story): i.e. the
+            # LAST epoch_program record, else the last audit of any name
+            is_epoch = r.get("name") == "epoch_program"
+            if is_epoch or not audit_is_epoch:
+                audit = {k: v for k, v in r.items() if k not in ("v", "ts", "kind")}
+                audit_is_epoch = audit_is_epoch or is_epoch
+
     prog = None
     for r in records:
         if r.get("kind") == "event" and r.get("name") == "pipeline_program":
@@ -185,6 +204,7 @@ def build_report(records, source=""):
         "mfu_includes_compile": mfu_includes_compile,
         "achieved_flops_per_sec": gauges.get("achieved_flops_per_sec"),
         "cost_model": cost,
+        "xla_audit": audit,
         "bubble_fraction": bubble,
         "spans": span_rows,
         "steps": len(steps),
@@ -212,10 +232,11 @@ def build_report(records, source=""):
 def baseline_throughput(path):
     """-> ``(samples_per_sec, label)`` from a baseline file, or ``(None,
     reason)``. ``.jsonl`` is another metrics stream (same steady-state
-    rules); ``.json`` accepts a bench record (``value`` + samples/s unit)
+    rules; multihost shard names/globs like ``run.jsonl.p*`` count too);
+    ``.json`` accepts a bench record (``value`` + samples/s unit)
     or a tpu_capture artifact (``headline_best_sps``)."""
     p = Path(path)
-    if p.suffix == ".jsonl":
+    if p.suffix == ".jsonl" or ".jsonl." in p.name:
         base = build_report(read_jsonl(p), source=str(p))
         tp = base["throughput_samples_per_sec"]
         if tp is None:
@@ -331,6 +352,106 @@ def _cost_lines(cost):
     return lines
 
 
+def _fmt_time_s(t):
+    if t is None or not isinstance(t, (int, float)) or not math.isfinite(t):
+        return "n/a"
+    if t >= 1.0:
+        return f"{t:.3f} s"
+    if t >= 1e-3:
+        return f"{t * 1e3:.2f} ms"
+    return f"{t * 1e6:.1f} µs"
+
+
+def _memory_lines(audit, md):
+    """The memory section: compiled-program peak HBM vs per-chip capacity
+    -> headroom, or an OOM forecast when the program does not fit."""
+    mem = (audit or {}).get("memory")
+    if not mem:
+        return []
+    lines = ["## Memory (compiled program)" if md else "memory (compiled program):"]
+    peak = mem.get("peak_hbm_bytes")
+    cap = audit.get("hbm_per_chip")
+    head = audit.get("hbm_headroom_fraction")
+    # memory_analysis sizes are per device (the addressable shard), so the
+    # peak compares against one chip's capacity directly
+    line = f"peak HBM: {format_bytes(peak)} (per device)"
+    if cap and head is not None:
+        if head < 0:
+            line += (
+                f" — OOM FORECAST: exceeds the {format_bytes(cap)}/chip "
+                f"capacity ({audit.get('hbm_source')}) by "
+                f"{format_bytes(-head * cap)}"
+            )
+        else:
+            line += (
+                f" of {format_bytes(cap)}/chip ({audit.get('hbm_source')}) "
+                f"— {head * 100:.1f}% headroom"
+            )
+    lines.append(line)
+    lines.append(
+        "  args {a} + output {o} + temp {t} (aliased {al})".format(
+            a=format_bytes(mem.get("argument_size_in_bytes")),
+            o=format_bytes(mem.get("output_size_in_bytes")),
+            t=format_bytes(mem.get("temp_size_in_bytes")),
+            al=format_bytes(mem.get("alias_size_in_bytes")),
+        )
+    )
+    lines.append("")
+    return lines
+
+
+def _comms_lines(audit, md):
+    """The comms section: the compiled program's collective census vs the
+    layout contract, the analytical bytes/step, and the bandwidth-bound
+    lower-bound verdict next to the compute bound."""
+    if not audit:
+        return []
+    census = audit.get("census") or {}
+    exp = audit.get("expected") or {}
+    lines = ["## Comms (XLA program audit)" if md else "comms (XLA program audit):"]
+    if census:
+        kinds = ", ".join(
+            f"{k} x{v['count']} ({format_bytes(v['bytes'])})"
+            for k, v in sorted(census.items())
+        )
+    elif audit.get("hlo_available") is False:
+        kinds = "unavailable (backend exposed no HLO text)"
+    elif exp.get("sequential"):
+        kinds = "none (sequential program)"
+    else:
+        kinds = "none"
+    ok = audit.get("census_ok")
+    if ok is True:
+        verdict = "matches the layout contract"
+    elif ok is False:
+        verdict = "CONTRACT MISMATCH: " + "; ".join(audit.get("mismatches", ()))
+    else:
+        verdict = "contract not checked"
+    lines.append(f"census [{audit.get('name', 'program')}]: {kinds} — {verdict}")
+    if exp:
+        parts = []
+        for axis, a in sorted((exp.get("axes") or {}).items()):
+            parts.append(
+                f"{axis} {a.get('kind')} {format_bytes(a.get('bytes_per_step_per_device'))}"
+            )
+        total = exp.get("bytes_per_step_per_device")
+        line = f"model: {format_bytes(total)}/step/device"
+        if parts:
+            line += " (" + " + ".join(parts) + ")"
+        lines.append(line)
+        ct, xt = exp.get("comms_time_per_step_s"), exp.get("compute_time_per_step_s")
+        if ct is not None or xt is not None:
+            bound = exp.get("bound")
+            lines.append(
+                f"lower bounds: comms {_fmt_time_s(ct)} @ "
+                f"{_fmt_num(exp.get('bandwidth_bytes_per_sec'), 'B/s')} "
+                f"({exp.get('bandwidth_source')}) vs compute {_fmt_time_s(xt)}"
+                + (f" — {bound}-bound" if bound else "")
+            )
+    lines.append("")
+    return lines
+
+
 def render(report, fmt, comparison=None):
     if fmt == "json":
         out = dict(report)
@@ -352,6 +473,8 @@ def render(report, fmt, comparison=None):
     lines.append("")
     lines.extend(_cost_lines(report["cost_model"]))
     lines.append("")
+    lines.extend(_memory_lines(report.get("xla_audit"), md))
+    lines.extend(_comms_lines(report.get("xla_audit"), md))
     header = "## Span breakdown" if md else "span breakdown:"
     lines.append(header)
     if report["spans"]:

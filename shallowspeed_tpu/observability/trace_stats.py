@@ -25,6 +25,29 @@ import json
 import sys
 from pathlib import Path
 
+# Device-op name prefixes that are COMMUNICATION, not compute — the HLO
+# collective spellings (incl. their async -start/-done halves) plus the
+# point-to-point ops. Everything else on the device timeline counts as
+# compute, so ``comm_fraction`` is directly comparable against the
+# analytical comms model's bound verdict (program_audit.expected_comms).
+COMM_OP_PREFIXES = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+    "collective-broadcast",
+    "send",
+    "recv",
+)
+
+
+def is_comm_op(name):
+    """True when a device-op name is a communication op (collective or
+    point-to-point), by HLO-name prefix."""
+    n = str(name).lower()
+    return n.startswith(COMM_OP_PREFIXES)
+
 
 def find_traces(path):
     """A file path as-is, or every ``*.trace.json.gz`` under a directory."""
@@ -41,9 +64,14 @@ def summarize(trace_path):
     the device timeline), ``busy_ms`` (summed op durations), ``ns_per_op_issued``
     (serial issue rate — the latency-roofline number), ``unit_overlap``
     (busy/span; >1 means functional units overlap, the op stream rather than
-    FLOPs is the bottleneck when this is high while MXU% is low), and
-    ``top_ops`` (count per op-name prefix). ``{"device_ops": 0}`` when the
-    trace holds no device ops.
+    FLOPs is the bottleneck when this is high while MXU% is low),
+    ``top_ops`` (count per op-name prefix), and the comm/compute split —
+    ``comm_ops`` / ``comm_ms`` / ``compute_ms`` / ``comm_fraction`` (comm
+    busy time over total busy time, classified by ``is_comm_op``) — so the
+    MEASURED communication share of a capture is directly comparable
+    against the analytical comms model's verdict
+    (program_audit.expected_comms). ``{"device_ops": 0}`` when the trace
+    holds no device ops.
     """
     with gzip.open(trace_path) as f:
         tr = json.load(f)
@@ -77,6 +105,8 @@ def summarize(trace_path):
     t1 = max(e["ts"] + e.get("dur", 0) for e in ops)
     span_us = t1 - t0
     busy_us = sum(e.get("dur", 0) for e in ops)
+    comm = [e for e in ops if is_comm_op(e["name"])]
+    comm_us = sum(e.get("dur", 0) for e in comm)
     kinds = collections.Counter(e["name"].split(".")[0] for e in ops)
     return {
         "trace": str(trace_path),
@@ -89,6 +119,12 @@ def summarize(trace_path):
         # >1 means functional units overlap; the op stream, not FLOPs,
         # is the bottleneck when this is high while MXU% is low
         "unit_overlap": round(busy_us / span_us, 2),
+        # the measured comm/compute split (busy-time attribution) — the
+        # observed counterpart of the comms model's bound verdict
+        "comm_ops": len(comm),
+        "comm_ms": round(comm_us / 1e3, 3),
+        "compute_ms": round((busy_us - comm_us) / 1e3, 3),
+        "comm_fraction": round(comm_us / busy_us, 4) if busy_us else 0.0,
         "top_ops": dict(kinds.most_common(8)),
     }
 

@@ -169,6 +169,38 @@ def program_flops(prog, spec, mubatch_size):
     return (2 * n_fwd + 4 * n_bwd) * mubatch_size * padded_p
 
 
+def program_comm_bytes(prog, spec, mubatch_size):
+    """Analytical inter-stage traffic for ONE execution of this tick program
+    — the pp-axis leg of the observability comms model
+    (observability/program_audit.expected_comms).
+
+    The executor relays with TWO uniform ``lax.ppermute``s (one per
+    direction) EVERY tick, payload ``(mubatch_size, relay_width)`` f32 —
+    masked no-op ticks ship zero payloads, but they are shipped (that
+    uniformity is what makes the program SPMD), so the wire bytes each
+    device moves per step are ``2 * num_ticks * payload``. The useful
+    bytes (ticks whose send tables actually emit) ride alongside so the
+    relay's own padding tax is a recorded number too. Computed from the
+    ACTUAL tick tables, like ``program_stats``/``program_flops``.
+
+    Returns plain scalars (JSON-able as-is): ``relay_payload_bytes`` (one
+    direction, one tick), ``wire_bytes_per_device`` (2 x ticks x payload),
+    ``useful_bytes_per_device`` (mean over devices of the send-table
+    bytes), ``useful_sends`` (total send-table count), ``num_ticks``.
+    """
+    from shallowspeed_tpu.parallel.executor import relay_width
+
+    payload = 4 * mubatch_size * relay_width(spec)
+    useful_sends = int(np.sum(prog.send_fwd) + np.sum(prog.send_bwd))
+    return {
+        "relay_payload_bytes": int(payload),
+        "num_ticks": int(prog.num_ticks),
+        "wire_bytes_per_device": int(2 * prog.num_ticks * payload),
+        "useful_sends": useful_sends,
+        "useful_bytes_per_device": useful_sends * payload / prog.num_stages,
+    }
+
+
 def parse_stage_stream(commands, stage_id, num_stages, training=True, num_chunks=1):
     """Flatten one device's instruction stream into WorkItems + validate.
 

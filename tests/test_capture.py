@@ -478,6 +478,9 @@ def test_epoch_kernel_vmem_analysis_real_body(capture_mod):
         assert rec[name]["compiled_ok"] is True
         assert rec[name]["fits_predicate"] is True
         assert rec[name]["predicted_kernel_bytes"] > 0
+        # memory fields come through the SHARED program_audit.memory_stats
+        # helper now — same field set as before plus the peak estimate
+        assert rec[name]["peak_hbm_bytes"] > 0
     assert rec["adam"]["predicted_kernel_bytes"] > rec["sgd"]["predicted_kernel_bytes"]
     assert rec["budget_bytes"] > 0
 

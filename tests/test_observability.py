@@ -103,6 +103,7 @@ def test_null_metrics_hot_path_zero_net_allocation():
                 pass
             with m.span("s"):
                 pass
+            m.audit("a")  # the v3 audit hook keeps the guarantee too
 
     burst(100)  # warm up caches (method cache, code objects)
     # background threads (XLA's pools) can allocate a handful of blocks at
@@ -203,6 +204,36 @@ def test_trace_stats_summarize_synthetic(tmp_path):
     assert s["ns_per_op_issued"] == 30000.0  # 60us / 2 ops
     assert abs(s["unit_overlap"] - 0.67) < 1e-9
     assert s["top_ops"] == {"fusion": 1, "convolution": 1}
+    # an all-compute trace: the comm split exists and is zero
+    assert s["comm_ops"] == 0 and s["comm_ms"] == 0.0
+    assert s["compute_ms"] == 0.04 and s["comm_fraction"] == 0.0
+
+
+def test_trace_stats_comm_compute_split(tmp_path):
+    """Device ops split into comm vs compute by HLO-name prefix — the
+    measured comm share the analytical comms model's bound verdict is
+    compared against (docs/observability.md)."""
+    trace = tmp_path / "comm.trace.json.gz"
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.1", "ts": 0, "dur": 30},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "all-reduce.3", "ts": 30,
+         "dur": 10},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "collective-permute-start.1",
+         "ts": 40, "dur": 15},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "reduce-scatter.2", "ts": 55,
+         "dur": 5},
+    ]
+    with gzip.open(trace, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    s = trace_stats.summarize(trace)
+    assert s["comm_ops"] == 3
+    assert s["comm_ms"] == 0.03  # 10 + 15 + 5 us
+    assert s["compute_ms"] == 0.03
+    assert s["comm_fraction"] == 0.5
+    assert trace_stats.is_comm_op("all-gather-done.7")
+    assert not trace_stats.is_comm_op("fusion.all")
 
 
 def test_trace_stats_find_traces_and_empty(tmp_path):
@@ -482,22 +513,118 @@ def test_jsonl_stays_strict_json_under_non_finite_values(tmp_path):
     assert recs[3]["nested"]["a"] == [1.0, "NaN"]
 
 
-def test_schema_v2_step_and_health_kinds(tmp_path):
-    """Schema v2: the step/health record kinds round-trip with the version
-    stamp, and NullMetrics no-ops them."""
-    assert SCHEMA_VERSION == 2
-    path = tmp_path / "v2.jsonl"
+def test_schema_v2_and_v3_kinds(tmp_path):
+    """Schema v2/v3: the step/health/xla_audit record kinds round-trip with
+    the version stamp, and NullMetrics no-ops them."""
+    assert SCHEMA_VERSION == 3
+    path = tmp_path / "v3.jsonl"
     with JsonlMetrics(path) as m:
         m.step("train", step=0, epoch=0, loss=0.5, grad_norm=0.1, param_norm=9.0)
         m.health("non_finite", epoch=0, step=3, action="warn", detail="x")
+        m.audit(
+            "epoch_program",
+            census={"all_reduce": {"count": 14, "bytes": 4096}},
+            census_ok=True,
+        )
     recs = read_jsonl(path)
-    assert [r["kind"] for r in recs] == ["meta", "step", "health"]
-    assert all(r["v"] == 2 for r in recs)
+    assert [r["kind"] for r in recs] == ["meta", "step", "health", "xla_audit"]
+    assert all(r["v"] == 3 for r in recs)
     assert recs[1]["step"] == 0 and recs[1]["param_norm"] == 9.0
     assert recs[2]["name"] == "non_finite" and recs[2]["action"] == "warn"
+    assert recs[3]["name"] == "epoch_program" and recs[3]["census_ok"] is True
+    assert recs[3]["census"]["all_reduce"]["count"] == 14
     n = NullMetrics()
     n.step("train", loss=0.5)
     n.health("non_finite", step=1)
+    n.audit("epoch_program", census_ok=True)
+
+
+def test_schema_v3_reader_accepts_v1_and_v2_unchanged(tmp_path):
+    """The compat contract (docs/observability.md): v3 is additive, so the
+    v3 reader accepts v1 AND v2 files unchanged, the strict refusal stays
+    one-directional (only records NEWER than the reader), and the new
+    xla_audit kind round-trips through the non-finite-float sanitizer."""
+    # v1 and v2 files, as their writers produced them
+    v1 = tmp_path / "v1.jsonl"
+    v1.write_text(
+        json.dumps({"v": 1, "ts": 0.0, "kind": "event", "name": "epoch",
+                    "epoch": 0, "loss": 0.5}) + "\n"
+    )
+    v2 = tmp_path / "v2.jsonl"
+    v2.write_text(
+        json.dumps({"v": 2, "ts": 0.0, "kind": "step", "name": "train",
+                    "step": 0, "loss": 0.5}) + "\n"
+        + json.dumps({"v": 2, "ts": 0.0, "kind": "health",
+                      "name": "non_finite", "action": "warn"}) + "\n"
+    )
+    assert read_jsonl(v1)[0]["loss"] == 0.5
+    assert [r["kind"] for r in read_jsonl(v2)] == ["step", "health"]
+    # one-directional: only NEWER records are refused
+    v4 = tmp_path / "v4.jsonl"
+    v4.write_text(json.dumps({"v": SCHEMA_VERSION + 1, "kind": "event"}) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        read_jsonl(v4)
+    # xla_audit through the sanitizer: a non-finite nested field (e.g. an
+    # unknown-peak division) stays STRICT JSON
+    path = tmp_path / "audit.jsonl"
+    with JsonlMetrics(path) as m:
+        m.audit(
+            "epoch_program",
+            expected={"comms_time_per_step_s": float("inf"),
+                      "bytes": [1.0, float("nan")]},
+            census_ok=True,
+        )
+    raw = [json.loads(l, parse_constant=lambda s: (_ for _ in ()).throw(
+        ValueError(s))) for l in path.read_text().splitlines()]
+    assert raw[1]["expected"]["comms_time_per_step_s"] == "Infinity"
+    assert raw[1]["expected"]["bytes"] == [1.0, "NaN"]
+    assert read_jsonl(path)[1]["census_ok"] is True
+
+
+def test_jsonl_multihost_shard_suffix_and_glob_read(tmp_path, monkeypatch):
+    """Multihost JSONL safety: under process_count > 1 every host writes
+    its own .p{index} shard (no interleaved writes into one file), and
+    read_jsonl accepts a glob of shards — plus the bare-path auto-fallback
+    the report CLI rides."""
+    import jax
+
+    from shallowspeed_tpu.parallel import multihost
+
+    base = tmp_path / "multi.jsonl"
+    # a live 2-process distributed runtime, as the probe sees it (the
+    # compat gate first — it keeps the probe from initializing the
+    # backend in single-process runs — then the public process surface)
+    monkeypatch.setattr(multihost, "_distributed_is_initialized", lambda: True)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    with JsonlMetrics(base) as m:
+        assert m.path == str(base) + ".p1"
+        m.event("epoch", epoch=0, loss=0.5)
+    assert not base.exists()
+    shard1 = tmp_path / "multi.jsonl.p1"
+    assert shard1.exists()
+    # a second host's shard, written independently
+    shard0 = tmp_path / "multi.jsonl.p0"
+    shard0.write_text(
+        json.dumps({"v": SCHEMA_VERSION, "ts": 0.0, "kind": "event",
+                    "name": "epoch", "epoch": 0, "loss": 0.25}) + "\n"
+    )
+    # explicit glob: sorted shard order, concatenated
+    recs = read_jsonl(str(base) + ".p*")
+    assert [r["loss"] for r in recs if r["kind"] == "event"] == [0.25, 0.5]
+    # bare-path fallback: the unsharded name resolves to its shards
+    recs2 = read_jsonl(base)
+    assert len(recs2) == len(recs)
+    # a missing glob refuses loudly
+    with pytest.raises(FileNotFoundError):
+        read_jsonl(str(tmp_path / "nope-*.jsonl"))
+
+
+def test_shard_path_single_process_is_identity(tmp_path):
+    """With one jax process (the normal case) the path is untouched."""
+    from shallowspeed_tpu.observability.metrics import _shard_path
+
+    assert _shard_path(tmp_path / "x.jsonl") == str(tmp_path / "x.jsonl")
 
 
 @pytest.mark.parametrize(

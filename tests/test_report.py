@@ -253,7 +253,7 @@ def test_report_mfu_carries_compile_caveat(tmp_path, capsys):
 
 
 def test_report_accepts_schema_v1_files(tmp_path, capsys):
-    """The v2 reader/report accept v1 files unchanged (compat rule)."""
+    """The v3 reader/report accept v1 files unchanged (compat rule)."""
     path = tmp_path / "v1.jsonl"
     v1 = [
         {"v": 1, "ts": 0.0, "kind": "meta", "name": "metrics",
@@ -304,3 +304,38 @@ def test_report_unreadable_run_exits_1(tmp_path, capsys):
     missing = tmp_path / "nope.jsonl"
     assert report.main([str(missing)]) == 1
     assert "cannot read" in capsys.readouterr().err
+
+
+def test_report_without_audit_records_omits_sections(tmp_path, capsys):
+    """No xla_audit record -> no Memory/Comms sections (and no crash);
+    the JSON rendering carries xla_audit: null so consumers can tell
+    'not audited' from 'audited clean'."""
+    path = tmp_path / "plain.jsonl"
+    with JsonlMetrics(path) as m:
+        m.event("epoch", epoch=0, loss=0.5, samples_per_sec=10.0, wall_s=1.0)
+    rep = report.build_report(read_jsonl(path))
+    assert rep["xla_audit"] is None
+    assert report.main([str(path), "--format", "md"]) == 0
+    out = capsys.readouterr().out
+    assert "Memory (compiled program)" not in out
+    assert "Comms (XLA program audit)" not in out
+    assert report.main([str(path), "--format", "json"]) == 0
+    assert json.loads(capsys.readouterr().out)["xla_audit"] is None
+
+
+def test_report_reads_multihost_shard_glob(tmp_path, capsys):
+    """The report CLI accepts a glob of multihost JSONL shards (and the
+    bare-path fallback): per-host epoch records merge into one report."""
+    for idx, loss in ((0, 0.5), (1, 0.25)):
+        (tmp_path / f"run.jsonl.p{idx}").write_text(
+            json.dumps({"v": SCHEMA_VERSION, "ts": float(idx), "kind": "event",
+                        "name": "epoch", "epoch": 0, "loss": loss,
+                        "samples_per_sec": 100.0, "wall_s": 1.0}) + "\n"
+        )
+    glob_arg = str(tmp_path / "run.jsonl.p*")
+    assert report.main([glob_arg, "--format", "json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["epochs"] == 2
+    # bare path that never existed resolves to its shards
+    assert report.main([str(tmp_path / "run.jsonl"), "--format", "json"]) == 0
+    assert json.loads(capsys.readouterr().out)["epochs"] == 2

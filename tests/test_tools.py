@@ -289,6 +289,14 @@ def test_build_record_mfu_companions():
         {}, {}, 1000.0, "_STUB_NOT_MEASURED", True, stub=True
     )
     assert r["mfu"] is None and r["mfu_peak_flops"] is None
+    # peak_hbm_bytes rides the record when the child's memory audit (the
+    # shared program_audit.memory_stats path) reported one — null otherwise
+    assert r["peak_hbm_bytes"] is None
+    r, _ = bench.build_record(
+        {"default": 5e4, "_peak_hbm_bytes": 123456}, {"default": cpu},
+        1000.0, "", False,
+    )
+    assert r["peak_hbm_bytes"] == 123456
 
 
 def test_bench_publishes_before_spending_tunnel_patience(monkeypatch, capsys):

@@ -110,6 +110,18 @@ def main():
         "`python -m shallowspeed_tpu.observability.report FILE`",
     )
     ap.add_argument(
+        "--audit",
+        action="store_true",
+        help="XLA program audit: at jit time, census the compiled "
+        "program's collectives (all-reduce / reduce-scatter / all-gather / "
+        "collective-permute) and verify them against the layout's "
+        "analytical comms contract — a mismatch aborts BEFORE the first "
+        "dispatch. With --metrics-out the full audit (census, memory "
+        "analysis, bytes/step comms model) lands as a schema-v3 "
+        "xla_audit record; the report CLI renders its memory and comms "
+        "sections",
+    )
+    ap.add_argument(
         "--health",
         choices=["record", "warn", "halt"],
         default=None,
@@ -205,6 +217,7 @@ def main():
     run = TrainingSession(
         metrics=metrics,
         health=args.health,
+        audit=args.audit,
         dp=args.dp,
         pp=args.pp,
         schedule=args.schedule,
@@ -295,7 +308,7 @@ def main():
         print(f"HEALTH HALT: {e}", file=sys.stderr)
         if metrics is not None:
             metrics.close()
-            print(f"telemetry written: {args.metrics_out}")
+            print(f"telemetry written: {metrics.path}")
         sys.exit(3)
     print(
         f"Epoch: {run.epoch}, Time Spent: {time.time() - t0:.2f}s, "
@@ -307,7 +320,7 @@ def main():
     print("final model hash:", run.model_hash())
     if metrics is not None:
         metrics.close()
-        print(f"telemetry written: {args.metrics_out}")
+        print(f"telemetry written: {metrics.path}")
 
 
 if __name__ == "__main__":
