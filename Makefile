@@ -6,8 +6,8 @@ CPU_MESH = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # verify needs bash (pipefail / PIPESTATUS)
 SHELL := /bin/bash
 
-.PHONY: test verify metrics-smoke report-smoke audit-smoke data train \
-        train-mesh bench bench-scaling schedules clean
+.PHONY: test verify metrics-smoke report-smoke audit-smoke overlap-smoke \
+        data train train-mesh bench bench-scaling schedules clean
 
 test:
 	python -m pytest tests/ -q
@@ -59,6 +59,27 @@ audit-smoke:
 	  grep -q "Comms (XLA program audit)" $$f.report.md; \
 	done
 	@echo "audit-smoke OK: census + memory + comms sections on all 4 layouts"
+
+# bucketed gradient-sync end-to-end: 1 CPU epoch each for DP=2 and ZeRO-1
+# with --grad-bucket-bytes 65536 --audit — train.py aborts (nonzero exit)
+# if the compiled program's bucket count / sizes violate the plan — then
+# assert the census verdict is clean and the report renders the
+# overlap-efficiency row + the bucketed sync line, exit 0 (needs data,
+# like metrics-smoke)
+overlap-smoke:
+	rm -f /tmp/overlap_dp.jsonl /tmp/overlap_z1.jsonl
+	$(CPU_MESH) python train.py --epochs 1 --no-eval --audit --dp 2 \
+	    --grad-bucket-bytes 65536 --metrics-out /tmp/overlap_dp.jsonl
+	$(CPU_MESH) python train.py --epochs 1 --no-eval --audit --dp 2 --pp 2 \
+	    --schedule gpipe --zero1 --grad-bucket-bytes 65536 \
+	    --metrics-out /tmp/overlap_z1.jsonl
+	set -e; for f in /tmp/overlap_dp /tmp/overlap_z1; do \
+	  python -c "import json,sys; p=sys.argv[1]; recs=[json.loads(l) for l in open(p) if l.strip()]; a=[r for r in recs if r.get('kind')=='xla_audit']; assert a, p+': no xla_audit record'; assert all(r.get('census_ok') for r in a), p+': census mismatch'; dp=[r['expected']['axes']['dp'] for r in a][-1]; assert dp['mode']=='bucketed' and dp['num_buckets']>=2, p+': plan not bucketed'; plans=[r for r in recs if r.get('kind')=='event' and r.get('name')=='grad_sync_plan']; assert plans, p+': no grad_sync_plan event'; print(p+': bucketed census clean ('+str(dp['num_buckets'])+' buckets)')" $$f.jsonl; \
+	  python -m shallowspeed_tpu.observability.report $$f.jsonl --format md > $$f.report.md; \
+	  grep -q "overlap efficiency" $$f.report.md; \
+	  grep -q "gradient sync: bucketed" $$f.report.md; \
+	done
+	@echo "overlap-smoke OK: bucketed census + overlap-efficiency row on dp2 and zero1"
 
 data:
 	python prepare_data.py

@@ -78,11 +78,12 @@ def bench_sequential(nb, reps, sizes=SIZES):
     return reps * nb * B / (time.perf_counter() - t0)
 
 
-def bench_pipeline(
-    dp, pp, sched_name, nb, reps, virtual=1, sizes=SIZES, zero1=False,
-    optimizer=None,
+def _pipeline_epoch_setup(
+    dp, pp, sched_name, nb, virtual=1, sizes=SIZES, zero1=False,
+    optimizer=None, grad_bucket_bytes=0,
 ):
-    import jax
+    """Build one mesh config's epoch fn + initial state + data: the shared
+    setup behind the plain timing rows and the same-window pairs."""
     import jax.numpy as jnp
 
     from shallowspeed_tpu import model as Mo
@@ -98,11 +99,24 @@ def bench_pipeline(
     stacked, flags = E.init_stacked(spec, mesh, order=order)
     opt = make_optimizer(optimizer, 2e-4) if optimizer else SGD(LR)
     epoch = E.make_pipeline_epoch(
-        mesh, spec, prog, B // dp // M, opt, zero1=zero1
+        mesh, spec, prog, B // dp // M, opt, zero1=zero1,
+        grad_bucket_bytes=grad_bucket_bytes,
     )
     st = E.zero1_init_state(opt, spec, mesh) if zero1 else opt.init(stacked)
     X, Y = _data(nb, np.random.RandomState(0))
-    Xj, Yj = jnp.asarray(X), jnp.asarray(Y)
+    return spec, epoch, stacked, flags, st, jnp.asarray(X), jnp.asarray(Y)
+
+
+def bench_pipeline(
+    dp, pp, sched_name, nb, reps, virtual=1, sizes=SIZES, zero1=False,
+    optimizer=None,
+):
+    import jax
+
+    _, epoch, stacked, flags, st, Xj, Yj = _pipeline_epoch_setup(
+        dp, pp, sched_name, nb, virtual=virtual, sizes=sizes, zero1=zero1,
+        optimizer=optimizer,
+    )
     stacked, st, _ = epoch(stacked, flags, st, Xj, Yj)
     jax.block_until_ready(stacked["W"])
     t0 = time.perf_counter()
@@ -110,6 +124,69 @@ def bench_pipeline(
         stacked, st, _ = epoch(stacked, flags, st, Xj, Yj)
     jax.block_until_ready(stacked["W"])
     return reps * nb * B / (time.perf_counter() - t0)
+
+
+# anchor-vs-bucketed gradient-sync pairs (dp and ZeRO-1): measured with
+# bench.py's interleaved-trial slope protocol so each pair shares its
+# contention window — the ratio is same-window, like the TPU captures'.
+# On emulated CPU devices these rows validate the machinery and record
+# the bucket plan; the RATIO only means something on a real multi-chip
+# mesh (one CPU host has no interconnect to overlap against).
+GRAD_SYNC_BUCKET_BYTES = 65536
+SYNC_PAIRS = [
+    ("dp2", dict(dp=2, pp=1, sched="gpipe")),
+    ("dp2-zero1", dict(dp=2, pp=1, sched="gpipe", zero1=True)),
+]
+
+
+def bench_sync_pair(name, cfg, nb):
+    """One anchor-vs-bucketed pair, same-window: returns a list of record
+    dicts (one per mode) carrying grad_bucket_bytes + bucket count so a
+    MULTICHIP capture of these rows is self-describing."""
+    from bench import make_run_k, slope_epoch_seconds_many
+
+    from shallowspeed_tpu import model as Mo
+    from shallowspeed_tpu.parallel import gradsync
+
+    dp, pp = cfg["dp"], cfg["pp"]
+    zero1 = cfg.get("zero1", False)
+    spec = Mo.make_model_spec(SIZES, pp, B)
+    plan = gradsync.plan_buckets(
+        spec, dp, pp, GRAD_SYNC_BUCKET_BYTES, zero1=zero1
+    )
+    modes = {f"{name}-anchor": 0, f"{name}-bucketed": GRAD_SYNC_BUCKET_BYTES}
+    run_ks = {}
+    for label, gbb in modes.items():
+        _, epoch, stacked, flags, st, Xj, Yj = _pipeline_epoch_setup(
+            dp, pp, cfg["sched"], nb, zero1=zero1, grad_bucket_bytes=gbb
+        )
+
+        def epoch_fn(p, s, X, Y, _epoch=epoch, _flags=flags):
+            return _epoch(p, _flags, s, X, Y)
+
+        run_ks[label] = make_run_k(epoch_fn, stacked, st, Xj, Yj)
+    # min_delta_s=0: no tunnel transport constants to resolve above on a
+    # local backend — fixed short legs, trials still interleaved
+    slopes = slope_epoch_seconds_many(
+        run_ks, k1=1, k2=3, trials=2, min_delta_s=0
+    )
+    anchor_sps = nb * B / slopes[f"{name}-anchor"]
+    records = []
+    for label, gbb in modes.items():
+        sps = nb * B / slopes[label]
+        records.append(
+            {
+                "config": label,
+                "devices": dp * pp,
+                "samples_per_sec": round(sps, 1),
+                "grad_bucket_bytes": gbb,
+                "grad_buckets": plan.num_buckets if gbb else 0,
+                "zero1": zero1,
+                "same_window": True,
+                "vs_anchor": round(sps / anchor_sps, 4),
+            }
+        )
+    return records
 
 
 CONFIGS = [
@@ -173,6 +250,15 @@ def main():
                 }
             )
         )
+
+    # the anchor-vs-bucketed gradient-sync pairs (same-window per pair)
+    for name, cfg in SYNC_PAIRS:
+        need = cfg["dp"] * cfg["pp"]
+        if need > n_dev:
+            print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
+            continue
+        for rec in bench_sync_pair(name, cfg, args.batches):
+            print(json.dumps(rec))
 
 
 if __name__ == "__main__":

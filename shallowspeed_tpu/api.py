@@ -40,6 +40,7 @@ from shallowspeed_tpu.optimizer import (
     split_state,
 )
 from shallowspeed_tpu.parallel import executor as E
+from shallowspeed_tpu.parallel import gradsync
 from shallowspeed_tpu.parallel import lower_schedule, make_mesh
 from shallowspeed_tpu.parallel.lowering import program_flops, program_stats
 
@@ -79,6 +80,7 @@ class TrainingSession:
         momentum=0.9,
         virtual_stages=1,
         zero1=False,
+        grad_bucket_bytes=0,
         scan_unroll=1,
         tick_unroll=1,
         weight_decay=0.0,
@@ -192,6 +194,17 @@ class TrainingSession:
             raise ValueError(
                 "zero1 shards the optimizer update over the dp mesh axis; "
                 "the sequential path has no mesh — use dp/pp > 1"
+            )
+        if grad_bucket_bytes is None:
+            grad_bucket_bytes = 0
+        grad_bucket_bytes = int(grad_bucket_bytes)
+        if grad_bucket_bytes < 0:
+            raise ValueError("grad_bucket_bytes must be >= 0 (0 = anchor sync)")
+        if grad_bucket_bytes and self._sequential:
+            raise ValueError(
+                "grad_bucket_bytes buckets the dp-axis gradient collectives; "
+                "the sequential path has no gradient sync — use dp/pp > 1 "
+                "(0 keeps the legacy anchor psum on mesh layouts)"
             )
         self.epoch = 0
 
@@ -403,6 +416,7 @@ class TrainingSession:
                 clip_norm=clip_norm, kernel_backend=kernel_backend,
                 with_grad_norm=self._epoch_aux,
                 with_step_stats=self._step_aux,
+                grad_bucket_bytes=grad_bucket_bytes,
             )
             self._prog = prog
             self._mubatch_local = local_batch // mubatches
@@ -410,6 +424,7 @@ class TrainingSession:
                 precision=self.precision, unroll=scan_unroll,
                 tick_unroll=tick_unroll, zero1=self._zero1,
                 clip_norm=clip_norm, kernel_backend=kernel_backend,
+                grad_bucket_bytes=grad_bucket_bytes,
             )
             self._eval_step = None  # built lazily, sized to the val split
 
@@ -438,7 +453,22 @@ class TrainingSession:
         # the layout's analytical comms contract (required/forbidden
         # collective kinds + bytes/step per mesh axis, derived from the
         # lowered tick tables) — what the compiled program's collective
-        # census is audited against at jit time
+        # census is audited against at jit time. The gradient-sync bucket
+        # plan is rebuilt here through the SAME gradsync planners the
+        # executor used, so contract and emitters can never disagree.
+        self._sync_plan = None
+        if grad_bucket_bytes and not self._sequential:
+            self._sync_plan = gradsync.plan_buckets(
+                self.spec, dp, pp, grad_bucket_bytes, zero1=self._zero1
+            )
+            if self._metrics.enabled:
+                # the plan is static telemetry, recorded once like the
+                # pipeline program stats: bucket count + sizes make every
+                # later throughput/audit record self-describing
+                self._metrics.event(
+                    "grad_sync_plan", dp=dp, pp=pp, zero1=self._zero1,
+                    **self._sync_plan.describe(),
+                )
         self._expected_comms = program_audit.expected_comms(
             self.spec,
             dp,
@@ -448,6 +478,7 @@ class TrainingSession:
             mubatch_size=None if self._sequential else self._mubatch_local,
             platform=platform,
             precision=self._precision_name,
+            grad_bucket_plan=self._sync_plan,
         )
 
     # -- training -----------------------------------------------------------

@@ -43,6 +43,26 @@ census, never demanded nor counted as interconnect traffic), dp > 1
 without ZeRO-1 must all-reduce and must NOT reduce-scatter/all-gather,
 and ZeRO-1 must reduce-scatter AND all-gather (even at dp=1 — the
 chunked update always lowers both).
+
+BUCKETED gradient sync (``grad_bucket_bytes > 0``, parallel/gradsync.py)
+tightens the contract beyond kinds: each bucket is deliberately emitted
+as ONE flat collective, so every planned bucket must be ACCOUNTED FOR by
+the compiled sync ops — one op of exactly the bucket's result-byte size,
+or one op whose size is the sum of a merged run of ADJACENT buckets
+(backend collective-combiner passes may fuse neighboring small
+collectives; a merged program still syncs every planned byte and must
+not be refused). A tampered plan fails the match, as does the common
+unwired-knob shape on this jax (the legacy DP anchor lowers one
+all-reduce per LEAF, whose sizes cannot be partitioned into the planned
+bucket sums). Known evidence limit: ONE sync op of the total byte size
+is accepted — a combiner that merged every bucket and an unwired ZeRO-1
+anchor (one flat reduce-scatter) are byte-identical in the census, and
+refusing would abort healthy combiner-merged runs; wiring regressions
+of that shape are instead pinned by the CPU census tests, where no
+combiner runs and the per-bucket ops are visible individually
+(tests/test_program_audit.py::test_compiled_census_matches_bucket_plan).
+Total synced bytes are unchanged by bucketing; only the op granularity
+moves, which is exactly what this accounting pins down.
 """
 
 import math
@@ -171,14 +191,20 @@ def parse_collectives(hlo_text):
     return ops
 
 
-def collective_census(hlo_text):
-    """-> ``{kind: {"count": n, "bytes": summed result bytes}}``."""
+def census_of_ops(ops):
+    """Aggregate a ``parse_collectives`` op list into the census shape:
+    ``{kind: {"count": n, "bytes": summed result bytes}}``."""
     census = {}
-    for op in parse_collectives(hlo_text):
+    for op in ops:
         agg = census.setdefault(op["kind"], {"count": 0, "bytes": 0})
         agg["count"] += 1
         agg["bytes"] += op["bytes"]
     return census
+
+
+def collective_census(hlo_text):
+    """-> ``{kind: {"count": n, "bytes": summed result bytes}}``."""
+    return census_of_ops(parse_collectives(hlo_text))
 
 
 def memory_stats(compiled):
@@ -267,6 +293,7 @@ def expected_comms(
     mubatch_size=None,
     platform="cpu",
     precision="highest",
+    grad_bucket_plan=None,
 ):
     """The layout's analytical comms contract, derived from the model spec
     and (on mesh layouts) the LOWERED tick tables — the numbers the
@@ -295,13 +322,28 @@ def expected_comms(
       * ``dp`` (zero1): reduce-scatter + all-gather of the padded flat
         param vector, ``2 * (dp-1)/dp x flat_bytes``;
 
+      the dp axis entry comes from ``gradsync.sync_comm_bytes`` and
+      carries the sync ``mode`` — with a ``grad_bucket_plan`` it also
+      carries the bucketed contract (``num_buckets`` + per-bucket
+      grad/census bytes; total bytes unchanged) that ``check_census``
+      verifies against the compiled ops;
+
     - ``bytes_per_step_per_device``: the axes' total;
     - ``comms_time_per_step_s``: bandwidth-bound lower bound at the
       platform's interconnect peak (with provenance);
     - ``compute_time_per_step_s``: per-device padded-FLOP lower bound at
       the platform's matmul peak (``costmodel.peak_flops_per_chip``);
     - ``bound``: ``"comms"`` / ``"compute"`` — which lower bound dominates
-      (None when either peak is unknown).
+      (None when either peak is unknown);
+    - ``serial_bound_s`` / ``overlapped_bound_s``: the two step-time lower
+      bounds — ``comm + compute`` prices the legacy anchor (no gradient
+      communication can start until the whole backward ends, nothing
+      overlaps), ``max(comm, compute)`` prices perfectly-overlapped
+      bucketed sync; their gap is the overlap headroom the bucketing knob
+      exists to claim, and ``model_hidden_comm_share`` (``min(comm,
+      compute) / comm``) is the share of communication a perfect overlap
+      hides — the model-side number next to the MEASURED overlap
+      efficiency the report derives from a trace's comm/compute split.
     """
     sequential = prog is None
     axes = {}
@@ -311,7 +353,6 @@ def expected_comms(
         forbidden = [k.replace("-", "_") for k in COLLECTIVE_KINDS]
         flops_per_step = mlp_train_flops_per_sample(spec.sizes) * spec.global_batch_size
     else:
-        from shallowspeed_tpu.parallel.executor import slot_shapes
         from shallowspeed_tpu.parallel.lowering import (
             program_comm_bytes,
             program_flops,
@@ -334,35 +375,24 @@ def expected_comms(
                     "useful_bytes_per_device"
                 ],
             }
-        dims = slot_shapes(spec)
-        V = spec.n_stages // pp
-        # this device's padded stacked params == its gradient payload
-        flat = sum(V * o * i for o, i in dims) + sum(V * o for o, _ in dims)
-        grad_bytes = 4 * flat
+        from shallowspeed_tpu.parallel.gradsync import sync_comm_bytes
+
         if zero1:
             # the chunked update always lowers both collectives, dp=1 included
             required += ["reduce_scatter", "all_gather"]
-            csz = -(-flat // dp)
-            padded_bytes = 4 * csz * dp
-            axes["dp"] = {
-                "kind": "reduce_scatter+all_gather",
-                "algorithm": "ring",
-                "grad_bytes_per_device": padded_bytes,
-                "bytes_per_step_per_device": 2 * (dp - 1) / dp * padded_bytes,
-            }
         else:
             forbidden += ["reduce_scatter", "all_gather"]
             if dp > 1:
-                # "the DP all-reduce really is one psum": the kind must be
-                # there (leaf-count fusion makes exact op counts compiler
-                # noise — see the module docstring)
+                # "the DP all-reduce really is one psum" (or one per
+                # bucket): the kind must be there (leaf-count fusion makes
+                # exact UNBUCKETED op counts compiler noise — see the
+                # module docstring; the bucketed contract pins counts)
                 required.append("all_reduce")
-            axes["dp"] = {
-                "kind": "all_reduce",
-                "algorithm": "ring",
-                "grad_bytes_per_device": grad_bytes,
-                "bytes_per_step_per_device": 2 * (dp - 1) / dp * grad_bytes,
-            }
+        # the dp-axis byte model (anchor or per-bucket) has ONE definition,
+        # shared with the executor's emitters: gradsync.sync_comm_bytes
+        axes["dp"] = sync_comm_bytes(
+            spec, dp, pp, zero1=zero1, plan=grad_bucket_plan
+        )
         # per-device padded compute: the tick program's FLOPs are the whole
         # pp-group's; SPMD uniformity splits them evenly across devices
         flops_per_step = program_flops(prog, spec, mubatch_size) / pp
@@ -373,8 +403,15 @@ def expected_comms(
     comms_t = (total / bw) if bw else None
     compute_t = (flops_per_step / peak) if peak else None
     bound = None
+    serial_t = overlapped_t = hidden_share = None
     if comms_t is not None and compute_t is not None:
         bound = "comms" if comms_t > compute_t else "compute"
+        # the two step-time lower bounds: the anchor's serial comm-then-
+        # compute chain vs the perfectly-overlapped bucketed sync
+        serial_t = comms_t + compute_t
+        overlapped_t = max(comms_t, compute_t)
+        if comms_t > 0:
+            hidden_share = min(comms_t, compute_t) / comms_t
     return {
         "dp": int(dp),
         "pp": int(pp),
@@ -392,13 +429,24 @@ def expected_comms(
         "peak_flops_source": peak_source,
         "compute_time_per_step_s": compute_t,
         "bound": bound,
+        "serial_bound_s": serial_t,
+        "overlapped_bound_s": overlapped_t,
+        "model_hidden_comm_share": hidden_share,
     }
 
 
-def check_census(census, expected):
+def check_census(census, expected, ops=None):
     """Compare a compiled program's collective census against the layout
     contract. Returns a list of human-readable mismatch strings (empty =
-    the census matches)."""
+    the census matches).
+
+    ``ops`` (optional): the per-op list from ``parse_collectives`` — when
+    the contract's dp axis is BUCKETED, the bucket-accounting check runs
+    against it (every planned bucket matched by a sync op of its exact
+    result size or by a combiner-merged adjacent run's sum — see the
+    module docstring; without ``ops`` there is no per-op size evidence
+    and only the kind legs run).
+    """
     mismatches = []
     for kind in expected.get("required", ()):
         if census.get(kind, {}).get("count", 0) < 1:
@@ -420,12 +468,93 @@ def check_census(census, expected):
                 "pipeline relay must permute in BOTH directions "
                 f"(>= 2 collective-permutes); compiled program has {n}"
             )
+    mismatches += _check_bucketed_sync(census, expected, ops)
     return mismatches
 
 
-def verify_census(census, expected, context="compiled program"):
-    """``check_census`` that fails loudly — the tested layout invariant."""
-    mismatches = check_census(census, expected)
+def _check_bucketed_sync(census, expected, ops):
+    """The bucketed gradient-sync leg of the contract: the emitters
+    deliberately lower one flat collective per bucket, so every planned
+    bucket must be accounted for by the compiled sync ops — one op of
+    exactly the bucket's result size, or one op of a MERGED adjacent
+    run's summed size (backend collective combiners may fuse neighboring
+    small collectives; a merged program still syncs every planned byte
+    and must not be refused). A tampered plan fails; so does the
+    per-leaf unwired-anchor shape — but a SINGLE op of the total size is
+    accepted (indistinguishable from a full combiner merge; see the
+    module docstring for where that regression shape is pinned instead).
+    Checked only with per-op evidence (``ops``) and only when the
+    dp axis is real traffic (dp > 1 — at dp == 1 XLA may elide the
+    degenerate collectives entirely, which is not a lowering bug)."""
+    axis = (expected.get("axes") or {}).get("dp") or {}
+    if axis.get("mode") != "bucketed" or expected.get("dp", 1) <= 1:
+        return []
+    if ops is None:
+        return []  # census aggregates carry no per-op sizes: no evidence
+    kind = "reduce_scatter" if expected.get("zero1") else "all_reduce"
+    planned = [int(b) for b in axis.get("bucket_census_bytes", ())]
+    compiled = sorted(op["bytes"] for op in ops if op["kind"] == kind)
+    if _buckets_accounted(planned, compiled):
+        return []
+
+    def _fmt(sizes):
+        s = ", ".join(str(v) for v in sizes[:12])
+        return f"[{s}{', ...' if len(sizes) > 12 else ''}]"
+
+    return [
+        f"bucketed sync: the compiled program's {kind} result sizes "
+        f"{_fmt(compiled)} cannot account for the planned bucket sizes "
+        f"{_fmt(planned)} (neither one op per bucket nor merged adjacent "
+        "runs)"
+    ]
+
+
+def _buckets_accounted(planned, compiled, node_budget=100_000):
+    """Can the ordered ``planned`` bucket sizes be partitioned into
+    contiguous runs whose sums each match a distinct ``compiled`` op
+    size? Run length 1 everywhere is the exact one-op-per-bucket case;
+    longer runs are combiner-merged neighbors (combiners fuse ops
+    adjacent in the schedule, i.e. consecutive buckets). Extra compiled
+    ops (loss psums, norm reductions) may go unused. Backtracking with a
+    node budget; when the search is infeasible (pathological many-equal-
+    size plans, or a plan deeper than Python's recursion limit) fall back
+    to the weaker total-bytes check rather than refusing a healthy
+    program on solver timeout."""
+    from collections import Counter
+
+    class _Exhausted(Exception):
+        pass
+
+    avail = Counter(compiled)
+    budget = [node_budget]
+
+    def match(i):
+        if budget[0] <= 0:
+            raise _Exhausted  # budget spent: no verdict either way
+        budget[0] -= 1
+        if i == len(planned):
+            return True
+        run = 0
+        for j in range(i, len(planned)):
+            run += planned[j]
+            if avail[run] > 0:
+                avail[run] -= 1
+                if match(j + 1):
+                    return True
+                avail[run] += 1
+        return False
+
+    try:
+        return match(0)
+    except (_Exhausted, RecursionError):
+        return sum(compiled) >= sum(planned)
+
+
+def verify_census(census, expected, context="compiled program", ops=None):
+    """``check_census`` that fails loudly — the tested layout invariant.
+    Pass ``ops`` (the ``parse_collectives`` list) to enforce the bucketed
+    size-accounting leg too; without it only the kind legs can fire."""
+    mismatches = check_census(census, expected, ops=ops)
     if mismatches:
         raise AuditMismatchError(
             f"{context}: collective census disagrees with the layout "
@@ -448,7 +577,8 @@ def audit_compiled(compiled, expected=None, platform=None, n_devices=1):
         text = compiled.as_text()
     except Exception:  # noqa: BLE001 — backend-optional surface
         text = None
-    census = collective_census(text) if text else {}
+    ops = parse_collectives(text) if text else []
+    census = census_of_ops(ops)
     rec = {
         "hlo_available": text is not None,
         "census": census,
@@ -465,7 +595,7 @@ def audit_compiled(compiled, expected=None, platform=None, n_devices=1):
             rec["peak_hbm_per_chip_bytes"] = mem["peak_hbm_bytes"]
             rec["hbm_headroom_fraction"] = 1.0 - mem["peak_hbm_bytes"] / cap
     if expected is not None:
-        mismatches = check_census(census, expected) if text else []
+        mismatches = check_census(census, expected, ops=ops) if text else []
         rec["expected"] = expected
         rec["mismatches"] = mismatches
         # no HLO text -> nothing to audit; None, not a silent pass/fail

@@ -17,7 +17,14 @@ human or a bench gate actually asks of a run:
   -> headroom, or an OOM forecast when the program exceeds it) and a
   COMMS section (collective census vs the layout contract, analytical
   bytes/step per device, bandwidth-bound lower-bound step time vs the
-  compute lower bound -> comms- vs compute-bound verdict);
+  compute lower bound -> comms- vs compute-bound verdict, the serial
+  ``comm + compute`` vs overlapped ``max(comm, compute)`` step bounds,
+  and the gradient-sync mode — anchor or N byte-buckets);
+- an OVERLAP EFFICIENCY row — the hidden-comm share
+  ``1 - exposed_comm / total_comm``: measured from a profiler trace's
+  comm/compute split when ``--trace`` points at one
+  (``observability.trace_stats``), else the comms model's
+  perfect-overlap bound from the audit record;
 - MFU + achieved FLOP/s and the cost-model cross-check (analytical vs
   XLA-reported FLOPs), with the peak's provenance so a nominal-CPU MFU
   cannot pass for a datasheet one;
@@ -96,9 +103,12 @@ def sparkline(values, width=60):
 # ---------------------------------------------------------------------------
 
 
-def build_report(records, source=""):
+def build_report(records, source="", trace=None):
     """Fold a record stream into the JSON-able report dict every renderer
-    (and the baseline comparison) consumes."""
+    (and the baseline comparison) consumes. ``trace``: an optional
+    ``trace_stats.summarize`` dict — its measured comm/compute split
+    upgrades the overlap-efficiency row from the model bound to a
+    measurement."""
     epochs = [
         r for r in records if r.get("kind") == "event" and r.get("name") == "epoch"
     ]
@@ -191,6 +201,8 @@ def build_report(records, source=""):
     if accuracy is None:
         accuracy = gauges.get("val_accuracy")
 
+    overlap = _overlap_info(audit, trace)
+
     return {
         "source": source,
         "schema_versions": sorted({r.get("v", 0) for r in records}),
@@ -205,6 +217,7 @@ def build_report(records, source=""):
         "achieved_flops_per_sec": gauges.get("achieved_flops_per_sec"),
         "cost_model": cost,
         "xla_audit": audit,
+        "overlap": overlap,
         "bubble_fraction": bubble,
         "spans": span_rows,
         "steps": len(steps),
@@ -227,6 +240,35 @@ def build_report(records, source=""):
             "halted": bool(halted),
         },
     }
+
+
+def _overlap_info(audit, trace):
+    """The overlap-efficiency story: hidden-comm share ``1 -
+    exposed_comm / total_comm``. A measured trace split (trace_stats)
+    wins; else the comms model's perfect-overlap bound from the audit's
+    ``expected`` contract; None when neither source knows anything."""
+    exp = (audit or {}).get("expected") or {}
+    info = None
+    if _finite(exp.get("model_hidden_comm_share")):
+        axis = (exp.get("axes") or {}).get("dp") or {}
+        info = {
+            "source": "model",
+            "hidden_comm_share": exp["model_hidden_comm_share"],
+            "serial_bound_s": exp.get("serial_bound_s"),
+            "overlapped_bound_s": exp.get("overlapped_bound_s"),
+            "sync_mode": axis.get("mode"),
+            "num_buckets": axis.get("num_buckets"),
+        }
+    if trace and _finite(trace.get("overlap_efficiency")):
+        info = dict(info or {})
+        info.update(
+            source="measured",
+            hidden_comm_share=trace["overlap_efficiency"],
+            comm_ms=trace.get("comm_ms"),
+            exposed_comm_ms=trace.get("exposed_comm_ms"),
+            comm_fraction=trace.get("comm_fraction"),
+        )
+    return info
 
 
 def baseline_throughput(path):
@@ -328,6 +370,23 @@ def _rows(report):
         rows.append(("final accuracy", _fmt_num(report["final_accuracy"], pct=True)))
     if report["bubble_fraction"] is not None:
         rows.append(("pipeline bubble", _fmt_num(report["bubble_fraction"], pct=True)))
+    ov = report.get("overlap")
+    if ov is not None:
+        share = _fmt_num(ov.get("hidden_comm_share"), pct=True)
+        if ov["source"] == "measured":
+            detail = (
+                f"{share} of comm hidden (measured: "
+                f"{_fmt_num(ov.get('exposed_comm_ms'))} ms exposed of "
+                f"{_fmt_num(ov.get('comm_ms'))} ms comm)"
+            )
+        else:
+            mode = ov.get("sync_mode")
+            sync = (
+                f"{ov.get('num_buckets')} buckets" if mode == "bucketed"
+                else "anchor sync"
+            )
+            detail = f"{share} of comm hideable (model bound; {sync})"
+        rows.append(("overlap efficiency", detail))
     rows.append(("health", report["health"]["verdict"]))
     return rows
 
@@ -439,6 +498,19 @@ def _comms_lines(audit, md):
         if parts:
             line += " (" + " + ".join(parts) + ")"
         lines.append(line)
+        dp_axis = (exp.get("axes") or {}).get("dp") or {}
+        if dp_axis.get("mode") == "bucketed":
+            # "budget", not "<=": a single leaf larger than the budget
+            # gets its own oversized bucket (the planner never splits one)
+            sizes = dp_axis.get("bucket_grad_bytes") or []
+            lines.append(
+                f"gradient sync: bucketed — {dp_axis.get('num_buckets')} "
+                f"collectives, budget "
+                f"{format_bytes(dp_axis.get('grad_bucket_bytes'))}/bucket "
+                f"(largest bucket "
+                f"{format_bytes(max(sizes) if sizes else None)}); "
+                "total bytes unchanged vs the anchor"
+            )
         ct, xt = exp.get("comms_time_per_step_s"), exp.get("compute_time_per_step_s")
         if ct is not None or xt is not None:
             bound = exp.get("bound")
@@ -448,6 +520,13 @@ def _comms_lines(audit, md):
                 f"({exp.get('bandwidth_source')}) vs compute {_fmt_time_s(xt)}"
                 + (f" — {bound}-bound" if bound else "")
             )
+            st, ot = exp.get("serial_bound_s"), exp.get("overlapped_bound_s")
+            if st is not None and ot is not None:
+                lines.append(
+                    f"step-time bounds: serial (anchor) {_fmt_time_s(st)} "
+                    f"= comm + compute; overlapped (bucketed, perfect) "
+                    f"{_fmt_time_s(ot)} = max(comm, compute)"
+                )
     lines.append("")
     return lines
 
@@ -532,6 +611,14 @@ def main(argv=None):
         help="metrics JSONL or bench/capture JSON to compare throughput "
         "against (regression beyond --threshold exits 2)",
     )
+    ap.add_argument(
+        "--trace",
+        default=None,
+        help="a jax.profiler trace dir or *.trace.json.gz of this run "
+        "(e.g. the --profile-dir artifact): its measured comm/compute "
+        "split upgrades the overlap-efficiency row from the comms-model "
+        "bound to a measurement",
+    )
     ap.add_argument("--format", choices=("md", "text", "json"), default="md")
     ap.add_argument(
         "--threshold",
@@ -545,7 +632,20 @@ def main(argv=None):
     except (OSError, ValueError) as e:
         print(f"report: cannot read {args.run}: {e}", file=sys.stderr)
         return 1
-    report = build_report(records, source=args.run)
+    trace = None
+    if args.trace:
+        from shallowspeed_tpu.observability import trace_stats
+
+        traces = trace_stats.find_traces(args.trace)
+        if not traces:
+            print(
+                f"report: no *.trace.json.gz under {args.trace}", file=sys.stderr
+            )
+            return 1
+        # one capture = one trace; with several, the newest wins (the
+        # capture helpers timestamp their subdirs)
+        trace = trace_stats.summarize(traces[-1])
+    report = build_report(records, source=args.run, trace=trace)
     comparison = None
     if args.baseline:
         try:

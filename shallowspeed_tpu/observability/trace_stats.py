@@ -49,6 +49,19 @@ def is_comm_op(name):
     return n.startswith(COMM_OP_PREFIXES)
 
 
+def _union_us(events):
+    """Total covered length of the [ts, ts+dur) intervals of ``events``."""
+    covered, end = 0.0, None
+    for s, e in sorted((ev["ts"], ev["ts"] + ev.get("dur", 0)) for ev in events):
+        if end is None or s > end:
+            covered += e - s
+            end = e
+        elif e > end:
+            covered += e - end
+            end = e
+    return covered
+
+
 def find_traces(path):
     """A file path as-is, or every ``*.trace.json.gz`` under a directory."""
     p = Path(path)
@@ -70,8 +83,21 @@ def summarize(trace_path):
     busy time over total busy time, classified by ``is_comm_op``) — so the
     MEASURED communication share of a capture is directly comparable
     against the analytical comms model's verdict
-    (program_audit.expected_comms). ``{"device_ops": 0}`` when the trace
-    holds no device ops.
+    (program_audit.expected_comms). From the same split come the overlap
+    numbers the bucketed gradient sync exists to move:
+    ``exposed_comm_ms`` — timeline time where communication ran with NO
+    compute op in flight on the same device (a per-pid interval-union
+    sweep: ``|union(comm) \\ union(compute)|`` summed over device pids —
+    busy-time arithmetic would be fooled by multi-device traces and by
+    functional-unit overlap, where summed busy time exceeds the span) —
+    and ``overlap_efficiency`` — the hidden-comm share
+    ``1 - exposed_comm / comm_union`` (None when the trace has no comm
+    ops; ``comm_union_ms`` — the comm-interval union — is the
+    denominator rather than summed comm busy time, so collectives that
+    merely overlap EACH OTHER do not count as hidden behind compute):
+    1.0 means every communication microsecond rode behind compute, 0.0
+    means the sync was fully serial. ``{"device_ops": 0}`` when the
+    trace holds no device ops.
     """
     with gzip.open(trace_path) as f:
         tr = json.load(f)
@@ -108,6 +134,20 @@ def summarize(trace_path):
     comm = [e for e in ops if is_comm_op(e["name"])]
     comm_us = sum(e.get("dur", 0) for e in comm)
     kinds = collections.Counter(e["name"].split(".")[0] for e in ops)
+    # exposed comm per DEVICE pid: comm-interval time not covered by any
+    # compute interval on the same device — |union(all) - union(compute)|
+    # (compute on another chip cannot hide this chip's collective, and
+    # the interval union is immune to busy-sum > span unit overlap). The
+    # efficiency denominator is the comm interval UNION, not summed busy
+    # time: two collectives overlapping each other hide nothing behind
+    # compute, and must not inflate the hidden share.
+    exposed_us = 0.0
+    comm_union_us = 0.0
+    for pid in {e["pid"] for e in comm}:
+        dev = [e for e in ops if e["pid"] == pid]
+        compute_cover = _union_us(e for e in dev if not is_comm_op(e["name"]))
+        exposed_us += _union_us(dev) - compute_cover
+        comm_union_us += _union_us(e for e in dev if is_comm_op(e["name"]))
     return {
         "trace": str(trace_path),
         "device_ops": len(ops),
@@ -125,6 +165,15 @@ def summarize(trace_path):
         "comm_ms": round(comm_us / 1e3, 3),
         "compute_ms": round((busy_us - comm_us) / 1e3, 3),
         "comm_fraction": round(comm_us / busy_us, 4) if busy_us else 0.0,
+        # the measured overlap story (see the docstring): how much of the
+        # comm timeline was exposed vs hidden behind compute
+        "exposed_comm_ms": round(exposed_us / 1e3, 3),
+        "comm_union_ms": round(comm_union_us / 1e3, 3),
+        "overlap_efficiency": (
+            round(1.0 - exposed_us / comm_union_us, 4)
+            if comm_union_us
+            else None
+        ),
         "top_ops": dict(kinds.most_common(8)),
     }
 

@@ -20,11 +20,16 @@ optimizer step — is ONE jitted XLA computation:
   ``pp`` (the reference's blocking Send/Recv pairs, pipe.py:367-381);
 - microbatch activation stashes (reference Module._cache) are fixed-shape
   ring buffers carried through the scan; mailbox slots come from the lowering;
-- the DP all-reduce is a single ``jax.lax.psum`` of the accumulated gradient
-  pytree over ``dp`` after the tick loop — the reference's per-parameter
-  Iallreduce engine (pipe.py:302-327) with XLA's latency-hiding scheduler
-  providing the compute/comm overlap, and fusion providing the bucketing its
-  docstring wishes for;
+- the DP gradient sync after the tick loop has TWO modes
+  (``grad_bucket_bytes``): the legacy anchor — one ``jax.lax.psum`` of the
+  whole accumulated gradient pytree over ``dp`` — or byte-bucketed
+  collectives (parallel/gradsync.py): backward-ordered buckets of the
+  gradient tree, one all-reduce per bucket (``psum_scatter`` per bucket
+  under ZeRO-1), so XLA's latency-hiding scheduler can overlap bucket k's
+  communication with the consumers of already-synced buckets. This is the
+  reference's per-parameter Iallreduce engine (pipe.py:302-327) with the
+  bucketing its docstring wishes for; both modes are bitwise identical
+  (psum reduces elementwise per leaf);
 - the optimizer step happens on-device on the padded params (padded regions
   receive exactly-zero gradients, so they stay zero — see tests).
 
@@ -207,14 +212,22 @@ def init_stacked(spec: ModelSpec, mesh: Mesh, order=None):
 # unpack host-side state for layout-independent checkpoints.
 
 
+def stacked_flat_len(spec: ModelSpec, pp: int) -> int:
+    """Per-pp-device flattened param count of the stacked layout (every W
+    slot then every b slot, V virtual rows each) — the ONE definition of
+    the flat layout's size. ``zero1_flat_len``, the gradsync bucket
+    planners and the audit's comms model all read it, so a layout change
+    here propagates to every consumer at once."""
+    dims = slot_shapes(spec)
+    V = spec.n_stages // pp
+    return sum(V * o * i for o, i in dims) + sum(V * o for o, _ in dims)
+
+
 def zero1_flat_len(spec: ModelSpec, mesh: Mesh):
     """(flat_len, chunk_size): per-pp-device flattened param count and the
     padded per-dp-replica chunk size."""
-    dims = slot_shapes(spec)
-    P_, dp = mesh.shape["pp"], mesh.shape["dp"]
-    V = spec.n_stages // P_
-    flat = sum(V * o * i for o, i in dims) + sum(V * o for o, _ in dims)
-    return flat, -(-flat // dp)
+    flat = stacked_flat_len(spec, mesh.shape["pp"])
+    return flat, -(-flat // mesh.shape["dp"])
 
 
 def _zero1_flatten_rows(stacked_np, spec, mesh):
@@ -432,6 +445,7 @@ def make_pipeline_step(
     kernel_backend="xla",
     with_grad_norm=False,
     with_step_stats=False,
+    grad_bucket_bytes=0,
 ):
     """Build the jitted SPMD step executing one TickProgram over the mesh.
 
@@ -454,7 +468,18 @@ def make_pipeline_step(
     The norm is GLOBAL over every parameter of the model: the local squared
     sum is psum'd over ``pp`` (and, under zero1, over ``dp`` where the
     summed gradient lives chunked) — padded entries are exactly zero, so the
-    stacked norm equals the logical norm.
+    stacked norm equals the logical norm. The norm always reads the
+    POST-SYNC gradient, so it is identical under both sync modes.
+
+    ``grad_bucket_bytes``: 0 (default) keeps the legacy gradient-sync
+    anchor — one whole-tree ``lax.psum`` over ``dp`` (one flat
+    ``psum_scatter`` under zero1). A positive byte budget switches to the
+    bucketed sync (parallel/gradsync.py): the gradient is greedily packed
+    into backward-ordered buckets of at most this many bytes and each
+    bucket is synced by its OWN collective, giving XLA's scheduler
+    independent communication ops to overlap with the update's compute.
+    Bitwise identical to the anchor on every layout (elementwise
+    reductions; tested).
 
     ``with_grad_norm`` (training only): telemetry aux — the step returns a
     FOURTH output, the pre-clip global gradient norm (replicated scalar,
@@ -509,6 +534,17 @@ def make_pipeline_step(
     assert prog.num_stages == P_, "program/mesh device-count mismatch"
     assert S_ == P_ * V, "model stages must equal devices x virtual chunks"
     dp_n = mesh.shape["dp"]
+    # gradient-sync plan: None = legacy anchor collective; a BucketPlan =
+    # per-bucket collectives (derived deterministically from spec + knob,
+    # so the session's audit contract rebuilds the identical plan)
+    if grad_bucket_bytes and training:
+        from shallowspeed_tpu.parallel import gradsync
+
+        sync_plan = gradsync.plan_buckets(
+            spec, dp_n, P_, grad_bucket_bytes, zero1=zero1
+        )
+    else:
+        sync_plan = None
     if zero1:
         if not training:
             raise ValueError("zero1 applies to training programs only")
@@ -699,9 +735,18 @@ def make_pipeline_step(
                 [g.reshape(-1) for g in carry["gW"]]
                 + [g.reshape(-1) for g in carry["gb"]]
             )
-            gsh = lax.psum_scatter(
-                jnp.pad(gvec, (0, pad)), "dp", scatter_dimension=0, tiled=True
-            )
+            # the gradient sync: one flat reduce-scatter at the anchor, or
+            # one per byte-bucket (column ranges of the (dp, chunk) view —
+            # the concatenated outputs ARE the anchor chunk, bitwise)
+            gpad = jnp.pad(gvec, (0, pad))
+            if sync_plan is None:
+                gsh = lax.psum_scatter(
+                    gpad, "dp", scatter_dimension=0, tiled=True
+                )
+            else:
+                from shallowspeed_tpu.parallel import gradsync
+
+                gsh = gradsync.psum_scatter_bucketed(gpad, sync_plan)
             if with_grad_norm:
                 # chunks partition the dp-summed gradient across (dp, pp),
                 # so the pre-clip global norm is one cross-axis reduction
@@ -758,11 +803,22 @@ def make_pipeline_step(
                 outs += (gnorm_of(new_stacked, lambda sq: lax.psum(sq, "pp")),)
             return outs
 
-        # the BackwardGradAllReduce anchor: one SUM-psum of the whole gradient
-        # pytree over dp per batch (reference pipe.py:302-327)
-        gW = lax.psum(carry["gW"], "dp")
-        gb = lax.psum(carry["gb"], "dp")
-        grads = {"W": gW, "b": gb}  # (V, ...) leaves, mirroring the shards
+        # the BackwardGradAllReduce anchor, in one of two bitwise-identical
+        # forms (reference pipe.py:302-327): legacy — one SUM-psum of the
+        # whole gradient pytree over dp per batch — or bucketed — one psum
+        # per backward-ordered byte-bucket, so XLA can overlap each
+        # bucket's all-reduce with the rest of the tail. The clip-norm /
+        # grad-norm consumers below always read the POST-SYNC tree.
+        if sync_plan is None:
+            gW = lax.psum(carry["gW"], "dp")
+            gb = lax.psum(carry["gb"], "dp")
+            grads = {"W": gW, "b": gb}  # (V, ...) leaves, mirroring the shards
+        else:
+            from shallowspeed_tpu.parallel import gradsync
+
+            grads = gradsync.psum_bucketed(
+                {"W": carry["gW"], "b": carry["gb"]}, sync_plan
+            )
         if with_grad_norm:
             from shallowspeed_tpu.optimizer import global_norm
 
@@ -873,6 +929,7 @@ def make_pipeline_epoch(
     kernel_backend="xla",
     with_grad_norm=False,
     with_step_stats=False,
+    grad_bucket_bytes=0,
 ):
     """Scan the pipeline train step over all batches of an epoch: one XLA
     program per epoch. X: (num_batches, global_batch, in_dim), batch axis
@@ -887,12 +944,14 @@ def make_pipeline_epoch(
     ``with_step_stats`` adds per-step ``step_loss``/``step_grad_norm``/
     ``step_param_norm`` vectors to that aux (both mirror
     trainer.make_train_epoch's aux, so TrainingSession records the same
-    scalars on every layout)."""
+    scalars on every layout); ``grad_bucket_bytes`` selects the gradient-
+    sync mode (0 = anchor collective, >0 = byte-bucketed — see
+    make_pipeline_step)."""
     step = make_pipeline_step(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
         tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
         kernel_backend=kernel_backend, with_grad_norm=with_grad_norm,
-        with_step_stats=with_step_stats,
+        with_step_stats=with_step_stats, grad_bucket_bytes=grad_bucket_bytes,
     )
     return jax.jit(
         _make_pipeline_epoch_core(step, unroll, with_grad_norm, with_step_stats),
@@ -956,6 +1015,7 @@ def make_pipeline_run(
     eval_mubatch_size=None,
     kernel_backend="xla",
     with_grad_norm=False,
+    grad_bucket_bytes=0,
 ):
     """Epochs-outer scan around the pipeline epoch: the whole multi-epoch run
     as ONE XLA program over the mesh (the pipeline counterpart of
@@ -977,12 +1037,14 @@ def make_pipeline_run(
     (ordinary scan outputs, so the run stays one fused program; this closes
     the mesh-fused-run gap docs/observability.md used to document).
 
-    ``n_epochs`` is static (one compile per value).
+    ``n_epochs`` is static (one compile per value); ``grad_bucket_bytes``
+    selects the gradient-sync mode (see make_pipeline_step).
     """
     step = make_pipeline_step(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
         tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
         kernel_backend=kernel_backend, with_grad_norm=with_grad_norm,
+        grad_bucket_bytes=grad_bucket_bytes,
     )
     eval_step = None
     if eval_prog is not None:

@@ -1,0 +1,272 @@
+"""Bucketed gradient synchronization: the DP sync as a PLANNED op sequence.
+
+The reference's headline DP feature is a *computation-overlapped,
+non-blocking* gradient all-reduce: its engine (pipe.py:302-327) issues one
+MPI ``Iallreduce`` per parameter as soon as that parameter's backward
+finishes, output layer first, and its docstring wishes it could bucket
+small tensors together. Our executor historically collapsed all of that
+into ONE whole-tree ``lax.psum`` at the ``BackwardGradAllReduce`` anchor —
+correct, but a single fat dependency: XLA cannot start any gradient
+communication until every leaf is ready, and nothing downstream (clip
+norm, the optimizer update) can start until the whole sync returns.
+
+This module restores the reference's structure in SPMD form. A
+``BucketPlan`` greedily packs the per-device gradient leaves into byte-
+bounded buckets in BACKWARD order (output layer first — the order the tick
+loop finalizes them), and the emitters issue one collective per bucket:
+
+- plain DP: each bucket's leaves are flattened into one contiguous vector
+  and ``lax.psum``'d — one all-reduce op per bucket in the compiled
+  program (verified by the program audit's census contract). Buckets have
+  no data dependence on each other, so XLA's latency-hiding scheduler is
+  free to overlap bucket k's all-reduce with the consumers of already-
+  synced buckets (norm partials, the elementwise update of their params);
+- ZeRO-1: the padded flat gradient is viewed as a ``(dp, chunk)`` matrix
+  (row d = the chunk replica d updates) and each bucket is a COLUMN range,
+  reduce-scattered with ``scatter_dimension=0, tiled=False`` — every
+  device receives exactly the same contiguous chunk slice the anchor
+  layout gives it, so the optimizer-state layout, the checkpoint mapping
+  and the single deferred ``all_gather`` of the updated chunk are all
+  untouched by bucketing.
+
+Numerics contract: ``psum``/``psum_scatter`` reduce ELEMENTWISE, and
+flatten/concat/slice are exact data movement, so per-bucket sync is
+**bitwise identical** to the anchor collective — the NumPy-oracle parity
+and cross-layout fuzz tests run unchanged over every bucket size
+(tests/test_gradsync.py asserts the bit-equality directly). ``bucket_bytes
+= 0`` disables planning entirely: the executor keeps its legacy anchor
+collective, same program byte for byte.
+
+The plan is pure host data (derived deterministically from the model spec
+and the knob), so the executor, the TrainingSession audit contract
+(observability/program_audit.expected_comms) and the bench rows all build
+the SAME plan and can never disagree about bucket count or sizes.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLeaf:
+    """One gradient leaf of the executor's per-device stacked tree."""
+
+    kind: str  # "W" | "b"
+    slot: int  # layer-slot index (executor.slot_shapes order)
+    shape: tuple  # per-device stacked shape: (V, o, i) for W, (V, o) for b
+
+    @property
+    def size(self):
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        return n
+
+    @property
+    def nbytes(self):
+        return 4 * self.size  # f32 gradients
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """A static bucketing of one layout's gradient sync.
+
+    ``mode="dp"``: ``buckets`` is a tuple of leaf groups (each a tuple of
+    ``BucketLeaf``), in backward order — the emitter issues one flat
+    ``psum`` per group. ``mode="zero1"``: ``buckets`` is a tuple of
+    ``(start, stop)`` column ranges over the per-replica chunk — the
+    emitter issues one ``psum_scatter`` per range (``dp`` records the
+    replica count the ranges were planned for).
+    """
+
+    mode: str  # "dp" | "zero1"
+    bucket_bytes: int  # the --grad-bucket-bytes knob that built the plan
+    buckets: tuple
+    dp: int = 1  # zero1 only: replicas (census result bytes = grad / dp)
+
+    @property
+    def num_buckets(self):
+        return len(self.buckets)
+
+    def bucket_grad_bytes(self):
+        """Per-bucket synced-gradient payload in bytes (what the byte
+        budget bounds): the full leaf bytes for DP buckets, ``dp x width``
+        chunk columns for ZeRO-1 buckets."""
+        if self.mode == "dp":
+            return [sum(l.nbytes for l in group) for group in self.buckets]
+        return [4 * self.dp * (b - a) for a, b in self.buckets]
+
+    def bucket_census_bytes(self):
+        """Per-bucket expected HLO RESULT bytes — what the program audit
+        matches against ``parse_collectives``: an all-reduce returns the
+        full bucket on every device; a reduce-scatter returns 1/dp of it."""
+        if self.mode == "dp":
+            return self.bucket_grad_bytes()
+        return [4 * (b - a) for a, b in self.buckets]
+
+    def total_grad_bytes(self):
+        return sum(self.bucket_grad_bytes())
+
+    def describe(self):
+        """JSON-able plan summary (metrics / bench record lines)."""
+        return {
+            "mode": self.mode,
+            "grad_bucket_bytes": int(self.bucket_bytes),
+            "num_buckets": self.num_buckets,
+            "bucket_grad_bytes": self.bucket_grad_bytes(),
+            "bucket_census_bytes": self.bucket_census_bytes(),
+            "total_grad_bytes": self.total_grad_bytes(),
+        }
+
+
+def _stacked_leaves(spec, pp):
+    """The executor's per-device gradient leaves in BACKWARD order: the
+    tick loop's ``_stage_bwd`` finalizes slot L-1 (the output layer) first
+    and computes each slot's dW and db together, so the bucket order is
+    [W_{L-1}, b_{L-1}, ..., W_0, b_0]."""
+    from shallowspeed_tpu.parallel.executor import slot_shapes
+
+    dims = slot_shapes(spec)
+    V = spec.n_stages // pp
+    leaves = []
+    for l in reversed(range(len(dims))):
+        o, i = dims[l]
+        leaves.append(BucketLeaf("W", l, (V, o, i)))
+        leaves.append(BucketLeaf("b", l, (V, o)))
+    return leaves
+
+
+def plan_dp_buckets(spec, pp, bucket_bytes):
+    """Greedy byte-bounded bucketing of the stacked gradient tree for the
+    plain-DP all-reduce. Returns None when ``bucket_bytes`` is falsy (the
+    legacy whole-tree anchor psum). Every leaf lands in exactly one
+    bucket; backward order is preserved; a bucket is closed as soon as
+    adding the next leaf would exceed the budget (a single oversized leaf
+    still gets its own bucket — the plan never splits a leaf)."""
+    if not bucket_bytes:
+        return None
+    bucket_bytes = int(bucket_bytes)
+    buckets, current, current_bytes = [], [], 0
+    for leaf in _stacked_leaves(spec, pp):
+        if current and current_bytes + leaf.nbytes > bucket_bytes:
+            buckets.append(tuple(current))
+            current, current_bytes = [], 0
+        current.append(leaf)
+        current_bytes += leaf.nbytes
+    if current:
+        buckets.append(tuple(current))
+    return BucketPlan(mode="dp", bucket_bytes=bucket_bytes, buckets=tuple(buckets))
+
+
+def plan_zero1_buckets(spec, dp, pp, bucket_bytes):
+    """Byte-bounded bucketing of the ZeRO-1 reduce-scatter: column ranges
+    over the per-replica chunk of the padded flat gradient. Each bucket
+    covers ``dp x width`` gradient elements (one width-slice of EVERY
+    replica's chunk), so the scatter's output concatenation reproduces the
+    anchor chunk exactly. Returns None when ``bucket_bytes`` is falsy."""
+    if not bucket_bytes:
+        return None
+    bucket_bytes = int(bucket_bytes)
+    from shallowspeed_tpu.parallel.executor import stacked_flat_len
+
+    csz = -(-stacked_flat_len(spec, pp) // dp)
+    width = max(1, bucket_bytes // (4 * dp))
+    ranges = tuple(
+        (a, min(a + width, csz)) for a in range(0, csz, width)
+    )
+    return BucketPlan(
+        mode="zero1", bucket_bytes=bucket_bytes, buckets=ranges, dp=int(dp)
+    )
+
+
+def plan_buckets(spec, dp, pp, bucket_bytes, zero1=False):
+    """The one layout->plan dispatch: the executor's emitters, the
+    session's audit contract and the bench rows all plan through here, so
+    they can never pick different planners for the same layout. Returns
+    None when ``bucket_bytes`` is falsy (the legacy anchor sync)."""
+    if zero1:
+        return plan_zero1_buckets(spec, dp, pp, bucket_bytes)
+    return plan_dp_buckets(spec, pp, bucket_bytes)
+
+
+def psum_bucketed(grads, plan, axis_name="dp"):
+    """Per-bucket DP gradient sync: for each bucket, flatten its leaves
+    into ONE contiguous vector, ``lax.psum`` it (one all-reduce op per
+    bucket in the compiled program), and scatter the summed values back
+    into the tree. Elementwise reduction + exact data movement = bitwise
+    identical to the whole-tree anchor psum.
+
+    ``grads``: the executor's per-device ``{"W": tuple, "b": tuple}``.
+    Returns the same structure, fully summed over ``axis_name``.
+    """
+    out = {"W": list(grads["W"]), "b": list(grads["b"])}
+    for group in plan.buckets:
+        flat = jnp.concatenate(
+            [grads[l.kind][l.slot].reshape(-1) for l in group]
+        )
+        summed = lax.psum(flat, axis_name)
+        off = 0
+        for l in group:
+            out[l.kind][l.slot] = summed[off : off + l.size].reshape(l.shape)
+            off += l.size
+    return {"W": tuple(out["W"]), "b": tuple(out["b"])}
+
+
+def psum_scatter_bucketed(gvec_padded, plan, axis_name="dp"):
+    """Per-bucket ZeRO-1 gradient sync: view the padded flat gradient as
+    ``(dp, chunk)`` — row d is the contiguous chunk replica d updates —
+    and reduce-scatter each COLUMN range with ``scatter_dimension=0,
+    tiled=False`` (one reduce-scatter op per bucket). Concatenating the
+    per-bucket outputs reproduces this replica's anchor chunk exactly
+    (same elements, same order), so the chunked update, the optimizer-
+    state layout and the deferred all_gather are untouched by bucketing.
+    """
+    csz = gvec_padded.shape[0] // plan.dp
+    mat = gvec_padded.reshape(plan.dp, csz)
+    pieces = [
+        lax.psum_scatter(
+            mat[:, a:b], axis_name, scatter_dimension=0, tiled=False
+        )
+        for a, b in plan.buckets
+    ]
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+
+def sync_comm_bytes(spec, dp, pp, zero1=False, plan=None):
+    """The dp-axis leg of the analytical comms contract
+    (observability/program_audit.expected_comms): ring-algorithm wire
+    bytes PER DEVICE PER STEP for the gradient sync, with the bucketing
+    plan's per-collective breakdown when one is active. Bucketing never
+    changes the TOTAL bytes — ``2 (dp-1)/dp x payload`` whether the
+    payload moves as one collective or N — only how many ops carry them,
+    which is exactly what the census contract verifies.
+    """
+    from shallowspeed_tpu.parallel.executor import stacked_flat_len
+
+    flat = stacked_flat_len(spec, pp)
+    if zero1:
+        csz = -(-flat // dp)
+        payload = 4 * csz * dp  # the padded flat vector
+        axis = {
+            "kind": "reduce_scatter+all_gather",
+            "algorithm": "ring",
+            "grad_bytes_per_device": payload,
+            "bytes_per_step_per_device": 2 * (dp - 1) / dp * payload,
+        }
+    else:
+        payload = 4 * flat  # this device's padded stacked gradient
+        axis = {
+            "kind": "all_reduce",
+            "algorithm": "ring",
+            "grad_bytes_per_device": payload,
+            "bytes_per_step_per_device": 2 * (dp - 1) / dp * payload,
+        }
+    axis["mode"] = "anchor" if plan is None else "bucketed"
+    if plan is not None:
+        axis["grad_bucket_bytes"] = int(plan.bucket_bytes)
+        axis["num_buckets"] = plan.num_buckets
+        axis["bucket_grad_bytes"] = plan.bucket_grad_bytes()
+        axis["bucket_census_bytes"] = plan.bucket_census_bytes()
+    return axis

@@ -187,6 +187,12 @@ def program_comm_bytes(prog, spec, mubatch_size):
     direction, one tick), ``wire_bytes_per_device`` (2 x ticks x payload),
     ``useful_bytes_per_device`` (mean over devices of the send-table
     bytes), ``useful_sends`` (total send-table count), ``num_ticks``.
+
+    This function covers the pp-axis relay only. The dp-axis gradient-sync
+    leg — one anchor collective, or one collective PER BYTE-BUCKET when
+    ``grad_bucket_bytes > 0`` — is modeled by
+    ``parallel/gradsync.sync_comm_bytes`` (same per-bucket numbers the
+    executor's emitters lower and the program audit verifies).
     """
     from shallowspeed_tpu.parallel.executor import relay_width
 

@@ -99,6 +99,24 @@ def test_mesh_cli_dp2_pp2(tiny_data):
     assert re.search(r"final model hash: [0-9a-f]{40}", out)
 
 
+def test_mesh_cli_grad_bucket_bytes_matches_anchor(tiny_data):
+    """--grad-bucket-bytes through the real CLI (with --audit enforcing
+    the bucketed census): the final model hash must equal the anchor
+    run's — the knob is a scheduling choice, never a numerics one."""
+    common = [
+        "--dp", "2", "--epochs", "1", "--global-batch-size", "32",
+        "--mubatches", "2", "--no-eval", "--audit",
+    ]
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    anchor = _run(common, tiny_data, extra_env=env)
+    bucketed = _run(
+        common + ["--grad-bucket-bytes", "65536"], tiny_data, extra_env=env
+    )
+    h = re.compile(r"final model hash: ([0-9a-f]{40})")
+    assert h.search(anchor).group(1) == h.search(bucketed).group(1)
+    assert "DP replicas in sync" in bucketed
+
+
 def test_mesh_cli_interleaved_zero1_momentum(tiny_data):
     """The round-2 flag surface in one run: interleaved virtual stages,
     ZeRO-1 sharded momentum."""

@@ -291,6 +291,144 @@ def test_report_flags_nan_steps_and_halt_verdict(tmp_path, capsys):
     assert "x" in report.sparkline([0.5, float("nan"), 0.5])
 
 
+def _audit_record_with_bounds():
+    """A minimal xla_audit record carrying the comms model's overlap
+    fields (the shape TrainingSession(audit=True) emits)."""
+    return {
+        "v": SCHEMA_VERSION, "ts": 0.0, "kind": "xla_audit",
+        "name": "epoch_program", "hlo_available": True,
+        "census": {"all_reduce": {"count": 3, "bytes": 3072}},
+        "memory": None, "n_devices": 2,
+        "expected": {
+            "dp": 2, "pp": 1, "zero1": False, "sequential": False,
+            "required": ["all_reduce"], "forbidden": [],
+            "axes": {"dp": {"kind": "all_reduce", "mode": "bucketed",
+                            "num_buckets": 3,
+                            "grad_bucket_bytes": 1024,
+                            "bucket_grad_bytes": [1024, 1024, 1024],
+                            "bytes_per_step_per_device": 3072}},
+            "bytes_per_step_per_device": 3072,
+            "comms_time_per_step_s": 4e-6,
+            "compute_time_per_step_s": 1e-6,
+            "bound": "comms",
+            "serial_bound_s": 5e-6,
+            "overlapped_bound_s": 4e-6,
+            "model_hidden_comm_share": 0.25,
+        },
+        "mismatches": [], "census_ok": True,
+    }
+
+
+def test_report_overlap_row_model_and_measured(tmp_path, capsys):
+    """The overlap-efficiency row: the comms model's hidden-comm bound by
+    default, upgraded to the measured trace split when one is given."""
+    path = tmp_path / "ov.jsonl"
+    path.write_text(json.dumps(_audit_record_with_bounds()) + "\n")
+    assert report.main([str(path), "--format", "text"]) == 0
+    out = capsys.readouterr().out
+    assert "overlap efficiency" in out
+    assert "25.00% of comm hideable (model bound; 3 buckets)" in out
+    assert "serial (anchor)" in out and "max(comm, compute)" in out
+
+    records = read_jsonl(path)
+    rep = report.build_report(
+        records,
+        trace={
+            "overlap_efficiency": 0.87, "comm_ms": 10.0,
+            "exposed_comm_ms": 1.3, "comm_fraction": 0.2,
+        },
+    )
+    assert rep["overlap"]["source"] == "measured"
+    assert rep["overlap"]["hidden_comm_share"] == 0.87
+    # the model's bounds survive alongside the measured share
+    assert rep["overlap"]["serial_bound_s"] == 5e-6
+    out = report.render(rep, "text")
+    assert "87.00% of comm hidden (measured" in out
+
+
+def test_report_trace_flag_measures_overlap(tmp_path, capsys):
+    """--trace: a chrome trace's comm/compute split feeds the measured
+    overlap-efficiency row (exposed = span not coverable by compute)."""
+    import gzip
+
+    trace = {
+        "traceEvents": [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            # comm spans the full 100 us; compute covers 60 of them ->
+            # 40 us exposed of 100 us comm -> 60% hidden
+            {"ph": "X", "pid": 1, "tid": 1, "name": "all-reduce.1",
+             "ts": 0, "dur": 100},
+            {"ph": "X", "pid": 1, "tid": 2, "name": "fusion.2",
+             "ts": 0, "dur": 60},
+        ]
+    }
+    tpath = tmp_path / "x.trace.json.gz"
+    with gzip.open(tpath, "wt") as f:
+        json.dump(trace, f)
+    from shallowspeed_tpu.observability import trace_stats
+
+    s = trace_stats.summarize(tpath)
+    assert s["comm_ms"] == 0.1 and s["compute_ms"] == 0.06
+    assert s["exposed_comm_ms"] == pytest.approx(0.04)
+    assert s["overlap_efficiency"] == pytest.approx(0.6)
+
+    path = tmp_path / "run.jsonl"
+    path.write_text(json.dumps(_audit_record_with_bounds()) + "\n")
+    assert report.main(
+        [str(path), "--format", "text", "--trace", str(tmp_path)]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "60.00% of comm hidden (measured" in out
+
+
+def test_trace_overlap_survives_multidevice_and_unit_overlap(tmp_path):
+    """The exposure math is a per-device interval union, so it is not
+    fooled by (a) several device pids sharing one wall span or (b)
+    functional-unit overlap where summed busy time exceeds the span —
+    busy-sum arithmetic would report exposed=0 for any such trace."""
+    import gzip
+
+    trace = {
+        "traceEvents": [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "pid": 2, "name": "process_name",
+             "args": {"name": "/device:TPU:1"}},
+            # device 0: comm [0,100], compute [0,40]+[20,60] on two unit
+            # threads (busy 100+40+40=180 > span 100) -> union(compute) =
+            # [0,60], exposed comm = 40
+            {"ph": "X", "pid": 1, "tid": 1, "name": "all-reduce.1",
+             "ts": 0, "dur": 100},
+            {"ph": "X", "pid": 1, "tid": 2, "name": "fusion.1",
+             "ts": 0, "dur": 40},
+            {"ph": "X", "pid": 1, "tid": 3, "name": "fusion.2",
+             "ts": 20, "dur": 40},
+            # device 1: comm [0,50] + comm [25,75] (mutually overlapping
+            # — must NOT count as hidden: the union, 75, is the
+            # denominator) fully under compute [0,100] -> 0 exposed
+            # (device 0's compute must NOT be credited here either)
+            {"ph": "X", "pid": 2, "tid": 1, "name": "all-reduce.2",
+             "ts": 0, "dur": 50},
+            {"ph": "X", "pid": 2, "tid": 3, "name": "all-reduce.3",
+             "ts": 25, "dur": 50},
+            {"ph": "X", "pid": 2, "tid": 2, "name": "fusion.3",
+             "ts": 0, "dur": 100},
+        ]
+    }
+    tpath = tmp_path / "multi.trace.json.gz"
+    with gzip.open(tpath, "wt") as f:
+        json.dump(trace, f)
+    from shallowspeed_tpu.observability import trace_stats
+
+    s = trace_stats.summarize(tpath)
+    assert s["comm_ms"] == pytest.approx(0.2)  # summed busy time
+    assert s["comm_union_ms"] == pytest.approx(0.175)  # 100 + 75
+    assert s["exposed_comm_ms"] == pytest.approx(0.04)
+    # hidden share over the comm interval UNION: 1 - 40/175
+    assert s["overlap_efficiency"] == pytest.approx(1 - 40 / 175, abs=1e-3)
+
+
 def test_sparkline_shapes():
     assert report.sparkline([]) == ""
     assert len(report.sparkline(list(range(1000)), width=60)) == 60

@@ -72,6 +72,11 @@ def test_trace_stats_reproduces_roofline_numbers():
     # matmuls present and dominated in count by small fusions — the
     # op-stream (not FLOPs) picture the roofline section describes
     assert s["top_ops"].get("convolution_add_fusion", 0) > 10000
+    # a sequential chip trace has no collectives: the overlap split must
+    # say so (no comm -> no efficiency claim), not fabricate a number
+    assert s["comm_ops"] == 0 and s["comm_ms"] == 0.0
+    assert s["exposed_comm_ms"] == 0.0
+    assert s["overlap_efficiency"] is None
 
 
 def test_train_cli_help():
@@ -82,7 +87,10 @@ def test_train_cli_help():
         timeout=120,
     )
     assert r.returncode == 0
-    for flag in ("--dp", "--pp", "--schedule", "--checkpoint", "--resume", "--precision"):
+    for flag in (
+        "--dp", "--pp", "--schedule", "--checkpoint", "--resume",
+        "--precision", "--grad-bucket-bytes",
+    ):
         assert flag in r.stdout
 
 

@@ -75,6 +75,19 @@ def main():
         "(reduce_scatter grads, per-replica chunk update, all_gather params; "
         "mesh layouts only — beyond the reference)",
     )
+    ap.add_argument(
+        "--grad-bucket-bytes",
+        type=int,
+        default=0,
+        help="mesh layouts: bucket the DP gradient sync — the backward-"
+        "ordered gradient tree is greedily packed into buckets of at most "
+        "this many bytes and each bucket is synced by its OWN collective "
+        "(all-reduce; reduce-scatter slice under --zero1), so XLA can "
+        "overlap bucket communication with the update's compute. 0 "
+        "(default) keeps the single whole-tree anchor psum. Bitwise-"
+        "identical numerics either way; --audit verifies the bucket count "
+        "and sizes in the compiled program (see docs/performance.md)",
+    )
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--no-eval", action="store_true", help="skip per-epoch accuracy")
     ap.add_argument(
@@ -235,6 +248,7 @@ def main():
         momentum=args.momentum,
         virtual_stages=args.virtual_stages,
         zero1=args.zero1,
+        grad_bucket_bytes=args.grad_bucket_bytes,
         scan_unroll=args.scan_unroll,
         tick_unroll=args.tick_unroll,
         weight_decay=args.weight_decay,
