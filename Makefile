@@ -7,7 +7,7 @@ CPU_MESH = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 SHELL := /bin/bash
 
 .PHONY: test verify metrics-smoke report-smoke audit-smoke overlap-smoke \
-        data train train-mesh bench bench-scaling schedules clean
+        split-smoke data train train-mesh bench bench-scaling schedules clean
 
 test:
 	python -m pytest tests/ -q
@@ -80,6 +80,43 @@ overlap-smoke:
 	  grep -q "gradient sync: bucketed" $$f.report.md; \
 	done
 	@echo "overlap-smoke OK: bucketed census + overlap-efficiency row on dp2 and zero1"
+
+# split-backward end-to-end: 1 CPU epoch each for pp4 gpipe and pp4
+# pipedream with --backward-split --audit (train.py aborts nonzero if the
+# split program's collective census violates the layout contract), plus an
+# UNSPLIT twin of each — then assert the xla_audit census is clean, the
+# pipeline_program record is backward_split with a weighted bubble strictly
+# below the unsplit twin's, the report renders the weighted-bubble row, and
+# the final model hash EQUALS the unsplit run's (the bitwise-parity
+# contract), exit 0 (needs data, like metrics-smoke)
+split-smoke:
+	rm -f /tmp/split_gpipe.jsonl /tmp/split_pd.jsonl \
+	    /tmp/split_gpipe_ref.jsonl /tmp/split_pd_ref.jsonl \
+	    /tmp/split_gpipe.out /tmp/split_gpipe_ref.out \
+	    /tmp/split_pd.out /tmp/split_pd_ref.out
+	set -o pipefail; $(CPU_MESH) python train.py --epochs 1 --no-eval \
+	    --audit --pp 4 --schedule gpipe --backward-split \
+	    --metrics-out /tmp/split_gpipe.jsonl | tee /tmp/split_gpipe.out
+	set -o pipefail; $(CPU_MESH) python train.py --epochs 1 --no-eval \
+	    --pp 4 --schedule gpipe \
+	    --metrics-out /tmp/split_gpipe_ref.jsonl | tee /tmp/split_gpipe_ref.out
+	set -o pipefail; $(CPU_MESH) python train.py --epochs 1 --no-eval \
+	    --audit --pp 4 --schedule pipedream --backward-split \
+	    --metrics-out /tmp/split_pd.jsonl | tee /tmp/split_pd.out
+	set -o pipefail; $(CPU_MESH) python train.py --epochs 1 --no-eval \
+	    --pp 4 --schedule pipedream \
+	    --metrics-out /tmp/split_pd_ref.jsonl | tee /tmp/split_pd_ref.out
+	set -e; for f in /tmp/split_gpipe /tmp/split_pd; do \
+	  split_h=$$(grep -o 'final model hash: [0-9a-f]*' $$f.out); \
+	  ref_h=$$(grep -o 'final model hash: [0-9a-f]*' $${f}_ref.out); \
+	  test -n "$$split_h" && test "$$split_h" = "$$ref_h" \
+	    || { echo "$$f: HASH MISMATCH split [$$split_h] vs unsplit [$$ref_h]"; exit 1; }; \
+	  echo "$$f: split hash == unsplit hash"; \
+	  python -c "import json,sys; p=sys.argv[1]; recs=[json.loads(l) for l in open(p+'.jsonl') if l.strip()]; a=[r for r in recs if r.get('kind')=='xla_audit']; assert a, p+': no xla_audit record'; assert all(r.get('census_ok') for r in a), p+': census mismatch'; prog=[r for r in recs if r.get('kind')=='event' and r.get('name')=='pipeline_program'][-1]; assert prog['backward_split'], p+': program not split'; ref=[json.loads(l) for l in open(p+'_ref.jsonl') if l.strip()]; rprog=[r for r in ref if r.get('kind')=='event' and r.get('name')=='pipeline_program'][-1]; assert not rprog['backward_split']; assert prog['weighted_bubble_fraction'] < rprog['weighted_bubble_fraction'], p+': weighted bubble did not shrink (%.3f vs unsplit %.3f)' % (prog['weighted_bubble_fraction'], rprog['weighted_bubble_fraction']); print(p+': split census clean, weighted bubble %.1f%% < unsplit %.1f%%' % (100*prog['weighted_bubble_fraction'], 100*rprog['weighted_bubble_fraction']))" $$f; \
+	  python -m shallowspeed_tpu.observability.report $$f.jsonl --format md > $$f.report.md; \
+	  grep -q "weighted bubble" $$f.report.md; \
+	done
+	@echo "split-smoke OK: bitwise hash parity + clean census + weighted-bubble row on gpipe and pipedream"
 
 data:
 	python prepare_data.py

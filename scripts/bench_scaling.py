@@ -80,10 +80,12 @@ def bench_sequential(nb, reps, sizes=SIZES):
 
 def _pipeline_epoch_setup(
     dp, pp, sched_name, nb, virtual=1, sizes=SIZES, zero1=False,
-    optimizer=None, grad_bucket_bytes=0,
+    optimizer=None, grad_bucket_bytes=0, backward_split=False,
 ):
     """Build one mesh config's epoch fn + initial state + data: the shared
-    setup behind the plain timing rows and the same-window pairs."""
+    setup behind the plain timing rows and the same-window pairs. Returns
+    the lowered TickProgram first, so pair benchmarks that record program
+    metrics describe exactly the program they time."""
     import jax.numpy as jnp
 
     from shallowspeed_tpu import model as Mo
@@ -95,7 +97,10 @@ def _pipeline_epoch_setup(
     mesh = make_mesh(dp, pp)
     spec = Mo.make_model_spec(sizes, pp * virtual, B)
     order = E.interleave_order(pp * virtual, pp) if virtual > 1 else None
-    prog = lower_schedule(S.SCHEDULES[sched_name], M, pp, virtual=virtual)
+    prog = lower_schedule(
+        S.SCHEDULES[sched_name], M, pp, virtual=virtual,
+        backward_split=backward_split,
+    )
     stacked, flags = E.init_stacked(spec, mesh, order=order)
     opt = make_optimizer(optimizer, 2e-4) if optimizer else SGD(LR)
     epoch = E.make_pipeline_epoch(
@@ -104,7 +109,7 @@ def _pipeline_epoch_setup(
     )
     st = E.zero1_init_state(opt, spec, mesh) if zero1 else opt.init(stacked)
     X, Y = _data(nb, np.random.RandomState(0))
-    return spec, epoch, stacked, flags, st, jnp.asarray(X), jnp.asarray(Y)
+    return prog, epoch, stacked, flags, st, jnp.asarray(X), jnp.asarray(Y)
 
 
 def bench_pipeline(
@@ -189,6 +194,62 @@ def bench_sync_pair(name, cfg, nb):
     return records
 
 
+# split-vs-unsplit backward pairs at pp4 (gpipe + 1F1B): same-window via the
+# interleaved-trial slope protocol, like the gradient-sync pairs. The split
+# schedule's win is FLOP-weighted bubble time (the record carries both
+# programs' weighted bubble fractions); on emulated CPU devices the extra
+# OP_BWD_W ticks are pure op-issue overhead with nothing to overlap, so —
+# exactly like grad bucketing — expect the unsplit row to win here and the
+# ratio to mean something only on a real multi-chip mesh.
+SPLIT_PAIRS = [
+    ("pp4-gpipe-split", dict(dp=1, pp=4, sched="gpipe")),
+    ("pp4-pipedream-split", dict(dp=1, pp=4, sched="pipedream")),
+]
+
+
+def bench_split_pair(name, cfg, nb):
+    """One unsplit-vs-split backward pair, same-window: returns a list of
+    record dicts (one per mode) carrying backward_split + the lowered
+    programs' weighted bubble fractions so a MULTICHIP capture of these
+    rows is self-describing."""
+    from bench import make_run_k, slope_epoch_seconds_many
+
+    from shallowspeed_tpu.parallel.lowering import weighted_utilization
+
+    dp, pp = cfg["dp"], cfg["pp"]
+    modes = {f"{name}-unsplit": False, f"{name}-split": True}
+    run_ks, wbubble = {}, {}
+    for label, bs in modes.items():
+        # the setup's own lowered program feeds the recorded metric, so
+        # the weighted bubble always describes the program being timed
+        prog, epoch, stacked, flags, st, Xj, Yj = _pipeline_epoch_setup(
+            dp, pp, cfg["sched"], nb, backward_split=bs
+        )
+        wbubble[label] = round(1.0 - weighted_utilization(prog), 4)
+
+        def epoch_fn(p, s, X, Y, _epoch=epoch, _flags=flags):
+            return _epoch(p, _flags, s, X, Y)
+
+        run_ks[label] = make_run_k(epoch_fn, stacked, st, Xj, Yj)
+    slopes = slope_epoch_seconds_many(run_ks, k1=1, k2=3, trials=2, min_delta_s=0)
+    unsplit_sps = nb * B / slopes[f"{name}-unsplit"]
+    records = []
+    for label, bs in modes.items():
+        sps = nb * B / slopes[label]
+        records.append(
+            {
+                "config": label,
+                "devices": dp * pp,
+                "samples_per_sec": round(sps, 1),
+                "backward_split": bs,
+                "weighted_bubble_fraction": wbubble[label],
+                "same_window": True,
+                "vs_unsplit": round(sps / unsplit_sps, 4),
+            }
+        )
+    return records
+
+
 CONFIGS = [
     # the five BASELINE.md configs...  (name, kwargs)
     ("seq", dict(dp=1, pp=1)),
@@ -258,6 +319,15 @@ def main():
             print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
             continue
         for rec in bench_sync_pair(name, cfg, args.batches):
+            print(json.dumps(rec))
+
+    # the unsplit-vs-split backward pairs (same-window per pair)
+    for name, cfg in SPLIT_PAIRS:
+        need = cfg["dp"] * cfg["pp"]
+        if need > n_dev:
+            print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
+            continue
+        for rec in bench_split_pair(name, cfg, args.batches):
             print(json.dumps(rec))
 
 

@@ -6,9 +6,17 @@ the ACTUAL lowered tick program, so what you see is exactly what the SPMD
 executor will run — forward cells, backward cells, and the bubbles.
 
     python scripts/show_schedule.py gpipe --mubatches 4 --stages 4
+    python scripts/show_schedule.py pipedream --backward-split
     python scripts/show_schedule.py --all
 
-Legend: F<m> forward of microbatch m · B<m> backward · '.' bubble (noop tick).
+Legend: F<m> forward of microbatch m · B<m> combined backward · b<m>
+backward-input (split: the relay-critical dgrad half) · W<m>
+backward-weight (split: the deferred wgrad half, packed into bubbles) ·
+'.' bubble (noop tick).
+
+Each diagram prints BOTH utilizations: equal-weight (active cells / all
+cells) and FLOP-weighted (a combined backward cell is 2x a forward's work;
+the split halves are 1x each — the metric that can see the split win).
 """
 
 import argparse
@@ -20,16 +28,20 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from shallowspeed_tpu import schedules as S  # noqa: E402
 from shallowspeed_tpu.parallel.lowering import (  # noqa: E402
     OP_BWD,
+    OP_BWD_W,
     OP_FWD,
     lower_schedule,
     utilization,
+    weighted_utilization,
 )
 
 ALL = {**S.SCHEDULES, "inference": S.InferenceSchedule}
 
 
-def render(name, M, stages, virtual=1):
-    prog = lower_schedule(ALL[name], M, stages, virtual=virtual)
+def render(name, M, stages, virtual=1, backward_split=False):
+    prog = lower_schedule(
+        ALL[name], M, stages, virtual=virtual, backward_split=backward_split
+    )
     # interleaved cells carry the virtual chunk as a suffix: F2'1 = forward
     # of microbatch 2, chunk 1
     width = max(2, len(str(M - 1)) + 1) + (2 if virtual > 1 else 0)
@@ -42,15 +54,22 @@ def render(name, M, stages, virtual=1):
             if op == OP_FWD:
                 cells.append(f"F{mb}{ck}".ljust(width))
             elif op == OP_BWD:
-                cells.append(f"B{mb}{ck}".ljust(width))
+                # split programs: lowercase b = B-input (dgrad half only)
+                tag = "b" if prog.backward_split else "B"
+                cells.append(f"{tag}{mb}{ck}".ljust(width))
+            elif op == OP_BWD_W:
+                cells.append(f"W{mb}{ck}".ljust(width))
             else:
                 cells.append(".".ljust(width))
         lines.append(f"stage {s} │ " + " ".join(cells))
     util = utilization(prog)
+    wutil = weighted_utilization(prog)
     vtag = f" V={virtual}" if virtual > 1 else ""
+    stag = " split-bwd" if prog.backward_split else ""
     header = (
-        f"{name}  M={M} S={stages}{vtag}: {prog.num_ticks} ticks, "
-        f"utilization {util * 100:.0f}% (bubbles {100 - util * 100:.0f}%)"
+        f"{name}{stag}  M={M} S={stages}{vtag}: {prog.num_ticks} ticks, "
+        f"utilization {util * 100:.0f}% (bubbles {100 - util * 100:.0f}%) · "
+        f"weighted {wutil * 100:.0f}% (bubbles {100 - wutil * 100:.0f}%)"
     )
     print(header)
     print("─" * len(header))
@@ -69,6 +88,12 @@ def main():
     ap.add_argument(
         "--virtual", "-v", type=int, default=1,
         help="virtual stages per device (interleaved schedule only)",
+    )
+    ap.add_argument(
+        "--backward-split", action="store_true",
+        help="render the two-stage backward variant: b<m> = B-input at the "
+        "combined backward's tick, W<m> = deferred B-weight packed into "
+        "bubbles (gpipe/pipedream/naive)",
     )
     ap.add_argument(
         "--all",
@@ -95,7 +120,14 @@ def main():
                 f"M={args.mubatches}, S={args.stages})\n"
             )
             continue
-        render(name, args.mubatches, args.stages, virtual=v)
+        # split applies to the flat training schedules only (the inference
+        # relay has no backward; interleaved is lowering-rejected)
+        split = args.backward_split and name not in ("interleaved", "inference")
+        if args.backward_split and name in ("interleaved", "inference"):
+            if args.schedule == name:
+                raise SystemExit(f"--backward-split does not apply to {name}")
+            print(f"{name}  (rendered without --backward-split)\n")
+        render(name, args.mubatches, args.stages, virtual=v, backward_split=split)
 
 
 if __name__ == "__main__":

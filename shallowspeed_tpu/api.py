@@ -81,6 +81,7 @@ class TrainingSession:
         virtual_stages=1,
         zero1=False,
         grad_bucket_bytes=0,
+        backward_split=False,
         scan_unroll=1,
         tick_unroll=1,
         weight_decay=0.0,
@@ -206,6 +207,25 @@ class TrainingSession:
                 "the sequential path has no gradient sync — use dp/pp > 1 "
                 "(0 keeps the legacy anchor psum on mesh layouts)"
             )
+        self._backward_split = bool(backward_split)
+        if self._backward_split:
+            if self._sequential:
+                raise ValueError(
+                    "backward_split is a pipeline-schedule property (B-input "
+                    "at the relay tick, B-weight deferred into bubbles); the "
+                    "sequential path has no schedule — use dp/pp > 1"
+                )
+            if virtual_stages > 1:
+                raise ValueError(
+                    "backward_split is not supported with interleaved "
+                    "virtual stages (the chunked steady state interleaves "
+                    "its own bubbles; splitting its backward is future work)"
+                )
+            if kernel_backend == "pallas":
+                raise ValueError(
+                    "backward_split needs the XLA per-slot backward; the "
+                    "fused pallas flag kernel has no split halves"
+                )
         self.epoch = 0
 
         data_dir = data_dir or default_data_dir()
@@ -365,7 +385,8 @@ class TrainingSession:
             self.mesh = make_mesh(dp, pp, devices)
             with self._metrics.span("schedule_lower"):
                 prog = lower_schedule(
-                    S.SCHEDULES[schedule], mubatches, pp, virtual=self.V
+                    S.SCHEDULES[schedule], mubatches, pp, virtual=self.V,
+                    backward_split=self._backward_split,
                 )
             if self._metrics.enabled:
                 # per-tick program stats, recorded once at lowering time:

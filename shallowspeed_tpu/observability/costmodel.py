@@ -44,6 +44,17 @@ PEAK_FLOPS_PER_CHIP = {
 
 ENV_PEAK = "SHALLOWSPEED_PEAK_FLOPS"
 
+# Relative per-tick FLOP weights of the pipeline executor's compute ops, in
+# units of one forward's matmul work (2P per microbatch, P = the padded
+# per-slot weight count — every op runs the same slot stack, so the RATIOS
+# are exact regardless of stage): a combined backward is dgrad 2P + wgrad 2P
+# = 2 forwards; the split halves are one forward each. This is the single
+# source for ``lowering.weighted_utilization`` / ``weighted_makespan`` —
+# the metric that can see the split-backward win (equal-weight utilization
+# counts a 4P backward cell and a 2P forward cell the same, so it scores a
+# schedule that splits backwards WORSE while the lockstep step time drops).
+PIPELINE_OP_COSTS = {"fwd": 1.0, "bwd": 2.0, "bwd_in": 1.0, "bwd_w": 1.0}
+
 
 def mlp_train_flops_per_sample(sizes):
     """Analytical training FLOPs per sample: fwd 2P + bwd 4P (dgrad 2P +

@@ -29,7 +29,9 @@ human or a bench gate actually asks of a run:
   XLA-reported FLOPs), with the peak's provenance so a nominal-CPU MFU
   cannot pass for a datasheet one;
 - the span breakdown (where the host-side wall time went);
-- the pipeline program's bubble fraction (mesh layouts);
+- the pipeline program's bubble fraction (mesh layouts) — equal-weight AND
+  FLOP-weighted (the weighted row is what moves under ``--backward-split``:
+  deferred B-weights pack into bubble ticks, see docs/lowering.md);
 - a step-loss sparkline from the flight-recorder ``step`` records;
 - the numerics health verdict (ok / N findings / halted-at-step).
 
@@ -166,6 +168,11 @@ def build_report(records, source="", trace=None):
     bubble = (
         prog.get("bubble_fraction") if prog else gauges.get("pipeline.bubble_fraction")
     )
+    # the FLOP-weighted bubble (PR5): the number that can see the
+    # split-backward win — a combined backward tick costs 2x a forward's
+    # work, so equal-weight cells under-state heavy-tick bubbles
+    weighted_bubble = prog.get("weighted_bubble_fraction") if prog else None
+    backward_split = bool(prog.get("backward_split")) if prog else False
 
     findings = [r for r in records if r.get("kind") == "health"]
     halted = [f for f in findings if f.get("action") == "halt"]
@@ -219,6 +226,8 @@ def build_report(records, source="", trace=None):
         "xla_audit": audit,
         "overlap": overlap,
         "bubble_fraction": bubble,
+        "weighted_bubble_fraction": weighted_bubble,
+        "backward_split": backward_split,
         "spans": span_rows,
         "steps": len(steps),
         "step_loss_sparkline": sparkline(step_losses) if steps else None,
@@ -370,6 +379,18 @@ def _rows(report):
         rows.append(("final accuracy", _fmt_num(report["final_accuracy"], pct=True)))
     if report["bubble_fraction"] is not None:
         rows.append(("pipeline bubble", _fmt_num(report["bubble_fraction"], pct=True)))
+    if report.get("weighted_bubble_fraction") is not None:
+        rows.append(
+            (
+                "weighted bubble",
+                _fmt_num(report["weighted_bubble_fraction"], pct=True)
+                + (
+                    "  (split backward: B-weights packed into bubbles)"
+                    if report.get("backward_split")
+                    else "  (FLOP-weighted ticks)"
+                ),
+            )
+        )
     ov = report.get("overlap")
     if ov is not None:
         share = _fmt_num(ov.get("hidden_comm_share"), pct=True)

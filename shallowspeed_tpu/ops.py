@@ -78,15 +78,52 @@ def linear(x, w, b, precision=DEFAULT_PRECISION):
     return jnp.matmul(x, w.T, precision=precision) + jnp.reshape(b, (1, -1))
 
 
+def linear_grad_input(g, w, precision=DEFAULT_PRECISION):
+    """The relay-critical half of linear's VJP: dx = g @ w.
+
+    This is the ONLY product the upstream pipeline stage needs — it sits on
+    the inter-stage backward relay critical path (PipeDream, arxiv
+    1806.03377), which is why the split-backward schedules run it at the
+    tick the combined backward would have and defer the weight half.
+    """
+    return jnp.matmul(g, w, precision=precision)
+
+
+def linear_grad_weight(g, x, precision=DEFAULT_PRECISION):
+    """The deferrable half of linear's VJP: (dw, db) = (g.T @ x, sum_rows(g)).
+
+    Consumes only the stashed activation ``x`` and the (stashed) output-grad
+    ``g`` — nothing downstream of it relays anywhere, so a split schedule
+    (2BP, arxiv 2405.18047) may pack it into otherwise-idle bubble ticks.
+    """
+    dw = jnp.matmul(g.T, x, precision=precision)
+    db = g.sum(axis=0)
+    return dw, db
+
+
 def linear_grad(g, x, w, precision=DEFAULT_PRECISION):
     """VJP of linear: returns (dx, dw, db) = (g @ w, g.T @ x, sum_rows(g)).
 
-    Reference: functional.py:20-21.
+    Reference: functional.py:20-21. Expressed as the composition of the
+    split halves (``linear_grad_input`` + ``linear_grad_weight``) so the
+    combined and two-stage backward paths can never disagree: they are the
+    same expressions, executed at different ticks.
     """
-    dx = jnp.matmul(g, w, precision=precision)
-    dw = jnp.matmul(g.T, x, precision=precision)
-    db = g.sum(axis=0)
+    dx = linear_grad_input(g, w, precision=precision)
+    dw, db = linear_grad_weight(g, x, precision=precision)
     return dx, dw, db
+
+
+def linear_relu_grad_input(g, bitmask, w, precision=DEFAULT_PRECISION):
+    """Split B-input of the linear+relu unit: dx from W and the relu mask
+    (the stashed activation is NOT needed — only B-weight reads it)."""
+    return linear_grad_input(relu_grad(g, bitmask), w, precision=precision)
+
+
+def linear_relu_grad_weight(g, bitmask, x, precision=DEFAULT_PRECISION):
+    """Split B-weight of the linear+relu unit: (dw, db) from the stashed
+    activation and the stashed output-grad."""
+    return linear_grad_weight(relu_grad(g, bitmask), x, precision=precision)
 
 
 def linear_relu_fused(x, w, b, precision=DEFAULT_PRECISION):

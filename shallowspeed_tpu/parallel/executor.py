@@ -20,6 +20,13 @@ optimizer step — is ONE jitted XLA computation:
   ``pp`` (the reference's blocking Send/Recv pairs, pipe.py:367-381);
 - microbatch activation stashes (reference Module._cache) are fixed-shape
   ring buffers carried through the scan; mailbox slots come from the lowering;
+- split-backward programs (``backward_split`` schedules, 2BP arxiv
+  2405.18047) add a FOURTH switch branch: OP_BWD cells run only the
+  relay-critical dgrad chain (B-input, stashing the per-slot effective
+  output-grads into a grad-stash ring), and OP_BWD_W cells — packed by the
+  lowering into former bubble ticks — finish the deferred wgrads from the
+  activation + grad stashes, accumulating in the combined schedule's order
+  so the fp sums (and the weight hash) are bit-identical;
 - the DP gradient sync after the tick loop has TWO modes
   (``grad_bucket_bytes``): the legacy anchor — one ``jax.lax.psum`` of the
   whole accumulated gradient pytree over ``dp`` — or byte-bucketed
@@ -54,7 +61,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from shallowspeed_tpu import ops
 from shallowspeed_tpu.model import ModelSpec, init_model
 from shallowspeed_tpu.parallel.compat import shard_map
-from shallowspeed_tpu.parallel.lowering import OP_BWD, OP_FWD, TickProgram
+from shallowspeed_tpu.parallel.lowering import OP_BWD, OP_BWD_W, OP_FWD, TickProgram
 
 
 # ---------------------------------------------------------------------------
@@ -431,6 +438,43 @@ def _stage_bwd(Ws, active, relu, dims, xs, masks, g, precision, kernel_backend="
     return g, tuple(gWs), tuple(gbs)
 
 
+def _stage_bwd_input(Ws, active, relu, dims, masks, g, precision):
+    """The relay-critical half of the split backward: the dgrad chain only.
+
+    Returns ``(dx, g_effs)`` — the input gradient the upstream stage waits
+    for, plus the per-slot effective output-grads (the relu-masked ``g`` at
+    each slot, the SAME tensors the combined backward feeds its wgrad
+    matmuls). Those are free intermediates of the dx chain; the executor
+    stashes them so the deferred B-weight never recomputes a dgrad matmul.
+    Bit-parity: each slot's ``g_eff``/``dx`` expressions are character-
+    identical to ``_stage_bwd``'s, so the downstream wgrads are too.
+    """
+    L = len(dims)
+    g_effs = [None] * L
+    for l in reversed(range(L)):
+        o, i = dims[l]
+        g_l = _fit(g, o)
+        g_eff = jnp.where(relu[l], g_l * masks[l], g_l)
+        g_effs[l] = g_eff
+        dx = ops.linear_grad_input(g_eff, Ws[l], precision=precision)
+        g = jnp.where(active[l], dx, _fit(g_l, i))
+    return g, tuple(g_effs)
+
+
+def _stage_bwd_weight(active, dims, xs, g_effs, precision):
+    """The deferred half of the split backward: per-slot wgrads from the
+    stashed activations and the stashed effective output-grads. Slots are
+    independent (no chain), and the expressions match ``_stage_bwd``'s
+    wgrad leg exactly — bit-identical per-microbatch contributions."""
+    L = len(dims)
+    gWs, gbs = [None] * L, [None] * L
+    for l in range(L):
+        dw, db = ops.linear_grad_weight(g_effs[l], xs[l], precision=precision)
+        gWs[l] = jnp.where(active[l], dw, 0.0)
+        gbs[l] = jnp.where(active[l], db, 0.0)
+    return tuple(gWs), tuple(gbs)
+
+
 def make_pipeline_step(
     mesh: Mesh,
     spec: ModelSpec,
@@ -511,6 +555,13 @@ def make_pipeline_step(
     """
     if kernel_backend not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel_backend {kernel_backend!r}")
+    split = bool(getattr(prog, "backward_split", False))
+    if split and kernel_backend == "pallas":
+        raise ValueError(
+            "backward_split needs the XLA per-slot backward (the fused "
+            "pallas flag kernel computes dgrad+wgrad in one unit and has "
+            "no split halves); use kernel_backend='xla'"
+        )
     dims = slot_shapes(spec)
     S_, L = spec.n_stages, len(dims)
     D_in, D_out = dims[0][1], dims[-1][0]
@@ -518,6 +569,7 @@ def make_pipeline_step(
     M = prog.num_micro_batches
     Kf, Kb = prog.n_fwd_slots, prog.n_bwd_slots
     Ks = prog.n_stash_slots
+    Kg = prog.n_gstash_slots  # grad-stash depth (split programs only)
     mb_sz = mubatch_size
     B_global = spec.global_batch_size
     training = prog.is_training
@@ -557,24 +609,28 @@ def make_pipeline_step(
             z1_layout = opt.state_layout()
 
     # tick tables as device constants, scanned over their leading (T) axis
-    tabs = jax.tree.map(
-        jnp.asarray,
-        dict(
-            op=prog.op,
-            mb=prog.mb,
-            rf=prog.read_fwd_slot,
-            rb=prog.read_bwd_slot,
-            inf=prog.in_fwd_slot,
-            inb=prog.in_bwd_slot,
-            sf=prog.send_fwd,
-            sb=prog.send_bwd,
-            sw=prog.stash_write,
-            sr=prog.stash_read,
-            ck=prog.chunk,
-            li=prog.load_in,
-            ih=prog.is_head,
-        ),
+    tab_dict = dict(
+        op=prog.op,
+        mb=prog.mb,
+        rf=prog.read_fwd_slot,
+        rb=prog.read_bwd_slot,
+        inf=prog.in_fwd_slot,
+        inb=prog.in_bwd_slot,
+        sf=prog.send_fwd,
+        sb=prog.send_bwd,
+        sw=prog.stash_write,
+        sr=prog.stash_read,
+        ck=prog.chunk,
+        li=prog.load_in,
+        ih=prog.is_head,
     )
+    if split:
+        # split programs route three extra slot tables: the activation-
+        # stash peek (B-input) and the grad-stash write/read pair
+        tab_dict.update(
+            sp=prog.stash_peek, gw=prog.gstash_write, gr=prog.gstash_read
+        )
+    tabs = jax.tree.map(jnp.asarray, tab_dict)
     # ring shifts: with virtual chunks the device-(P-1) -> device-0 wrap IS a
     # stage boundary (chunk c on the last device feeds chunk c+1 on the
     # first); without chunks nothing ever sends on the wrap link and its zero
@@ -620,6 +676,17 @@ def make_pipeline_step(
                 gb=tuple(jnp.zeros((V, o), jnp.float32) for o, _ in dims),
                 loss=jnp.zeros((), jnp.float32),
             )
+            if split:
+                # grad stash: per-slot effective output-grads, held from
+                # each B-input tick to its deferred B-weight tick (slots
+                # assigned by the lowering, +1 trash — sized exactly like
+                # the activation stash, because it IS the same discipline)
+                carry.update(
+                    gstash=tuple(
+                        jnp.zeros((Kg + 1, mb_sz, o), jnp.float32)
+                        for o, _ in dims
+                    )
+                )
         else:
             carry.update(preds=jnp.zeros((M + 1, mb_sz, D_out), jnp.float32))
         zero_fwd = jnp.zeros((mb_sz, W_rel), jnp.float32)
@@ -700,9 +767,59 @@ def make_pipeline_step(
                 payload = jnp.where(row["sb"][stage] == 1, _fit(dx, W_rel), 0.0)
                 return c, zero_fwd, payload
 
-            # branch order is the op-code encoding: OP_NOOP=0, OP_FWD=1, OP_BWD=2
-            assert (OP_FWD, OP_BWD) == (1, 2)
-            branches = [noop, forward] + ([backward] if training else [noop])
+            def backward_input(c):
+                # split B-input: the combined backward's dgrad chain at the
+                # SAME tick — PEEKS the activation stash (masks + logits;
+                # the B-weight frees it later) and stashes the per-slot
+                # effective output-grads for the deferred wgrad
+                Ws, bs, active, relu, head_mask = chunk_params()
+                sp = row["sp"][stage]
+                g0 = ops.softmax_mse_head_grad(
+                    c["z"][sp], y[mb_r], B_global, valid_mask=head_mask[None, :]
+                )
+                Wb = max(D_out, W_rel)
+                g_in = jnp.where(
+                    is_head, _fit(g0, Wb), _fit(c["bwd_mail"][row["rb"][stage]], Wb)
+                )
+                masks_r = tuple(buf[sp] for buf in c["masks"])
+                dx, g_effs = _stage_bwd_input(
+                    Ws, active, relu, dims, masks_r, g_in, precision
+                )
+                c = dict(c)
+                gw = row["gw"][stage]
+                c["gstash"] = tuple(
+                    buf.at[gw].set(val) for buf, val in zip(c["gstash"], g_effs)
+                )
+                payload = jnp.where(row["sb"][stage] == 1, _fit(dx, W_rel), 0.0)
+                return c, zero_fwd, payload
+
+            def backward_weight(c):
+                # split B-weight: wgrads from the two stashes, accumulated
+                # in lowering-enforced B-input order (bit-identical fp sums
+                # vs the combined schedule); frees both stash slots by
+                # overwrite-on-reuse — no messages in or out
+                _, _, active, _, _ = chunk_params()
+                sr = row["sr"][stage]
+                gr = row["gr"][stage]
+                xs_r = tuple(buf[sr] for buf in c["xs"])
+                geff_r = tuple(buf[gr] for buf in c["gstash"])
+                gW_d, gb_d = _stage_bwd_weight(active, dims, xs_r, geff_r, precision)
+                c = dict(c)
+                if V == 1:
+                    c["gW"] = tuple(a.at[0].add(d) for a, d in zip(c["gW"], gW_d))
+                    c["gb"] = tuple(a.at[0].add(d) for a, d in zip(c["gb"], gb_d))
+                else:
+                    c["gW"] = tuple(a.at[v].add(d) for a, d in zip(c["gW"], gW_d))
+                    c["gb"] = tuple(a.at[v].add(d) for a, d in zip(c["gb"], gb_d))
+                return c, zero_fwd, zero_bwd
+
+            # branch order is the op-code encoding:
+            # OP_NOOP=0, OP_FWD=1, OP_BWD=2 (B-input when split), OP_BWD_W=3
+            assert (OP_FWD, OP_BWD, OP_BWD_W) == (1, 2, 3)
+            if training and split:
+                branches = [noop, forward, backward_input, backward_weight]
+            else:
+                branches = [noop, forward] + ([backward] if training else [noop])
             carry, fwd_out, bwd_out = lax.switch(opv, branches, carry)
 
             # uniform collectives outside the switch: relay payloads

@@ -30,20 +30,24 @@ hanging a TPU collective.
 """
 
 import dataclasses
+from collections import deque
 
 import numpy as np
 
 from shallowspeed_tpu import schedules as S
 
-# op codes in the tick tables
-OP_NOOP, OP_FWD, OP_BWD = 0, 1, 2
+# op codes in the tick tables. In a SPLIT program (backward_split) OP_BWD
+# cells are the relay-critical B-input half — same tick the combined
+# backward would occupy, same message structure — and OP_BWD_W cells are
+# the deferred B-weight halves packed into former bubble ticks.
+OP_NOOP, OP_FWD, OP_BWD, OP_BWD_W = 0, 1, 2, 3
 
 
 @dataclasses.dataclass(frozen=True)
 class WorkItem:
     """One compute event parsed from a device's instruction stream."""
 
-    kind: int  # OP_FWD | OP_BWD
+    kind: int  # OP_FWD | OP_BWD | OP_BWD_W
     mubatch_id: int
     chunk: int = 0  # virtual-stage chunk on this device (0 unless interleaved)
     needs_fwd_msg: bool = False  # consumes activations from the prior stage
@@ -86,6 +90,19 @@ class TickProgram:
     chunk: np.ndarray = None  # (T, S) int32: active virtual chunk (0 on noops)
     load_in: np.ndarray = None  # (T, S) int32 0/1: compute is global stage 0 fwd
     is_head: np.ndarray = None  # (T, S) int32 0/1: compute is the global last stage
+    # split-backward extension (backward_split programs only): OP_BWD cells
+    # are B-inputs, which PEEK the activation stash (masks/logits) without
+    # freeing it and WRITE a grad-stash slot (the per-slot effective
+    # output-grads); OP_BWD_W cells read+free both stashes. The activation
+    # stash is therefore held from the forward to the B-WEIGHT tick, and
+    # the grad stash from B-input to B-weight — both sized by the simulator
+    # exactly like the activation stash, so the split schedule's extra
+    # memory is a physical buffer shape, not prose.
+    backward_split: bool = False
+    n_gstash_slots: int = 0  # grad-stash depth (trash = index n_gstash_slots)
+    stash_peek: np.ndarray = None  # (T, S) int32: stash slot a B-input consults
+    gstash_write: np.ndarray = None  # (T, S) int32: grad-stash slot a B-input fills
+    gstash_read: np.ndarray = None  # (T, S) int32: grad-stash slot a B-weight frees
 
 
 class ScheduleLoweringError(ValueError):
@@ -103,10 +120,54 @@ def utilization(prog):
     Note: cells are weighted equally. Across different ``num_chunks`` (V)
     an active cell is 1/(P·V) of the model, so equal per-cell WORK across
     compared layouts (same total model, same microbatches) is the caller's
-    premise — true for the P-fixed comparisons the docs make.
+    premise — true for the P-fixed comparisons the docs make. Equal
+    weighting also cannot see the split-backward win (a combined backward
+    cell is 2x a forward cell's FLOPs; splitting trades fewer heavy ticks
+    for more uniform ones) — that is ``weighted_utilization``'s job.
     """
     active = int(np.sum(prog.op != OP_NOOP))
     return active / (prog.num_ticks * prog.num_stages)
+
+
+def _op_weights(prog):
+    """Per-op-code FLOP weights for this program, from the cost model's
+    single source (``observability.costmodel.PIPELINE_OP_COSTS``): in a
+    split program OP_BWD cells are B-inputs (dgrad only), in a combined
+    program they are full backwards (dgrad + wgrad)."""
+    from shallowspeed_tpu.observability.costmodel import PIPELINE_OP_COSTS as C
+
+    bwd = C["bwd_in"] if prog.backward_split else C["bwd"]
+    return np.array([0.0, C["fwd"], bwd, C["bwd_w"]], np.float64)
+
+
+def weighted_makespan(prog):
+    """FLOP-weighted makespan of the lowered program under the executor's
+    lockstep tick model: every tick, each device runs its cell's op and the
+    per-tick ``ppermute`` pair rejoins them, so a tick costs the MAXIMUM op
+    weight across devices (a tick where one stage runs a combined backward
+    while the rest forward costs a backward, not a forward). Weights come
+    from ``costmodel.PIPELINE_OP_COSTS`` (fwd 1, combined bwd 2, split
+    halves 1 each); the unit is one forward's work. All-noop ticks never
+    occur in a lowered program (the greedy simulator always progresses), so
+    their zero weight is unreachable."""
+    w = _op_weights(prog)
+    return float(w[np.asarray(prog.op)].max(axis=1).sum())
+
+
+def weighted_utilization(prog):
+    """FLOP-weighted active fraction: total cell work / (stages x weighted
+    makespan). Unlike ``utilization`` this sees the split-backward win —
+    splitting each 2-weight backward cell into two 1-weight halves shrinks
+    the weighted makespan (backward-phase ticks stop costing double while
+    the deferred halves fill former bubbles), so the weighted bubble
+    fraction ``1 - weighted_utilization`` drops even where the equal-weight
+    tick count grows. 1 - this is the number docs/lowering.md quotes for
+    ``--backward-split``."""
+    w = _op_weights(prog)
+    span = weighted_makespan(prog)
+    if span <= 0:
+        return 1.0
+    return float(w[np.asarray(prog.op)].sum() / (prog.num_stages * span))
 
 
 def program_stats(prog):
@@ -120,28 +181,40 @@ def program_stats(prog):
     (the observability JSONL sink emits this dict verbatim)."""
     cells = prog.num_ticks * prog.num_stages
     util = utilization(prog)
+    wutil = weighted_utilization(prog)
     # per-device occupancy: the fraction of ticks each pp device computes —
     # the per-row view of the pebble diagram (ramp devices idle longest)
     occupancy = [
         float(np.sum(prog.op[:, s] != OP_NOOP) / prog.num_ticks)
         for s in range(prog.num_stages)
     ]
+    # per-op-kind cell counts: OP_BWD cells are B-inputs in a split
+    # program, combined backwards otherwise (reported under the honest key)
+    n_bwd = int(np.sum(prog.op == OP_BWD))
     return {
         "num_ticks": int(prog.num_ticks),
         "num_stages": int(prog.num_stages),
         "num_micro_batches": int(prog.num_micro_batches),
         "num_chunks": int(prog.num_chunks),
         "is_training": bool(prog.is_training),
+        "backward_split": bool(prog.backward_split),
         "active_cells": int(np.sum(prog.op != OP_NOOP)),
         "total_cells": int(cells),
+        "cells_fwd": int(np.sum(prog.op == OP_FWD)),
+        "cells_bwd": 0 if prog.backward_split else n_bwd,
+        "cells_bwd_in": n_bwd if prog.backward_split else 0,
+        "cells_bwd_w": int(np.sum(prog.op == OP_BWD_W)),
         "sends_fwd": int(np.sum(prog.send_fwd)),
         "sends_bwd": int(np.sum(prog.send_bwd)),
         "fwd_mail_slots": int(prog.n_fwd_slots),
         "bwd_mail_slots": int(prog.n_bwd_slots),
         "stash_slots": int(prog.n_stash_slots),
+        "grad_stash_slots": int(prog.n_gstash_slots),
         "stage_occupancy": occupancy,
         "utilization": float(util),
         "bubble_fraction": float(1.0 - util),
+        "weighted_utilization": float(wutil),
+        "weighted_bubble_fraction": float(1.0 - wutil),
     }
 
 
@@ -166,7 +239,11 @@ def program_flops(prog, spec, mubatch_size):
     padded_p = sum(o * i for o, i in slot_shapes(spec))
     n_fwd = int(np.sum(prog.op == OP_FWD))
     n_bwd = int(np.sum(prog.op == OP_BWD))
-    return (2 * n_fwd + 4 * n_bwd) * mubatch_size * padded_p
+    n_bwd_w = int(np.sum(prog.op == OP_BWD_W))
+    # split programs spread the backward's 4-unit work over an OP_BWD
+    # (dgrad, 2) and an OP_BWD_W (wgrad, 2) cell: same total FLOPs
+    bwd_unit = 2 if prog.backward_split else 4
+    return (2 * n_fwd + bwd_unit * n_bwd + 2 * n_bwd_w) * mubatch_size * padded_p
 
 
 def program_comm_bytes(prog, spec, mubatch_size):
@@ -229,6 +306,8 @@ def parse_stage_stream(commands, stage_id, num_stages, training=True, num_chunks
     items = []
     pend_fwd_msg = pend_bwd_msg = False
     seen_zero = seen_opt = False
+    has_combined = has_split = False
+    bin_keys, bww_keys = set(), set()  # (chunk, mubatch) with a B-in / B-w
     for cmd in commands:
         if isinstance(cmd, S.ZeroGrad):
             if items or seen_zero:
@@ -277,6 +356,7 @@ def parse_stage_stream(commands, stage_id, num_stages, training=True, num_chunks
                 raise ScheduleLoweringError("RecvActivations not consumed by a Forward")
             if pend_bwd_msg and stage_g(cmd.chunk_id) == last_stage_g:
                 raise ScheduleLoweringError("global last stage cannot RecvOutputGrad")
+            has_combined = True
             items.append(
                 WorkItem(
                     OP_BWD,
@@ -287,6 +367,56 @@ def parse_stage_stream(commands, stage_id, num_stages, training=True, num_chunks
                 )
             )
             pend_bwd_msg = False
+        elif isinstance(cmd, S.BackwardInputGradAcc):
+            # the relay-critical half: same message structure as the
+            # combined backward (consumes the output-grad, may send dx)
+            if seen_opt:
+                raise ScheduleLoweringError("compute after OptimizerStep")
+            if pend_fwd_msg:
+                raise ScheduleLoweringError("RecvActivations not consumed by a Forward")
+            if pend_bwd_msg and stage_g(cmd.chunk_id) == last_stage_g:
+                raise ScheduleLoweringError("global last stage cannot RecvOutputGrad")
+            has_split = True
+            bin_keys.add((cmd.chunk_id, cmd.mubatch_id))
+            items.append(
+                WorkItem(
+                    OP_BWD,
+                    cmd.mubatch_id,
+                    chunk=cmd.chunk_id,
+                    needs_bwd_msg=pend_bwd_msg,
+                )
+            )
+            pend_bwd_msg = False
+        elif isinstance(cmd, S.BackwardWeightGradAcc):
+            # the deferred half: no messages in or out — only the stashes
+            if seen_opt:
+                raise ScheduleLoweringError("compute after OptimizerStep")
+            if pend_fwd_msg or pend_bwd_msg:
+                raise ScheduleLoweringError(
+                    "a Recv cannot bind to a BackwardWeightGrad (it consumes "
+                    "no messages — only the activation and grad stashes)"
+                )
+            key = (cmd.chunk_id, cmd.mubatch_id)
+            if key not in bin_keys:
+                raise ScheduleLoweringError(
+                    f"BackwardWeightGrad for microbatch {cmd.mubatch_id} "
+                    "precedes its BackwardInputGrad (the weight half reads "
+                    "the grad stash the input half fills)"
+                )
+            if key in bww_keys:
+                raise ScheduleLoweringError(
+                    f"duplicate BackwardWeightGrad for microbatch {cmd.mubatch_id}"
+                )
+            has_split = True
+            bww_keys.add(key)
+            items.append(
+                WorkItem(
+                    OP_BWD_W,
+                    cmd.mubatch_id,
+                    chunk=cmd.chunk_id,
+                    allreduce=isinstance(cmd, S.BackwardWeightGradAllReduce),
+                )
+            )
         elif isinstance(cmd, S.SendActivations):
             if not items or items[-1].kind != OP_FWD or items[-1].sends_fwd:
                 raise ScheduleLoweringError(
@@ -309,6 +439,11 @@ def parse_stage_stream(commands, stage_id, num_stages, training=True, num_chunks
         raise ScheduleLoweringError("dangling Recv with no consuming compute")
     if training and not (seen_zero and seen_opt):
         raise ScheduleLoweringError("training stream must bracket with ZeroGrad/OptimizerStep")
+    if has_combined and has_split:
+        raise ScheduleLoweringError(
+            "stream mixes combined Backward and split BackwardInput/"
+            "BackwardWeight instructions — a program is split or it is not"
+        )
     for it in items:
         if not 0 <= it.chunk < num_chunks:
             raise ScheduleLoweringError(f"chunk {it.chunk} out of range [0,{num_chunks})")
@@ -358,21 +493,50 @@ class _Mailbox:
         return len(self.free_from)
 
 
-def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None, virtual=1):
+def lower_schedule(
+    schedule_cls,
+    num_micro_batches,
+    num_stages,
+    training=None,
+    virtual=1,
+    backward_split=False,
+):
     """Compile a Schedule class into a TickProgram.
 
     ``num_stages`` is the number of pp DEVICES; ``virtual`` (V) is the number
     of virtual stages per device for interleaved schedules (the model has
     ``num_stages * virtual`` stages, stage ``s`` on device ``s % num_stages``
     as chunk ``s // num_stages``). V=1 is the ordinary one-stage-per-device
-    case."""
+    case.
+
+    ``backward_split``: lower the schedule's two-stage backward (B-input /
+    B-weight) variant. B-inputs keep exactly the combined backward's ticks
+    (same message structure, so the greedy simulation reproduces the same
+    placement); B-weight items have no dependencies beyond their own
+    B-input and are DEFERRED — each tick a stage first tries its next
+    F/B-input item and, only when that is message-blocked or exhausted,
+    runs its oldest pending B-weight instead, packing the weight halves
+    into what were bubble ticks. FIFO deferral preserves the per-stage
+    weight-grad accumulation order of the combined schedule (bit-identical
+    fp sums); the verifier additionally rejects streams whose B-weight
+    order disagrees with their B-input order, a B-weight without (or
+    before) its B-input, and a DP anchor anywhere but the final B-weight.
+    """
     if issubclass(schedule_cls, S.InterleavedSchedule):
+        if backward_split:
+            raise ScheduleLoweringError(
+                "backward_split is not supported for interleaved schedules "
+                "(the virtual-chunk steady state interleaves its own "
+                "chunks; splitting its backward is future work)"
+            )
         kw = {"num_chunks": virtual}  # V=1 degenerates to one chunk per device
     elif virtual != 1:
         raise ScheduleLoweringError(
             f"virtual={virtual} requires an interleaved schedule; "
             f"{schedule_cls.__name__} places one stage per device"
         )
+    elif backward_split:
+        kw = {"backward_split": True}
     else:
         kw = {}
     streams = [
@@ -393,6 +557,20 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None, v
         for s in range(num_stages)
     ]
 
+    # a program is split iff any stage deferred weight grads — and then
+    # every backward-bearing stage must be split the same way (each stage's
+    # own stream already rejects intra-stream mixing)
+    split = any(i.kind == OP_BWD_W for items in stage_items for i in items)
+    if split:
+        for s, items in enumerate(stage_items):
+            if any(i.kind == OP_BWD for i in items) and not any(
+                i.kind == OP_BWD_W for i in items
+            ):
+                raise ScheduleLoweringError(
+                    f"stage {s}: combined backwards in a split program "
+                    "(every stage must defer its weight grads or none may)"
+                )
+
     # validate per-device (chunk, microbatch) coverage
     want = sorted(
         (c, mb) for c in range(virtual) for mb in range(num_micro_batches)
@@ -405,12 +583,43 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None, v
             bwd = sorted((i.chunk, i.mubatch_id) for i in items if i.kind == OP_BWD)
             if bwd != want:
                 raise ScheduleLoweringError(f"stage {s}: backwards {bwd} != chunks x 0..M-1")
+            if split:
+                # exactly one B-weight per B-input, in the SAME per-stage
+                # order: the weight-grad accumulators sum per microbatch in
+                # B-weight order, so matching the B-input (= combined
+                # backward) order is what keeps the fp sum — and therefore
+                # the weight hash — bit-identical to the unsplit schedule
+                bin_seq = [
+                    (i.chunk, i.mubatch_id) for i in items if i.kind == OP_BWD
+                ]
+                bww_seq = [
+                    (i.chunk, i.mubatch_id) for i in items if i.kind == OP_BWD_W
+                ]
+                if sorted(bww_seq) != want:
+                    raise ScheduleLoweringError(
+                        f"stage {s}: B-weights {sorted(bww_seq)} != chunks x 0..M-1"
+                    )
+                if bww_seq != bin_seq:
+                    raise ScheduleLoweringError(
+                        f"stage {s}: B-weight order {bww_seq} must match the "
+                        f"B-input order {bin_seq} (weight-grad accumulation "
+                        "order is the bitwise-parity contract)"
+                    )
             ars = [i for i in items if i.allreduce]
-            bwds = [i for i in items if i.kind == OP_BWD]
-            if len(ars) != 1 or bwds[-1] is not ars[0]:
-                raise ScheduleLoweringError(
-                    f"stage {s}: BackwardGradAllReduce must be exactly the final backward"
-                )
+            if split:
+                bwws = [i for i in items if i.kind == OP_BWD_W]
+                if len(ars) != 1 or bwws[-1] is not ars[0]:
+                    raise ScheduleLoweringError(
+                        f"stage {s}: the DP anchor must be exactly the final "
+                        "B-weight (the gradient is incomplete until the last "
+                        "deferred weight half lands)"
+                    )
+            else:
+                bwds = [i for i in items if i.kind == OP_BWD]
+                if len(ars) != 1 or bwds[-1] is not ars[0]:
+                    raise ScheduleLoweringError(
+                        f"stage {s}: BackwardGradAllReduce must be exactly the final backward"
+                    )
 
     # --- greedy tick simulation -------------------------------------------
     # one compute per DEVICE per tick; messages keyed (chunk, microbatch).
@@ -424,36 +633,75 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None, v
     fwd_mail = [_Mailbox() for _ in range(P)]  # from the prior stage
     bwd_mail = [_Mailbox() for _ in range(P)]  # from the next stage
     # activation-stash allocation (training only): a forward claims a slot
-    # for its residuals; the matching backward frees it. Slot pressure is
-    # therefore the schedule's REAL activation memory — GPipe peaks at M,
+    # for its residuals; the matching backward frees it (the B-WEIGHT in a
+    # split program — the deferred wgrad still reads the activations, so
+    # deferral extends the stash lifetime; the higher slot peak is the
+    # split schedule's honest extra memory). Slot pressure is therefore the
+    # schedule's REAL activation memory — GPipe peaks at M,
     # PipeDream-Flush at min(M, depth - stage): 1F1B's memory advantage
     # becomes physical buffer sizes, not just an instruction-stream property.
     stash_free_from = [[] for _ in range(P)]  # per device, per slot
     stash_of = [dict() for _ in range(P)]  # (chunk, mubatch) -> slot
+    # grad-stash allocation (split programs): a B-input claims a slot for
+    # the per-slot effective output-grads; the matching B-weight frees it.
+    # Same discipline as the activation stash — held exactly from the
+    # B-input tick to the B-weight tick, peak depth becomes buffer shapes.
+    gstash_free_from = [[] for _ in range(P)]
+    gstash_of = [dict() for _ in range(P)]
+    # deferred B-weight items, FIFO per stage (FIFO = B-input order = the
+    # combined schedule's accumulation order, the bitwise-parity contract)
+    pending_w = [deque() for _ in range(P)]
     rows = []  # per tick: list of per-device dicts
     t = 0
     limit = 4 * virtual * num_micro_batches * P + 8 * virtual * P + 16
-    while any(ptr[s] < len(stage_items[s]) for s in range(P)):
+    while any(
+        ptr[s] < len(stage_items[s]) or pending_w[s] for s in range(P)
+    ):
         if t > limit:
             raise ScheduleLoweringError("schedule failed to converge (livelock?)")
         row = [
             dict(
                 op=OP_NOOP, mb=num_micro_batches, rf=-1, rb=-1, sf=0, sb=0,
                 inf=-1, inb=-1, sw=-1, sr=-1, ck=0, li=0, ih=0,
+                sp=-1, gw=-1, gr=-1,
             )
             for _ in range(P)
         ]
         arrivals = []  # (direction, to_device, key)
         progressed = False
         for s in range(P):
-            if ptr[s] >= len(stage_items[s]):
+            items = stage_items[s]
+            # defer B-weights as the pointer reaches them: no message
+            # dependencies, so they wait for an idle tick instead of
+            # delaying the relay-critical stream behind them
+            while ptr[s] < len(items) and items[ptr[s]].kind == OP_BWD_W:
+                pending_w[s].append(items[ptr[s]])
+                ptr[s] += 1
+            item = items[ptr[s]] if ptr[s] < len(items) else None
+            blocked = item is None or (
+                item.needs_fwd_msg
+                and not fwd_mail[s].consumable(t, (item.chunk, item.mubatch_id))
+            ) or (
+                item.needs_bwd_msg
+                and not bwd_mail[s].consumable(t, (item.chunk, item.mubatch_id))
+            )
+            if blocked:
+                if not pending_w[s]:
+                    continue  # a true bubble tick
+                # pack the oldest deferred B-weight into this bubble
+                w = pending_w[s].popleft()
+                key = (w.chunk, w.mubatch_id)
+                r = row[s]
+                r["op"], r["mb"], r["ck"] = OP_BWD_W, w.mubatch_id, w.chunk
+                slot = stash_of[s].pop(key)
+                stash_free_from[s][slot] = t + 1  # activations done
+                r["sr"] = slot
+                gslot = gstash_of[s].pop(key)
+                gstash_free_from[s][gslot] = t + 1
+                r["gr"] = gslot
+                progressed = True
                 continue
-            item = stage_items[s][ptr[s]]
             key = (item.chunk, item.mubatch_id)
-            if item.needs_fwd_msg and not fwd_mail[s].consumable(t, key):
-                continue
-            if item.needs_bwd_msg and not bwd_mail[s].consumable(t, key):
-                continue
             # execute item at tick t
             stage_g = item.chunk * P + s
             r = row[s]
@@ -476,9 +724,24 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None, v
                 stash_of[s][key] = slot
                 r["sw"] = slot
             elif training and item.kind == OP_BWD:
-                slot = stash_of[s].pop(key)
-                stash_free_from[s][slot] = t + 1  # reusable next tick
-                r["sr"] = slot
+                if split:
+                    # B-input: PEEK the activation stash (masks + logits;
+                    # the B-weight frees it) and claim a grad-stash slot
+                    r["sp"] = stash_of[s][key]
+                    gfree = gstash_free_from[s]
+                    for gslot, f in enumerate(gfree):
+                        if f <= t:
+                            break
+                    else:
+                        gfree.append(0)
+                        gslot = len(gfree) - 1
+                    gfree[gslot] = np.inf  # held until the matching B-weight
+                    gstash_of[s][key] = gslot
+                    r["gw"] = gslot
+                else:
+                    slot = stash_of[s].pop(key)
+                    stash_free_from[s][slot] = t + 1  # reusable next tick
+                    r["sr"] = slot
             if item.sends_fwd:
                 r["sf"] = 1
                 dst = (s + 1) % P
@@ -508,10 +771,13 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None, v
     for s in range(num_stages):
         if stash_of[s]:
             raise ScheduleLoweringError(f"stage {s}: unfreed activation stash")
+        if gstash_of[s]:
+            raise ScheduleLoweringError(f"stage {s}: unfreed grad stash")
 
     K_f = max((m.depth for m in fwd_mail), default=0) or 1
     K_b = max((m.depth for m in bwd_mail), default=0) or 1
     K_s = max((len(f) for f in stash_free_from), default=0) or 1
+    K_g = max((len(f) for f in gstash_free_from), default=0) if split else 0
     T = len(rows)
 
     def table(key, trash):
@@ -549,4 +815,9 @@ def lower_schedule(schedule_cls, num_micro_batches, num_stages, training=None, v
         chunk=raw("ck"),
         load_in=raw("li"),
         is_head=raw("ih"),
+        backward_split=split,
+        n_gstash_slots=K_g,
+        stash_peek=table("sp", K_s),
+        gstash_write=table("gw", K_g),
+        gstash_read=table("gr", K_g),
     )

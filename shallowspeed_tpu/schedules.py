@@ -9,7 +9,12 @@ static clock-tick program executed SPMD under shard_map (MPMD -> SPMD).
 
 Instruction set parity (reference pipe.py:12-138): ZeroGrad, OptimizerStep,
 Recv/SendActivations, Recv/SendOutputGrad/InputGrad, Forward,
-BackwardGradAcc, BackwardGradAllReduce, LoadMuBatchInput/Target.
+BackwardGradAcc, BackwardGradAllReduce, LoadMuBatchInput/Target — plus the
+split-backward trio beyond the reference (``backward_split=True``):
+BackwardInputGradAcc (the relay-critical dx half, at the combined
+backward's tick), BackwardWeightGradAcc (the deferrable dW/db half, packed
+into bubble ticks by the lowering) and BackwardWeightGradAllReduce (the
+DP-sync anchor, moved to the final weight half).
 
 Schedules: Naive (pipe.py:184-222), GPipe (pipe.py:225-272), Inference
 (pipe.py:275-294) — and PipeDream-Flush (1F1B), which the reference declares
@@ -94,6 +99,34 @@ class BackwardGradAllReduce(ComputeInstruction):
 
 
 @dataclasses.dataclass(frozen=True)
+class BackwardInputGradAcc(ComputeInstruction):
+    """The relay-critical HALF of a split backward (2BP, arxiv 2405.18047):
+    compute d(loss)/d(stage input) for one microbatch — dx from W and the
+    relu masks only — and stash the per-slot effective output-grads for the
+    deferred weight half. This is the only backward product the upstream
+    stage waits for, so it runs (and relays, via a following SendInputGrad)
+    at exactly the tick the combined backward would have."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BackwardWeightGradAcc(ComputeInstruction):
+    """The deferrable HALF of a split backward: dW/db for one microbatch
+    from the stashed activation and the stashed output-grad, accumulated
+    into the gradient buffers. No messages in or out — the lowering packs
+    these greedily into otherwise-idle bubble ticks, preserving the
+    per-stage accumulation order of the combined schedule (so the fp sum,
+    and therefore the weight hash, is bit-identical)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BackwardWeightGradAllReduce(BackwardWeightGradAcc):
+    """Split-schedule DP-sync anchor: the FINAL weight-grad compute of the
+    batch. In a split schedule the gradient is not complete until the last
+    deferred B-weight lands, so the all-reduce anchor moves here from the
+    final backward (every B-weight completes before the dp psum)."""
+
+
+@dataclasses.dataclass(frozen=True)
 class LoadInstruction(Instruction):
     mubatch_id: int = 0
     buffer_id: int = 0
@@ -122,12 +155,22 @@ class Schedule(ABC):
     unit-tested stream-wise and compiled to a clock-tick program.
     """
 
-    def __init__(self, num_micro_batches: int, num_stages: int, stage_id: int):
+    def __init__(
+        self,
+        num_micro_batches: int,
+        num_stages: int,
+        stage_id: int,
+        backward_split: bool = False,
+    ):
         assert num_micro_batches > 0 and num_stages > 0
         assert 0 <= stage_id < num_stages
         self.num_micro_batches = num_micro_batches
         self.num_stages = num_stages
         self.stage_id = stage_id
+        # two-stage backward: emit BackwardInputGradAcc + a deferred
+        # BackwardWeightGradAcc per microbatch instead of the combined
+        # Backward (the lowering packs the weight halves into bubble ticks)
+        self.backward_split = backward_split
 
     @abstractmethod
     def steps(self):
@@ -167,16 +210,33 @@ class Schedule(ABC):
             cmds.append(SendActivations())
         return cmds
 
+    def _bwd_compute(self, mb, allreduce):
+        """The backward compute (+ input-grad send) for one microbatch —
+        combined, or the split B-input/B-weight pair. The send always
+        follows the compute that produces dx (B-input when split), and the
+        DP-sync anchor rides the final backward's WEIGHT half when split
+        (the gradient is not complete until the last deferred B-weight)."""
+        cmds = []
+        if self.backward_split:
+            cmds.append(BackwardInputGradAcc(mubatch_id=mb))
+            if not self.is_first_stage:
+                cmds.append(SendInputGrad())
+            wcls = BackwardWeightGradAllReduce if allreduce else BackwardWeightGradAcc
+            cmds.append(wcls(mubatch_id=mb))
+        else:
+            cls = BackwardGradAllReduce if allreduce else BackwardGradAcc
+            cmds.append(cls(mubatch_id=mb))
+            if not self.is_first_stage:
+                cmds.append(SendInputGrad())
+        return cmds
+
     def _bwd_step(self, mb, allreduce):
         cmds = []
         if self.is_last_stage:
             cmds.append(LoadMuBatchTarget(mubatch_id=mb))
         else:
             cmds.append(RecvOutputGrad())
-        cls = BackwardGradAllReduce if allreduce else BackwardGradAcc
-        cmds.append(cls(mubatch_id=mb))
-        if not self.is_first_stage:
-            cmds.append(SendInputGrad())
+        cmds.extend(self._bwd_compute(mb, allreduce))
         return cmds
 
 
@@ -193,14 +253,7 @@ class NaiveParallelSchedule(Schedule):
             else:
                 cmds.append(SendActivations())
                 cmds.append(RecvOutputGrad())
-            cls = (
-                BackwardGradAllReduce
-                if self.is_last_mubatch(mb)
-                else BackwardGradAcc
-            )
-            cmds.append(cls(mubatch_id=mb))
-            if not self.is_first_stage:
-                cmds.append(SendInputGrad())
+            cmds.extend(self._bwd_compute(mb, self.is_last_mubatch(mb)))
             yield cmds
         yield [OptimizerStep()]
 

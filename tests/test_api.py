@@ -220,6 +220,34 @@ def test_grad_bucket_bytes_session_matches_anchor(data_dir):
     assert runs["z1-0"] == runs["z1-2048"]
 
 
+def test_backward_split_validation(data_dir):
+    with pytest.raises(ValueError, match="sequential path has no schedule"):
+        _session(data_dir, backward_split=True)  # dp=pp=1: no schedule
+    with pytest.raises(ValueError, match="interleaved"):
+        _session(data_dir, pp=2, schedule="interleaved", virtual_stages=2,
+                 backward_split=True)
+    with pytest.raises(ValueError, match="pallas"):
+        _session(data_dir, pp=2, schedule="gpipe", kernel_backend="pallas",
+                 backward_split=True)
+
+
+def test_backward_split_session_matches_unsplit(data_dir):
+    """Split vs unsplit THROUGH the session surface (per-epoch loop and
+    the fused run, ZeRO-1 included): identical model hashes — the split
+    schedule changes tick packing, never the training computation."""
+    runs = {}
+    for bs in (False, True):
+        run = _session(data_dir, pp=4, schedule="pipedream", backward_split=bs)
+        run.train_epoch()
+        runs[bs] = run.model_hash()
+        fused = _session(data_dir, dp=2, pp=2, schedule="gpipe", zero1=True,
+                         clip_norm=0.05, backward_split=bs)
+        fused.train_run(1, with_eval=False)
+        runs[f"z1-{bs}"] = fused.model_hash()
+    assert runs[False] == runs[True]
+    assert runs["z1-False"] == runs["z1-True"]
+
+
 def test_train_run_matches_epoch_loop(data_dir):
     """The fused multi-epoch program (one dispatch for every epoch + its
     on-device full-split accuracy) must reproduce the looped

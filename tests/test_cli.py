@@ -117,6 +117,22 @@ def test_mesh_cli_grad_bucket_bytes_matches_anchor(tiny_data):
     assert "DP replicas in sync" in bucketed
 
 
+def test_mesh_cli_backward_split_matches_unsplit(tiny_data):
+    """--backward-split through the real CLI (with --audit enforcing the
+    split program's collective contract): the final model hash must equal
+    the unsplit run's — the deferred B-weights change tick packing, never
+    the numerics."""
+    common = [
+        "--pp", "4", "--schedule", "pipedream", "--epochs", "1",
+        "--global-batch-size", "32", "--mubatches", "2", "--no-eval",
+    ]
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    unsplit = _run(common, tiny_data, extra_env=env)
+    split = _run(common + ["--backward-split", "--audit"], tiny_data, extra_env=env)
+    h = re.compile(r"final model hash: ([0-9a-f]{40})")
+    assert h.search(unsplit).group(1) == h.search(split).group(1)
+
+
 def test_mesh_cli_interleaved_zero1_momentum(tiny_data):
     """The round-2 flag surface in one run: interleaved virtual stages,
     ZeRO-1 sharded momentum."""

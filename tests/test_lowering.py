@@ -14,10 +14,12 @@ import pytest
 from shallowspeed_tpu import schedules as S
 from shallowspeed_tpu.parallel.lowering import (
     OP_BWD,
+    OP_BWD_W,
     OP_FWD,
     OP_NOOP,
     ScheduleLoweringError,
     lower_schedule,
+    weighted_utilization,
 )
 
 TRAIN = [S.NaiveParallelSchedule, S.GPipeSchedule, S.PipeDreamFlushSchedule]
@@ -29,11 +31,16 @@ def replay(p):
 
     Payloads are tuples ("act"|"grad", mubatch, from_stage). Raises on any
     mailbox misuse; returns events[(t, s)] = (op, mb, consumed_payload).
+    Split programs additionally model the two-stash discipline: a B-input
+    PEEKS the activation stash (written by the forward, still held) and
+    fills a grad-stash slot; the matching B-weight frees both.
     """
     Kf, Kb, Ks = p.n_fwd_slots, p.n_bwd_slots, p.n_stash_slots
+    Kg = p.n_gstash_slots
     fwd_mail = [[None] * Kf for _ in range(p.num_stages)]
     bwd_mail = [[None] * Kb for _ in range(p.num_stages)]
     stash = [[None] * Ks for _ in range(p.num_stages)]
+    gstash = [[None] * Kg for _ in range(p.num_stages)]
     events = {}
     for t in range(p.num_ticks):
         outgoing = []  # (dst, direction, slot, payload)
@@ -51,20 +58,42 @@ def replay(p):
                 assert consumed is not None, f"read from empty bwd slot at t={t} s={s}"
                 bwd_mail[s][rb] = None
             # activation stash: forwards write a free slot, the matching
-            # backward (same stage, same microbatch) reads and frees it
+            # backward (B-weight in a split program) reads and frees it
             sw, sr = int(p.stash_write[t, s]), int(p.stash_read[t, s])
             if sw != Ks:
                 assert op == OP_FWD
                 assert stash[s][sw] is None, f"stash overwrite t={t} s={s}"
                 stash[s][sw] = mb
             if sr != Ks:
-                assert op == OP_BWD
+                assert op == (OP_BWD_W if p.backward_split else OP_BWD)
                 assert stash[s][sr] == mb, (
                     f"backward reads wrong stash at t={t} s={s}: "
                     f"expected mb={mb}, slot holds {stash[s][sr]}"
                 )
                 stash[s][sr] = None
-            if p.is_training and op == OP_BWD:
+            if p.backward_split:
+                sp = int(p.stash_peek[t, s])
+                gw, gr = int(p.gstash_write[t, s]), int(p.gstash_read[t, s])
+                if sp != Ks:
+                    # B-input peek: the slot must hold THIS microbatch's
+                    # residuals and must NOT be freed (B-weight frees it)
+                    assert op == OP_BWD
+                    assert stash[s][sp] == mb, f"B-in peeks wrong stash t={t} s={s}"
+                if gw != Kg:
+                    assert op == OP_BWD
+                    assert gstash[s][gw] is None, f"grad-stash overwrite t={t} s={s}"
+                    gstash[s][gw] = mb
+                if gr != Kg:
+                    assert op == OP_BWD_W
+                    assert gstash[s][gr] == mb, (
+                        f"B-weight reads wrong grad stash at t={t} s={s}"
+                    )
+                    gstash[s][gr] = None
+                if p.is_training and op == OP_BWD:
+                    assert sp != Ks and gw != Kg, f"B-in without stashes t={t} s={s}"
+                if op == OP_BWD_W:
+                    assert sr != Ks and gr != Kg, f"B-w without stashes t={t} s={s}"
+            elif p.is_training and op == OP_BWD:
                 assert sr != Ks, f"backward without stash read at t={t} s={s}"
             if op != OP_NOOP:
                 events[(t, s)] = (op, mb, consumed)
@@ -72,7 +101,7 @@ def replay(p):
                 assert op == OP_FWD
                 outgoing.append((s + 1, "fwd", ("act", mb, s)))
             if p.send_bwd[t, s]:
-                assert op == OP_BWD
+                assert op == OP_BWD  # B-weights never send
                 outgoing.append((s - 1, "bwd", ("grad", mb, s)))
         for dst, direction, payload in outgoing:
             mail = fwd_mail if direction == "fwd" else bwd_mail
@@ -86,6 +115,7 @@ def replay(p):
     for s in range(p.num_stages):
         assert all(x is None for x in fwd_mail[s] + bwd_mail[s]), "leftover messages"
         assert all(x is None for x in stash[s]), "leaked activation stash"
+        assert all(x is None for x in gstash[s]), "leaked grad stash"
     return events
 
 
@@ -246,3 +276,181 @@ class TestValidation:
 
         with pytest.raises(ScheduleLoweringError):
             lower_schedule(Skips, 2, 1)
+
+
+# ---------------------------------------------------------------------------
+# Split backward (B-input / B-weight)
+# ---------------------------------------------------------------------------
+
+SPLIT_TRAIN = TRAIN  # every flat training schedule lowers a split variant
+
+
+@pytest.mark.parametrize("cls", SPLIT_TRAIN)
+@pytest.mark.parametrize("M,St", [(4, 2), (4, 4), (8, 4), (2, 4)])
+def test_split_dataflow_and_bin_ticks_match_unsplit(cls, M, St):
+    """The split program's relays must be indistinguishable from the
+    unsplit one: every B-input sits at EXACTLY the tick (and consumes
+    exactly the payload) the combined backward would have, forwards are
+    untouched, and the deferred B-weights pair one-to-one with their
+    B-inputs through the stash discipline (replay() asserts it)."""
+    u = lower_schedule(cls, M, St)
+    p = lower_schedule(cls, M, St, backward_split=True)
+    assert p.backward_split and not u.backward_split
+    T = u.num_ticks
+    assert p.num_ticks >= T
+    # identical F and B(-input) placement over the unsplit makespan, and
+    # nothing but B-weights in the extension
+    assert ((p.op[:T] == OP_FWD) == (u.op == OP_FWD)).all()
+    assert ((p.op[:T] == OP_BWD) == (u.op == OP_BWD)).all()
+    assert np.isin(p.op[T:], (OP_NOOP, OP_BWD_W)).all()
+    # same send tables over the shared prefix, none after (B-w never sends)
+    assert (p.send_fwd[:T] == u.send_fwd).all() and (p.send_bwd[:T] == u.send_bwd).all()
+    assert not p.send_fwd[T:].any() and not p.send_bwd[T:].any()
+    events = replay(p)
+    for (t, s), (op, mb, consumed) in events.items():
+        if op == OP_BWD and s != St - 1:
+            assert consumed == ("grad", mb, s + 1)
+        elif op == OP_BWD_W:
+            assert consumed is None
+    # every stage: M forwards, M B-inputs, M B-weights
+    for s in range(St):
+        ops_s = [v[0] for (t, ss), v in events.items() if ss == s]
+        assert ops_s.count(OP_FWD) == M
+        assert ops_s.count(OP_BWD) == M
+        assert ops_s.count(OP_BWD_W) == M
+
+
+@pytest.mark.parametrize("cls", SPLIT_TRAIN)
+@pytest.mark.parametrize("M,St", [(4, 4), (8, 4)])
+def test_split_bweight_order_matches_backward_order(cls, M, St):
+    """Per stage, B-weights execute in the B-input (= combined backward)
+    order — the weight-grad accumulation-order contract behind bitwise
+    parity."""
+    p = lower_schedule(cls, M, St, backward_split=True)
+    for s in range(St):
+        bin_order = [int(p.mb[t, s]) for t in range(p.num_ticks) if p.op[t, s] == OP_BWD]
+        bww_order = [
+            int(p.mb[t, s]) for t in range(p.num_ticks) if p.op[t, s] == OP_BWD_W
+        ]
+        assert bww_order == bin_order
+
+
+def test_split_weighted_bubble_shrinks_1f1b_p4_m8():
+    """The acceptance criterion, from the ACTUAL lowered tick tables:
+    split 1F1B at P=4, M=8 has a strictly smaller FLOP-weighted bubble
+    fraction than unsplit 1F1B (and GPipe behaves the same way)."""
+    u = lower_schedule(S.PipeDreamFlushSchedule, 8, 4)
+    p = lower_schedule(S.PipeDreamFlushSchedule, 8, 4, backward_split=True)
+    assert (1 - weighted_utilization(p)) < (1 - weighted_utilization(u))
+    # pin the measured figures docs/lowering.md quotes (40% -> 11%)
+    assert round((1 - weighted_utilization(u)) * 100) == 40
+    assert round((1 - weighted_utilization(p)) * 100) == 11
+    ug = lower_schedule(S.GPipeSchedule, 8, 4)
+    pg = lower_schedule(S.GPipeSchedule, 8, 4, backward_split=True)
+    assert (1 - weighted_utilization(pg)) < (1 - weighted_utilization(ug))
+
+
+def test_split_anchor_is_final_bweight():
+    """In a split stream the DP all-reduce anchor is the last B-WEIGHT,
+    never a B-input (the gradient is incomplete until the last deferred
+    wgrad lands)."""
+    for cls in SPLIT_TRAIN:
+        for stage in range(4):
+            cmds = S.flat_commands(
+                cls(num_micro_batches=4, num_stages=4, stage_id=stage,
+                    backward_split=True)
+            )
+            ar = [c for c in cmds if isinstance(c, S.BackwardWeightGradAllReduce)]
+            bww = [c for c in cmds if isinstance(c, S.BackwardWeightGradAcc)]
+            assert len(ar) == 1 and bww[-1] is ar[0]
+            assert not any(isinstance(c, S.BackwardGradAllReduce) for c in cmds)
+
+
+class TestSplitValidation:
+    def _lower_mangled(self, mangle):
+        """Lower split GPipe with ``mangle`` applied to each stage's
+        flattened command list (a deliberately broken stream generator)."""
+
+        class Mangled(S.GPipeSchedule):
+            def steps(self):
+                cmds = [c for step in super().steps() for c in step]
+                yield mangle(list(cmds))
+
+        return lower_schedule(Mangled, 2, 2, backward_split=True)
+
+    def test_misordered_bweight_stream_rejected(self):
+        """The acceptance criterion: a B-weight stream whose order
+        disagrees with the B-input order (breaking the accumulation-order
+        contract) fails at lowering time — even though every B-weight
+        still FOLLOWS its own B-input."""
+
+        def defer_weights_reversed(cmds):
+            # pull every B-weight out and append them all at the end in
+            # REVERSED (= forward) order: GPipe's B-inputs ran in backward
+            # order, so the accumulation order no longer matches
+            ws = [c for c in cmds if isinstance(c, S.BackwardWeightGradAcc)]
+            rest = [c for c in cmds if not isinstance(c, S.BackwardWeightGradAcc)]
+            opt = rest.pop()  # OptimizerStep stays last
+            return rest + list(reversed(ws)) + [opt]
+
+        with pytest.raises(ScheduleLoweringError, match="order"):
+            self._lower_mangled(defer_weights_reversed)
+
+    def test_bweight_before_its_binput_rejected(self):
+        def hoist_weight(cmds):
+            i = next(
+                i for i, c in enumerate(cmds)
+                if isinstance(c, S.BackwardWeightGradAcc)
+            )
+            w = cmds.pop(i)
+            # re-insert it before the backward phase begins: its B-input
+            # (and everyone else's) has not run yet
+            j = next(
+                j for j, c in enumerate(cmds)
+                if isinstance(
+                    c,
+                    (S.RecvOutputGrad, S.LoadMuBatchTarget, S.BackwardInputGradAcc),
+                )
+            )
+            cmds.insert(j, w)
+            return cmds
+
+        with pytest.raises(ScheduleLoweringError, match="precedes"):
+            self._lower_mangled(hoist_weight)
+
+    def test_missing_bweight_rejected(self):
+        def drop_weight(cmds):
+            i = next(
+                i for i, c in enumerate(cmds)
+                if type(c) is S.BackwardWeightGradAcc
+            )
+            cmds.pop(i)
+            return cmds
+
+        with pytest.raises(ScheduleLoweringError):
+            self._lower_mangled(drop_weight)
+
+    def test_mixed_split_and_combined_rejected(self):
+        def mix(cmds):
+            # replace the first B-input/B-weight pair with a combined
+            # backward: the stream now mixes both styles
+            i = next(
+                i for i, c in enumerate(cmds)
+                if isinstance(c, S.BackwardInputGradAcc)
+            )
+            first = cmds[i]
+            cmds[i] = S.BackwardGradAcc(mubatch_id=first.mubatch_id)
+            j = next(
+                j for j, c in enumerate(cmds)
+                if isinstance(c, S.BackwardWeightGradAcc)
+                and c.mubatch_id == first.mubatch_id
+            )
+            cmds.pop(j)
+            return cmds
+
+        with pytest.raises(ScheduleLoweringError, match="mixes"):
+            self._lower_mangled(mix)
+
+    def test_interleaved_split_rejected(self):
+        with pytest.raises(ScheduleLoweringError, match="interleaved"):
+            lower_schedule(S.InterleavedSchedule, 4, 4, virtual=2, backward_split=True)

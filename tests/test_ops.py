@@ -72,6 +72,27 @@ class TestGradOracle:
         np.testing.assert_allclose(dw, ww, atol=1e-5)
         np.testing.assert_allclose(db, jnp.reshape(wb, (-1,)), atol=1e-5)
 
+    def test_linear_grad_is_composition_of_split_halves(self):
+        """The combined backward IS the composition of the split halves —
+        bit-for-bit, which is what makes the two-stage pipeline backward
+        (B-input / B-weight) trivially bitwise-equal to the combined one."""
+        x, w, g = r(8, 5), r(3, 5), r(8, 3)
+        dx, dw, db = ops.linear_grad(g, x, w)
+        dxi = ops.linear_grad_input(g, w)
+        dww, dbw = ops.linear_grad_weight(g, x)
+        np.testing.assert_array_equal(np.asarray(dx), np.asarray(dxi))
+        np.testing.assert_array_equal(np.asarray(dw), np.asarray(dww))
+        np.testing.assert_array_equal(np.asarray(db), np.asarray(dbw))
+        # the fused relu-unit halves compose the same way
+        mask = r(8, 3) > 0
+        dxf, dwf, dbf = ops.linear_relu_grad_fused(g, mask, x, w)
+        np.testing.assert_array_equal(
+            np.asarray(dxf), np.asarray(ops.linear_relu_grad_input(g, mask, w))
+        )
+        dww2, dbw2 = ops.linear_relu_grad_weight(g, mask, x)
+        np.testing.assert_array_equal(np.asarray(dwf), np.asarray(dww2))
+        np.testing.assert_array_equal(np.asarray(dbf), np.asarray(dbw2))
+
     def test_softmax_grad(self):
         z, g = r(5, 10), r(5, 10)
         _, vjp = jax.vjp(ops.softmax, z)

@@ -355,6 +355,38 @@ def test_compiled_census_matches_layout_contract(data_dir, kw, present, absent):
     assert rec["hbm_headroom_fraction"] < 1.0
 
 
+def test_compiled_split_backward_census_and_tick_model(data_dir):
+    """A --backward-split session's COMPILED program still satisfies the
+    layout contract (both relay permutes, no dp collectives at dp=1), and
+    its comms model honestly derives from the SPLIT tick tables: more
+    ticks than the unsplit twin (the deferred B-weights extend the
+    program; the uniform per-tick permutes really ship those extra zero
+    payloads) at the same useful send count."""
+    run = _mesh_session(data_dir, pp=4, schedule="pipedream", backward_split=True)
+    ref = _mesh_session(data_dir, pp=4, schedule="pipedream")
+    compiled = run._epoch_fn.lower(*run._epoch_args()).compile()
+    rec = pa.audit_compiled(
+        compiled, expected=run._expected_comms, platform="cpu",
+        n_devices=run._cost_model.n_devices,
+    )
+    assert rec["census_ok"] is True, rec["mismatches"]
+    assert rec["census"]["collective_permute"]["count"] >= 2
+    split_pp = run._expected_comms["axes"]["pp"]
+    ref_pp = ref._expected_comms["axes"]["pp"]
+    assert split_pp["ticks"] > ref_pp["ticks"]
+    assert split_pp["payload_bytes"] == ref_pp["payload_bytes"]
+    assert (
+        split_pp["useful_bytes_per_step_per_device"]
+        == ref_pp["useful_bytes_per_step_per_device"]
+    )
+    # identical padded FLOPs: the split spreads the backward's work over
+    # two cells, it never adds or recomputes any
+    assert (
+        run._cost_model.padded_flops_per_batch
+        == ref._cost_model.padded_flops_per_batch
+    )
+
+
 def test_expected_comms_bucketed_contract_and_overlap_bounds(data_dir):
     """A bucketed session's contract: the dp axis carries the plan
     (mode/num_buckets/per-bucket bytes), TOTAL bytes are unchanged vs the

@@ -461,6 +461,32 @@ def test_report_without_audit_records_omits_sections(tmp_path, capsys):
     assert json.loads(capsys.readouterr().out)["xla_audit"] is None
 
 
+def test_report_weighted_bubble_row(tmp_path, capsys):
+    """The pipeline_program event's FLOP-weighted bubble renders as its
+    own row — tagged with the split-backward note when the program
+    deferred its weight grads, the plain FLOP-weighted note otherwise."""
+    for split in (False, True):
+        path = tmp_path / f"run_{split}.jsonl"
+        with JsonlMetrics(path) as m:
+            m.event(
+                "pipeline_program", schedule="pipedream", dp=1, pp=4,
+                bubble_fraction=0.27 if not split else 0.11,
+                weighted_bubble_fraction=0.40 if not split else 0.11,
+                backward_split=split,
+            )
+            m.event("epoch", epoch=0, loss=0.5, samples_per_sec=10.0, wall_s=1.0)
+        rep = report.build_report(read_jsonl(path))
+        assert rep["weighted_bubble_fraction"] == (0.40 if not split else 0.11)
+        assert rep["backward_split"] is split
+        assert report.main([str(path), "--format", "md"]) == 0
+        out = capsys.readouterr().out
+        assert "weighted bubble" in out
+        if split:
+            assert "split backward" in out
+        else:
+            assert "FLOP-weighted ticks" in out
+
+
 def test_report_reads_multihost_shard_glob(tmp_path, capsys):
     """The report CLI accepts a glob of multihost JSONL shards (and the
     bare-path fallback): per-host epoch records merge into one report."""

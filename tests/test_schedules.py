@@ -147,6 +147,58 @@ class TestPipeDreamFlush:
         assert compute == [True, False] * 4
 
 
+class TestBackwardSplitStreams:
+    """Structural properties of the split-backward instruction streams
+    (the lowering's own verifier re-checks these; here they are pinned as
+    pure-data schedule properties, like everything else in this file)."""
+
+    @pytest.mark.parametrize("cls", ALL_TRAIN)
+    @pytest.mark.parametrize("stage", [0, 1, 3])
+    def test_split_emits_one_pair_per_mubatch(self, cls, stage):
+        M = 4
+        cmds = flat(cls(num_micro_batches=M, num_stages=4, stage_id=stage,
+                        backward_split=True))
+        bins = [c.mubatch_id for c in cmds if isinstance(c, S.BackwardInputGradAcc)]
+        bwws = [c.mubatch_id for c in cmds if isinstance(c, S.BackwardWeightGradAcc)]
+        assert sorted(bins) == list(range(M))
+        assert sorted(bwws) == list(range(M))
+        # no combined backwards anywhere in a split stream
+        assert not any(
+            isinstance(c, (S.BackwardGradAcc, S.BackwardGradAllReduce)) for c in cmds
+        )
+
+    @pytest.mark.parametrize("cls", ALL_TRAIN)
+    def test_split_bweight_order_matches_binput_order(self, cls):
+        """The weight-grad accumulation-order contract, at the stream level."""
+        cmds = flat(cls(num_micro_batches=4, num_stages=4, stage_id=1,
+                        backward_split=True))
+        bins = [c.mubatch_id for c in cmds if isinstance(c, S.BackwardInputGradAcc)]
+        bwws = [c.mubatch_id for c in cmds if isinstance(c, S.BackwardWeightGradAcc)]
+        assert bwws == bins
+
+    @pytest.mark.parametrize("cls", ALL_TRAIN)
+    def test_split_sends_ride_the_binput(self, cls):
+        """SendInputGrad directly follows a B-input (the dx producer),
+        never a B-weight — the relay stays on the combined backward's
+        critical path."""
+        cmds = flat(cls(num_micro_batches=4, num_stages=4, stage_id=2,
+                        backward_split=True))
+        for i, c in enumerate(cmds):
+            if isinstance(c, S.SendInputGrad):
+                assert isinstance(cmds[i - 1], S.BackwardInputGradAcc)
+
+    @pytest.mark.parametrize("cls", ALL_TRAIN)
+    def test_split_anchor_is_final_bweight(self, cls):
+        cmds = flat(cls(num_micro_batches=4, num_stages=4, stage_id=1,
+                        backward_split=True))
+        ar = [i for i, c in enumerate(cmds)
+              if isinstance(c, S.BackwardWeightGradAllReduce)]
+        bww = [i for i, c in enumerate(cmds)
+               if isinstance(c, S.BackwardWeightGradAcc)]
+        assert len(ar) == 1
+        assert ar[0] == bww[-1]
+
+
 def test_inference_forward_only():
     for stage in range(3):
         cmds = flat(S.InferenceSchedule(num_micro_batches=2, num_stages=3, stage_id=stage))

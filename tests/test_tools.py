@@ -23,6 +23,53 @@ def test_show_schedule_renders_all(capsys):
     assert "gpipe  M=4 S=4: 14 ticks" in out
 
 
+def test_show_schedule_renders_split_cells(capsys):
+    """--backward-split diagrams: b<m> B-input cells at the combined
+    backward's ticks, W<m> B-weight cells in the bubbles, and BOTH
+    utilization figures in the header."""
+    scripts_dir = str(ROOT / "scripts")
+    sys.path.insert(0, scripts_dir)
+    try:
+        import show_schedule
+    finally:
+        sys.path.remove(scripts_dir)
+    show_schedule.render("pipedream", 4, 4, backward_split=True)
+    out = capsys.readouterr().out
+    assert "b0" in out and "W0" in out and "B0" not in out
+    assert "split-bwd" in out
+    assert "weighted" in out
+    # the README's quoted split diagram header
+    assert "15 ticks" in out
+
+
+def test_weighted_utilization_matches_documented_figures():
+    """docs/lowering.md's weighted-bubble table (1F1B M=8: 40% -> 11%
+    split; GPipe M=4: 43% -> 33%) must be computable from the lowered
+    tick tables — and the weights come from the cost model's single
+    source (fwd 1, combined bwd 2, split halves 1)."""
+    from shallowspeed_tpu import schedules as S
+    from shallowspeed_tpu.observability.costmodel import PIPELINE_OP_COSTS
+    from shallowspeed_tpu.parallel.lowering import (
+        lower_schedule,
+        weighted_makespan,
+        weighted_utilization,
+    )
+
+    assert PIPELINE_OP_COSTS == {"fwd": 1.0, "bwd": 2.0, "bwd_in": 1.0, "bwd_w": 1.0}
+    pd8 = lower_schedule(S.PipeDreamFlushSchedule, 8, 4)
+    pd8s = lower_schedule(S.PipeDreamFlushSchedule, 8, 4, backward_split=True)
+    assert round((1 - weighted_utilization(pd8)) * 100) == 40
+    assert round((1 - weighted_utilization(pd8s)) * 100) == 11
+    g4 = lower_schedule(S.GPipeSchedule, 4, 4)
+    g4s = lower_schedule(S.GPipeSchedule, 4, 4, backward_split=True)
+    assert round((1 - weighted_utilization(g4)) * 100) == 43
+    assert round((1 - weighted_utilization(g4s)) * 100) == 33
+    # the lockstep tick model: GPipe M=4 P=4 = 7 fwd-phase ticks (max
+    # weight 1) + 7 bwd-phase ticks (max weight 2) = 21 forward-units
+    assert weighted_makespan(g4) == 21.0
+    assert weighted_makespan(g4s) == float(g4s.num_ticks)  # all ticks weight 1
+
+
 def test_utilization_matches_documented_bubble_figures():
     """The docs' bubble-shrink claims (docs/lowering.md: flat 1F1B 57% vs
     interleaved V=2 73% at P=4, M=4; GPipe M/(M+S-1) per phase) must be

@@ -88,6 +88,20 @@ def main():
         "identical numerics either way; --audit verifies the bucket count "
         "and sizes in the compiled program (see docs/performance.md)",
     )
+    ap.add_argument(
+        "--backward-split",
+        action="store_true",
+        help="pipeline schedules (gpipe/pipedream/naive): two-stage backward "
+        "— each microbatch's backward is split into the relay-critical "
+        "B-input (d(loss)/d(input), at exactly the tick the combined "
+        "backward would run, so upstream stages never wait longer) and a "
+        "deferred B-weight (dW/db from the stashed activation + output-"
+        "grad) packed into otherwise-idle bubble ticks (2BP, arXiv "
+        "2405.18047). Bitwise-identical weights (the weight-grad "
+        "accumulation order is preserved); shrinks the FLOP-weighted "
+        "bubble fraction the report/show_schedule quote (see "
+        "docs/performance.md for when it pays)",
+    )
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--no-eval", action="store_true", help="skip per-epoch accuracy")
     ap.add_argument(
@@ -249,6 +263,7 @@ def main():
         virtual_stages=args.virtual_stages,
         zero1=args.zero1,
         grad_bucket_bytes=args.grad_bucket_bytes,
+        backward_split=args.backward_split,
         scan_unroll=args.scan_unroll,
         tick_unroll=args.tick_unroll,
         weight_decay=args.weight_decay,
