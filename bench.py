@@ -123,13 +123,25 @@ def _ensure_responsive_backend(probe_timeout_s=180, patience_s=None):
                 # retry launched just before the deadline would overshoot
                 # patience_s by up to probe_timeout_s (ADVICE r03)
                 if deadline - time.monotonic() >= probe_timeout_s:
+                    # bounded exponential backoff + jitter between probes
+                    # (the shared retry policy — scripts/tunnel_watch.sh and
+                    # the checkpoint writer use the same helper), clamped so
+                    # the last probe still fits the patience budget
+                    from shallowspeed_tpu import retry as _retry
+
+                    delay = _retry.backoff_delay(
+                        attempt - 1, base=20.0, factor=2.0, max_delay=120.0,
+                        jitter=0.2, seed=os.getpid(),
+                    )
                     print(
                         f"bench: tunnel probe {attempt} {detail}; retrying "
+                        f"in {delay:.0f}s "
                         f"({deadline - time.monotonic():.0f}s of patience left)",
                         file=sys.stderr,
                     )
                     time.sleep(
-                        min(120, max(0, deadline - time.monotonic() - probe_timeout_s))
+                        min(delay,
+                            max(0, deadline - time.monotonic() - probe_timeout_s))
                     )
                     continue
             else:

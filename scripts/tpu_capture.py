@@ -67,6 +67,24 @@ ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
 import bench  # the probe + the NumPy baseline + the headline protocol
+from shallowspeed_tpu import retry
+
+# Probe retries: this capture fronts bench._ensure_responsive_backend(),
+# whose between-probe sleeps use the SAME shared bounded-backoff-with-
+# jitter policy as scripts/tunnel_watch.sh and the checkpoint writer
+# (shallowspeed_tpu.retry) — no fixed-cadence hammering anywhere in the
+# tunnel tooling.
+
+
+def _write_artifact(path, obj):
+    """Artifact banking with the shared retry policy: one flaky host write
+    must not cost the round its measured cells (the .partial after every
+    phase IS the resume state; the renamed artifact IS the deliverable)."""
+    retry.retry_call(
+        lambda: Path(path).write_text(json.dumps(obj, indent=2) + "\n"),
+        attempts=3,
+        retry_on=(OSError,),
+    )
 
 
 def _measure_salvaged(run_ks, trials, samples_per_epoch):
@@ -942,7 +960,7 @@ def main():
         _load_resume_state(t0_result, (t0_out, t0_partial), config_sig)
     runner0 = _PhaseRunner(
         t0_result,
-        lambda: t0_partial.write_text(json.dumps(t0_result, indent=2) + "\n"),
+        lambda: _write_artifact(t0_partial, t0_result),
     )
     print("tier-0: headline pair + kernel triple + equality probes...", flush=True)
     tier0_phases(runner0, args.quick)
@@ -956,7 +974,7 @@ def main():
     )
     if t0_complete:
         t0_result["completed_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
-    t0_partial.write_text(json.dumps(t0_result, indent=2) + "\n")
+    _write_artifact(t0_partial, t0_result)
     if t0_complete:
         t0_partial.rename(t0_out)
         print(f"tier-0 artifact banked: {t0_out}", flush=True)
@@ -968,12 +986,10 @@ def main():
     # The runner's checkpoint is redirected to the banked file first, so
     # the phase cannot resurrect a stale .partial next to it.
     banked_path = t0_out if t0_complete else t0_partial
-    runner0.checkpoint = lambda: banked_path.write_text(
-        json.dumps(t0_result, indent=2) + "\n"
-    )
+    runner0.checkpoint = lambda: _write_artifact(banked_path, t0_result)
     print("t0b) epoch-kernel VMEM calibration compile...", flush=True)
     runner0.run("t0-vmem", epoch_kernel_vmem_analysis)
-    banked_path.write_text(json.dumps(t0_result, indent=2) + "\n")
+    _write_artifact(banked_path, t0_result)
     if args.tier0_only:
         print(json.dumps({
             "tier0": str(t0_out),
@@ -993,7 +1009,7 @@ def main():
         _load_resume_state(result, (Path(args.out), partial_path), config_sig)
     runner = _PhaseRunner(
         result,
-        lambda: partial_path.write_text(json.dumps(result, indent=2) + "\n"),
+        lambda: _write_artifact(partial_path, result),
     )
     trials = 2 if args.quick else 3
     nb_cells = 29 if args.quick else 116
@@ -1160,7 +1176,7 @@ def main():
     complete = capture_complete(result)
     if complete:
         result["completed_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
-    partial_path.write_text(json.dumps(result, indent=2) + "\n")
+    _write_artifact(partial_path, result)
     if complete:
         partial_path.rename(args.out)
     else:

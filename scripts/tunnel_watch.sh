@@ -1,10 +1,16 @@
 #!/bin/bash
-# Tunnel watcher: probe the axon TPU tunnel on a 10-minute cadence and run
-# the one-claim capture the moment it answers. The capture itself is
-# wedge-contained (tier-0 banking, per-phase budgets, --resume), so the
-# watcher's only jobs are (1) never miss a healthy window, (2) retry a
-# killed capture WITH --resume so completed phases are never re-measured,
-# (3) stop when the full artifact exists.
+# Tunnel watcher: probe the axon TPU tunnel and run the one-claim capture the
+# moment it answers. The capture itself is wedge-contained (tier-0 banking,
+# per-phase budgets, --resume), so the watcher's only jobs are (1) never miss
+# a healthy window, (2) retry a killed capture WITH --resume so completed
+# phases are never re-measured, (3) stop when the full artifact exists.
+#
+# Probe cadence: bounded exponential backoff with jitter from the shared
+# retry helper (python -m shallowspeed_tpu.retry — the same policy the
+# checkpoint writer and bench's probe loop use), NOT a fixed interval: the
+# r05 watcher hammered a dead tunnel on a fixed 10-minute cadence for 48
+# consecutive probes. Delays grow 120 s -> 1800 s cap (±20% jitter) while
+# the tunnel stays dead, and reset to the base the moment a probe succeeds.
 #
 # Usage: scripts/tunnel_watch.sh [OUT_JSON] [WINDOW_SECONDS]
 #   OUT_JSON        capture artifact path (default TPU_CAPTURE_r05.json)
@@ -13,6 +19,8 @@
 OUT=${1:-TPU_CAPTURE_r05.json}
 END=$(( $(date +%s) + ${2:-39600} ))
 LOG=/tmp/tunnel_probe.log
+SEED=${TUNNEL_BACKOFF_SEED:-$$}
+ATTEMPT=0
 cd "$(dirname "$0")/.."
 while [ "$(date +%s)" -lt "$END" ]; do
   if [ -f "$OUT" ]; then
@@ -24,6 +32,7 @@ while [ "$(date +%s)" -lt "$END" ]; do
   RC=$?
   echo "$(date -u +%FT%TZ) rc=$RC dt=$(( $(date +%s) - T0 ))s" >> "$LOG"
   if [ "$RC" = "0" ]; then
+    ATTEMPT=0
     echo "$(date -u +%FT%TZ) TUNNEL HEALTHY -> capture (--resume)" >> "$LOG"
     timeout 10800 python scripts/tpu_capture.py --resume --out "$OUT" \
       >> /tmp/capture_watch.log 2>&1
@@ -31,7 +40,12 @@ while [ "$(date +%s)" -lt "$END" ]; do
     [ -f "$OUT" ] && exit 0
     sleep 300
   else
-    sleep 600
+    DELAY=$(python -m shallowspeed_tpu.retry --attempts $(( ATTEMPT + 1 )) \
+      --base 120 --max 1800 --jitter 0.2 --seed "$SEED" | tail -1)
+    [ -n "$DELAY" ] || DELAY=600  # helper unavailable: old fixed cadence
+    ATTEMPT=$(( ATTEMPT + 1 ))
+    echo "$(date -u +%FT%TZ) backoff attempt=$ATTEMPT sleep=${DELAY}s" >> "$LOG"
+    sleep "$DELAY"
   fi
 done
 echo "$(date -u +%FT%TZ) watch window ended" >> "$LOG"

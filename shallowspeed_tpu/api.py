@@ -17,6 +17,7 @@ else the SPMD pipeline executor — same weights either way (tested layout
 equivalence).
 """
 
+import sys
 import time
 
 import jax
@@ -25,14 +26,22 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec
 
+from shallowspeed_tpu import faults as F
 from shallowspeed_tpu import model as Mo
 from shallowspeed_tpu import schedules as S
 from shallowspeed_tpu import trainer, utils
-from shallowspeed_tpu.checkpoint import load_checkpoint, save_checkpoint
+from shallowspeed_tpu.checkpoint import (
+    CheckpointError,
+    find_latest_good,
+    load_checkpoint,
+    rotate_step_checkpoints,
+    save_checkpoint,
+    step_checkpoint_path,
+)
 from shallowspeed_tpu.data import Dataset, default_data_dir
 from shallowspeed_tpu.observability import NullMetrics, costmodel, program_audit
 from shallowspeed_tpu.observability.flight import FlightRecorder
-from shallowspeed_tpu.observability.health import make_monitor
+from shallowspeed_tpu.observability.health import HealthError, make_monitor
 from shallowspeed_tpu.optimizer import (
     is_stateless,
     join_state,
@@ -94,6 +103,9 @@ class TrainingSession:
         health=None,
         record_steps=None,
         audit=False,
+        checkpoint_dir=None,
+        checkpoint_keep=3,
+        faults=None,
     ):
         # telemetry hook (observability package): None -> the zero-overhead
         # null backend. Everything the session emits — construction spans,
@@ -227,6 +239,33 @@ class TrainingSession:
                     "fused pallas flag kernel has no split halves"
                 )
         self.epoch = 0
+        # step cursor within the current epoch: 0 except after a mid-epoch
+        # resume / between train_steps() chunks. global_step (property) is
+        # the run-lifetime optimizer-step count — the unit the step
+        # checkpoints, fault injections and flight records all share.
+        self.step_in_epoch = 0
+        # fault-tolerance wiring (docs/robustness.md): the step-checkpoint
+        # directory + retention, the fault-injection plan (explicit arg, or
+        # the SHALLOWSPEED_FAULTS env spec), and what resume discovered
+        if checkpoint_keep < 1:
+            raise ValueError("checkpoint_keep must be >= 1")
+        self._ckpt_dir = checkpoint_dir
+        self._ckpt_keep = int(checkpoint_keep)
+        # paths THIS session wrote with all_finite=True: rotation trusts
+        # them without re-reading (their checksums were computed in-process)
+        self._trusted_snapshots = set()
+        self._faults = F.make_plan(faults)
+        self.resumed_from = None  # path of the restored snapshot, if any
+        self._recovery = None  # the recovery record's fields, if resume ran
+        # per-epoch aggregation across train_steps() chunks. steps_counted
+        # tracks how many steps THIS process dispatched: after a mid-epoch
+        # resume it is smaller than batches_per_epoch (the head of the
+        # epoch ran in the dead process), and the completing epoch's
+        # loss/throughput are reported over the counted steps only
+        self._epoch_loss_sum = 0.0
+        self._epoch_wall = 0.0
+        self._epoch_steps_counted = 0
+        self._epoch_first_dispatch = False
 
         data_dir = data_dir or default_data_dir()
         self._data_dir = data_dir
@@ -269,10 +308,50 @@ class TrainingSession:
         }
 
         host_opt_state = None  # logical (per-stage ragged) saved state, if any
+        if resume == "auto":
+            # crash-recovery discovery: newest VERIFYING snapshot in the
+            # checkpoint dir (corrupt/torn/non-finite ones are skipped with
+            # their causes recorded); an empty/missing dir is a fresh start,
+            # a dir with snapshots where NONE verifies is unrecoverable
+            if self._ckpt_dir is None:
+                raise ValueError(
+                    "resume='auto' discovers snapshots in the step-checkpoint "
+                    "directory — pass checkpoint_dir"
+                )
+            path, _, skipped = find_latest_good(self._ckpt_dir)
+            skipped_fields = [
+                {"path": str(p), "cause": cause} for p, cause in skipped
+            ]
+            if path is None and skipped:
+                # every candidate failed: corrupt/torn files, or non-finite
+                # blow-up snapshots that discovery skips BY DESIGN — name
+                # each cause so the operator can tell which they have
+                raise CheckpointError(
+                    self._ckpt_dir,
+                    "no snapshot verifies: "
+                    + "; ".join(f"{p.name}: {c}" for p, c in skipped)
+                    + " (non-finite snapshots are skipped by design — "
+                    "delete the directory to start fresh)",
+                )
+            if path is None:
+                resume = None
+                self._recovery = {
+                    "verdict": "fresh_start",
+                    "resumed_from": None,
+                    "skipped": skipped_fields,
+                }
+            else:
+                resume = path
+                self._recovery = {
+                    "verdict": "resumed",
+                    "resumed_from": str(path),
+                    "skipped": skipped_fields,
+                }
         if resume is not None:
             host_params, loaded_spec, meta, host_opt_state = load_checkpoint(
                 resume, n_model_stages, self.B, with_opt_state=True
             )
+            self.resumed_from = str(resume)
             if tuple(loaded_spec.sizes) != tuple(self.spec.sizes):
                 raise ValueError(
                     f"checkpoint sizes {loaded_spec.sizes} do not match the "
@@ -308,7 +387,29 @@ class TrainingSession:
                         f"silently change the trajectory — pass the saved value"
                     )
             self.spec = loaded_spec
-            self.epoch = meta["epoch"] + 1
+            if meta.get("step_in_epoch") is not None:
+                # v2 step snapshot: ``epoch`` is the epoch IN PROGRESS and
+                # the cursor restarts mid-epoch. The bit-identity contract
+                # needs the identical deterministic data order, so the
+                # global batch size must match the saved run exactly.
+                if meta["global_batch_size"] != self.B:
+                    raise ValueError(
+                        f"mid-epoch resume needs the saved data order: "
+                        f"checkpoint was taken at global_batch_size="
+                        f"{meta['global_batch_size']}, this run uses {self.B}"
+                    )
+                if not 0 <= meta["step_in_epoch"] < max(nb, 1):
+                    raise ValueError(
+                        f"checkpoint step_in_epoch {meta['step_in_epoch']} "
+                        f"out of range for {nb} batches/epoch — different "
+                        f"dataset?"
+                    )
+                self.epoch = int(meta["epoch"])
+                self.step_in_epoch = int(meta["step_in_epoch"])
+            else:
+                # legacy epoch-boundary snapshot: ``epoch`` is the last
+                # COMPLETED epoch
+                self.epoch = meta["epoch"] + 1
         else:
             host_params = Mo.init_model(self.spec)
 
@@ -342,6 +443,10 @@ class TrainingSession:
             )
         self._step_aux = bool(record_steps) and not kernel_path
         self.flight = FlightRecorder() if self._step_aux else None
+        if self.flight is not None:
+            # the metrics cursor: resumed step records continue the global
+            # numbering instead of restarting at 0
+            self.flight.total_steps = self.global_step
         self._epoch_compiled = False  # compile-span already recorded?
         self._epoch_dispatched = False  # first train_epoch includes compile
         self._cost_recorded = False  # cost_model event already emitted?
@@ -501,6 +606,18 @@ class TrainingSession:
             precision=self._precision_name,
             grad_bucket_plan=self._sync_plan,
         )
+        if self._recovery is not None and self._metrics.enabled:
+            # one schema-v4 recovery record per resume decision: what was
+            # restored (or that nothing was), where training restarts, and
+            # every corrupt snapshot skipped on the way
+            self._metrics.recovery(
+                self._recovery["verdict"],
+                resumed_from=self._recovery["resumed_from"],
+                epoch=self.epoch,
+                step_in_epoch=self.step_in_epoch,
+                global_step=self.global_step,
+                skipped=self._recovery["skipped"],
+            )
 
     # -- training -----------------------------------------------------------
 
@@ -541,6 +658,49 @@ class TrainingSession:
         # trained on the mislowered program
         self._record_audit(compiled, "epoch_program")
         self._epoch_compiled = True
+        self._record_cost_model()
+
+    def _refuse_pending_faults(self, entry):
+        """Injections fire at step boundaries, which only ``train_steps``
+        has — a whole-epoch or fused-run dispatch would sail straight past
+        them, and a recovery harness that expected the kill would conclude
+        the crash/resume path works when nothing was injected. Refuse
+        loudly instead of skipping silently."""
+        if self._faults and self._faults.pending:
+            raise ValueError(
+                f"{entry}() cannot honor the pending fault injection(s) "
+                f"{self._faults.pending!r}: injections land on step "
+                "boundaries — drive this run with train_steps()"
+            )
+
+    def _ensure_chunk_audited(self, k0, k1):
+        """Chunk-shaped sibling of ``_ensure_epoch_compiled``: a
+        ``train_steps`` dispatch over batches [k0, k1) is a DISTINCT XLA
+        program whenever the slice is shorter than the epoch, so the audit
+        contract ("a mislowered layout never trains a step") must census
+        that program, not the full-epoch one. Per distinct chunk length the
+        sliced program is AOT-compiled once inside a ``jit_compile`` span
+        and audited (the scan body — and therefore the collective census —
+        is length-independent; only the trip count changes). Full-epoch
+        slices take the normal epoch path; chunked-only sessions never pay
+        the full-epoch compile their dispatches would not use."""
+        if k1 - k0 == self.batches_per_epoch:
+            self._ensure_epoch_compiled()
+            return
+        if not (self._metrics.enabled or self._audit_strict):
+            return
+        dedup = ("chunk", k1 - k0)
+        if dedup in self._audit_done:
+            return
+        with self._metrics.span("jit_compile"):
+            compiled = self._epoch_fn.lower(
+                *self._sliced_epoch_args(k0, k1)
+            ).compile()
+        self._metrics.counter("jit_compiles")
+        # audited (and marked done) only on a pass — same never-latch-a-
+        # failure contract as the epoch path. No cost-model attach: the
+        # cross-check is defined against the epoch program's shapes.
+        self._record_audit(compiled, "chunk_program", dedup=dedup)
         self._record_cost_model()
 
     def _record_audit(self, compiled, program, dedup=None):
@@ -625,6 +785,220 @@ class TrainingSession:
             )
             self._health.dispatch(findings, self._metrics)
 
+    @property
+    def global_step(self):
+        """Run-lifetime optimizer-step count — the unit step checkpoints,
+        fault injections and flight-record numbering share."""
+        return self.epoch * self.batches_per_epoch + self.step_in_epoch
+
+    @property
+    def faults_active(self):
+        """True when a fault-injection plan is loaded (arg or env) — the
+        driver must then use the step loop so injections land on their
+        exact steps."""
+        return bool(self._faults)
+
+    def _sliced_epoch_args(self, k0, k1):
+        """The layout's runtime argument tuple for batches [k0, k1) of the
+        current epoch (the full-epoch tuple when k0=0, k1=nb)."""
+        if self._sequential:
+            return (self._params, self._opt_state, self._Xe[k0:k1], self._Ye[k0:k1])
+        return (
+            self._stacked, self._flags, self._opt_state,
+            self._X[k0:k1], self._Y[k0:k1],
+        )
+
+    def train_steps(self, n):
+        """Train up to ``n`` optimizer steps of the CURRENT epoch (clipped at
+        the epoch boundary) — the preemption-safe unit: the epoch-scan
+        program runs over a SLICE of the batch axis, so chunked dispatch
+        applies the exact same per-batch updates in the exact same order as
+        one whole-epoch dispatch (bitwise-identical weights; tested), while
+        the host regains control between chunks to write step checkpoints.
+
+        Fault-injection boundaries: when the active plan has a fault inside
+        this chunk, the chunk is truncated so the fault's step starts the
+        next call — ``die`` then kills the process (exception or SIGKILL)
+        BEFORE that step trains, ``nan`` poisons the params so that step's
+        gradients blow up.
+
+        Returns ``(steps_trained, epoch_mean_loss_or_None)`` — the mean loss
+        is reported once, on the call that completes the epoch (same
+        definition as ``train_epoch``; the per-chunk means are recombined
+        sample-weighted). After a mid-epoch resume the mean covers only the
+        steps THIS process trained — the epoch's head belongs to the dead
+        process's stream — and the epoch record carries ``steps_counted``
+        to say so. Under health policy 'halt' a finding raises
+        HealthError AFTER flushing a snapshot (when a checkpoint_dir is
+        configured), so the blow-up is resumable.
+        """
+        nb = self.batches_per_epoch
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        k0 = self.step_in_epoch
+        k1 = min(k0 + n, nb)
+        g0 = self.epoch * nb + k0
+        if self._faults:
+            # EVERY un-fired fault scheduled at g0 fires before the dispatch
+            # (same-step compositions like "nan@step=3,die@step=3" fire in
+            # spec order — a single-shot check would leave the second one
+            # pending forever, since later windows all start past g0); then
+            # the next pending fault inside this chunk still truncates it,
+            # or the chunk would dispatch straight past its step
+            fault = self._faults.first_in(g0, g0 + (k1 - k0))
+            while fault is not None and fault.step == g0:
+                if fault.kind == "die":
+                    self._faults.fire_die(fault)  # SIGKILL never returns
+                elif fault.kind == "nan":
+                    fault.fired = True
+                    if self._sequential:
+                        self._params = F.poison_nan(self._params)
+                    else:
+                        self._stacked = F.poison_nan(self._stacked)
+                fault = self._faults.first_in(g0, g0 + (k1 - k0))
+            if fault is not None:
+                k1 = k0 + (fault.step - g0)  # fault lands on a boundary
+        epoch_index = self.epoch
+        first_dispatch = self._metrics.enabled and not self._epoch_dispatched
+        self._ensure_chunk_audited(k0, k1)
+        t0 = time.perf_counter()
+        with self._metrics.span("train_steps"):
+            out = self._epoch_fn(*self._sliced_epoch_args(k0, k1))
+            if self._sequential:
+                self._params, self._opt_state, mean_loss = out[0], out[1], out[2]
+            else:
+                self._stacked, self._opt_state, mean_loss = out[0], out[1], out[2]
+            loss = float(mean_loss)  # forces device completion
+        wall = time.perf_counter() - t0
+        aux = out[3] if (self._epoch_aux or self._step_aux) else None
+        self._epoch_dispatched = True
+        steps = k1 - k0
+        self.step_in_epoch = k1
+        self._epoch_loss_sum += loss * steps
+        self._epoch_wall += wall
+        self._epoch_steps_counted += steps
+        self._epoch_first_dispatch = self._epoch_first_dispatch or first_dispatch
+        if self._metrics.enabled:
+            self._metrics.counter("samples_trained", steps * self.B)
+        epoch_loss = None
+        if k1 == nb:
+            # loss/throughput over the steps THIS process dispatched: after
+            # a mid-epoch resume that is the epoch's tail only (the head's
+            # evidence lives in the dead process's record stream), so the
+            # record says so instead of diluting the mean by the full nb
+            # and inflating samples/s with samples it never trained
+            counted = self._epoch_steps_counted
+            epoch_loss = self._epoch_loss_sum / counted
+            if self._metrics.enabled:
+                samples = counted * self.B
+                ew = self._epoch_wall
+                sps = samples / ew if ew > 0 else 0.0
+                record = dict(
+                    epoch=epoch_index,
+                    loss=epoch_loss,
+                    samples_per_sec=sps,
+                    wall_s=ew,
+                    chunked=True,  # wall spans >= 1 dispatches + host gaps
+                )
+                if counted < nb:
+                    record["steps_counted"] = counted  # mid-epoch resume
+                if self._epoch_first_dispatch:
+                    record["includes_compile"] = True
+                mfu = self._record_utilization(sps)
+                if mfu is not None:
+                    record["mfu"] = mfu
+                self._metrics.event("epoch", **record)
+                self._metrics.counter("epochs_trained")
+            self.epoch += 1
+            self.step_in_epoch = 0
+            self._epoch_loss_sum = 0.0
+            self._epoch_wall = 0.0
+            self._epoch_steps_counted = 0
+            self._epoch_first_dispatch = False
+        # flight + health LAST: session state is consistent if 'halt' raises
+        try:
+            if self._step_aux:
+                self._record_flight(epoch_index, aux)
+            elif self._health is not None:
+                self._health.dispatch(
+                    self._health.check_epoch(epoch_index, [loss]), self._metrics
+                )
+        except HealthError:
+            self._flush_halt_checkpoint()
+            raise
+        return steps, epoch_loss
+
+    def save_step_checkpoint(self, reason="step", rotate=True):
+        """Write the resumable snapshot at the current ``global_step`` into
+        the session's checkpoint directory (``step-<global_step>.npz``:
+        params + optimizer state + step cursor + content checksum), rotate
+        retention down to ``checkpoint_keep``, and emit a schema-v4
+        ``checkpoint`` record. Returns the written path.
+
+        Rotation is skipped when ``rotate=False`` (the halt flush opts out)
+        AND whenever the snapshot just written is non-finite: once a run
+        blows up, every grid save carries ``all_finite: false``, and
+        unconditional rotation would delete the last healthy snapshot
+        within ``keep`` intervals — making ``resume='auto'`` (which skips
+        non-finite snapshots by design) permanently unrecoverable. Instead
+        the non-finite evidence accumulates unrotated until finiteness
+        returns; recoverability beats disk tidiness on a blown-up run.
+        (``rotate_step_checkpoints`` itself also ranks fully-verifying
+        snapshots above non-finite/corrupt ones, so when rotation does
+        fire it reclaims the stale unusable pile, never a healthy
+        snapshot.)"""
+        if self._ckpt_dir is None:
+            raise ValueError(
+                "no checkpoint_dir configured on this session"
+            )
+        gs = self.global_step
+        path = step_checkpoint_path(self._ckpt_dir, gs)
+        t0 = time.perf_counter()
+        nbytes, finite = save_checkpoint(
+            path,
+            self.params(),
+            self.spec,
+            self.epoch,
+            extra={"optimizer": self._opt_config},
+            opt_state=self.opt_state_logical(),
+            step_in_epoch=self.step_in_epoch,
+            global_step=gs,
+        )
+        if finite:
+            self._trusted_snapshots.add(str(path))
+        if rotate and finite:
+            rotate_step_checkpoints(
+                self._ckpt_dir, self._ckpt_keep,
+                trusted=self._trusted_snapshots,
+            )
+        wall = time.perf_counter() - t0
+        if self._metrics.enabled:
+            self._metrics.checkpoint(
+                reason,
+                path=str(path),
+                epoch=self.epoch,
+                step_in_epoch=self.step_in_epoch,
+                global_step=gs,
+                bytes=int(nbytes),
+                wall_s=wall,
+            )
+        return path
+
+    def _flush_halt_checkpoint(self):
+        """The health monitor's halt policy flushes a snapshot BEFORE the
+        HealthError propagates (when a checkpoint directory is configured):
+        a finite finding (grad spike, divergence) is resumable from the
+        halt step itself; a non-finite one writes an ``all_finite: false``
+        snapshot that resume discovery SKIPS, landing on the last healthy
+        step instead. Best-effort — a failing flush never masks the halt."""
+        if self._ckpt_dir is None:
+            return
+        try:
+            self.save_step_checkpoint(reason="halt", rotate=False)
+            self._metrics.flush()
+        except Exception as e:  # noqa: BLE001 — never mask the HealthError
+            print(f"halt checkpoint flush failed: {e}", file=sys.stderr)
+
     def train_epoch(self) -> float:
         """One epoch over the training shard; returns the mean batch training
         loss (same definition on both layouts: global-batch-scaled MSE of each
@@ -636,6 +1010,13 @@ class TrainingSession:
         recorded epoch carries ``includes_compile: true`` — the jit call
         cache is cold on the first dispatch, so that record's wall clock
         includes compilation and must not be read as steady-state."""
+        if self.step_in_epoch != 0:
+            raise ValueError(
+                f"epoch {self.epoch} is mid-flight at step "
+                f"{self.step_in_epoch} (resumed or chunked) — use "
+                f"train_steps() to finish it"
+            )
+        self._refuse_pending_faults("train_epoch")
         first_dispatch = self._metrics.enabled and not self._epoch_dispatched
         self._ensure_epoch_compiled()
         epoch_index = self.epoch
@@ -677,16 +1058,21 @@ class TrainingSession:
         self._epoch_dispatched = True
         self.epoch += 1
         # flight recording + health checks LAST: session state is already
-        # consistent when a 'halt' policy raises out of here
-        if self._step_aux:
-            self._record_flight(epoch_index, aux)
-        elif self._health is not None:
-            # no per-step aux (kernel paths can't thread it — gradients
-            # never leave VMEM — or record_steps=False opted out): fall
-            # back to epoch-granular loss checks
-            self._health.dispatch(
-                self._health.check_epoch(epoch_index, [loss]), self._metrics
-            )
+        # consistent when a 'halt' policy raises out of here (and the halt
+        # path flushes a snapshot first, so the blow-up is resumable)
+        try:
+            if self._step_aux:
+                self._record_flight(epoch_index, aux)
+            elif self._health is not None:
+                # no per-step aux (kernel paths can't thread it — gradients
+                # never leave VMEM — or record_steps=False opted out): fall
+                # back to epoch-granular loss checks
+                self._health.dispatch(
+                    self._health.check_epoch(epoch_index, [loss]), self._metrics
+                )
+        except HealthError:
+            self._flush_halt_checkpoint()
+            raise
         return loss
 
     def train_run(self, epochs: int, with_eval: bool = True):
@@ -702,6 +1088,13 @@ class TrainingSession:
         """
         if epochs <= 0:
             raise ValueError("epochs must be positive")
+        if self.step_in_epoch != 0:
+            raise ValueError(
+                f"epoch {self.epoch} is mid-flight at step "
+                f"{self.step_in_epoch} (resumed or chunked) — finish it with "
+                f"train_steps() before a fused train_run()"
+            )
+        self._refuse_pending_faults("train_run")
         if with_eval and self._vx is None:
             self._load_val()
         if self._metrics.enabled or self._audit_strict:

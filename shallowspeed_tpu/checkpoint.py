@@ -12,18 +12,54 @@ Format: a single .npz (atomic rename on save) with arrays ``w{i}``/``b{i}``
 per global layer, optional optimizer-state arrays ``ow{i}``/``ob{i}`` in the
 same logical order (for stateful optimizers, e.g. momentum velocity), plus a
 JSON metadata blob (sizes, global batch size, epoch, optimizer config).
+
+Format v2 (additive; v1 files load unchanged) makes checkpoints the
+RESUMABLE unit of fault tolerance (docs/robustness.md):
+
+- a step cursor: ``global_step`` / ``step_in_epoch`` — a snapshot taken
+  mid-epoch resumes exactly at its step, not at the last epoch boundary;
+- a content ``checksum`` (sha256 over every array's bytes, name-sorted):
+  a torn or bit-flipped file is DETECTED on load instead of silently
+  training on garbage;
+- an ``all_finite`` flag, so resume discovery can skip a snapshot flushed
+  mid-blow-up (the health monitor's halt path) without re-reading it.
+
+Step-checkpoint directories (``step-<global_step>.npz``, rotating retention)
+plus ``find_latest_good`` — newest-first discovery that VERIFIES each
+candidate and falls back past corrupt ones — are what ``--resume auto``
+runs on. Loader errors surface as ``CheckpointError`` naming the path and
+the suspected cause (zero-byte / truncated / wrong format / checksum
+mismatch), never a raw NumPy/zipfile traceback.
 """
 
+import hashlib
 import json
 import os
+import re
 import tempfile
+import zipfile
 from pathlib import Path
 
 import numpy as np
 
+from shallowspeed_tpu import retry
 from shallowspeed_tpu.model import ModelSpec, make_model_spec
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+SUPPORTED_VERSIONS = (1, 2)
+
+STEP_CHECKPOINT_RE = re.compile(r"^step-(\d+)\.npz$")
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file that cannot be trusted: unreadable, truncated,
+    wrong format, or failing its content checksum. Carries the ``path``
+    and a human ``cause`` so the error names what to look at."""
+
+    def __init__(self, path, cause):
+        self.path = str(path)
+        self.cause = cause
+        super().__init__(f"checkpoint {self.path}: {cause}")
 
 
 def _flatten_logical(params_list):
@@ -50,8 +86,31 @@ def _opt_prefix(key):
     return ("ow", "ob") if key == "" else (f"o_{key}_w", f"o_{key}_b")
 
 
+def content_checksum(arrays):
+    """sha256 over every non-meta array's name, dtype, shape and bytes, in
+    name-sorted order — the torn/corrupt-file detector format v2 stores in
+    (and verifies against) the metadata blob."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        if name == "meta":
+            continue
+        a = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
 def save_checkpoint(
-    path, params_list, spec: ModelSpec, epoch: int, extra=None, opt_state=None
+    path,
+    params_list,
+    spec: ModelSpec,
+    epoch: int,
+    extra=None,
+    opt_state=None,
+    step_in_epoch=None,
+    global_step=None,
 ):
     """Atomically write params (+ metadata) to ``path`` (.npz).
 
@@ -61,6 +120,19 @@ def save_checkpoint(
     mirror the params — momentum velocity, Adam moments) — stored in the
     same logical layer order, so it is exactly as layout-independent as the
     weights; scalars (Adam's step count) go into the metadata blob.
+
+    ``step_in_epoch`` / ``global_step``: the v2 resumable cursor — with
+    them set, ``epoch`` means "the epoch IN PROGRESS" and resume restarts
+    at exactly this optimizer step; without them (the legacy epoch-boundary
+    save), ``epoch`` means "last COMPLETED epoch" and resume restarts at
+    ``epoch + 1``. A mid-stream failure never leaves a temp file behind,
+    and transient ``OSError`` on the write path is retried with bounded
+    backoff (retry.retry_call) before surfacing.
+
+    Returns ``(bytes_written, all_finite)`` — the finiteness flag that was
+    stamped into the metadata, so callers can gate retention on it without
+    re-scanning the arrays (a non-finite snapshot must never rotate the
+    last healthy one away).
     """
     path = Path(path)
     flat = _flatten_logical(params_list)
@@ -75,12 +147,14 @@ def save_checkpoint(
         "sizes": list(spec.sizes),
         "global_batch_size": spec.global_batch_size,
         "epoch": int(epoch),
+        "step_in_epoch": None if step_in_epoch is None else int(step_in_epoch),
+        "global_step": None if global_step is None else int(global_step),
         "has_opt_state": "" in parts,  # legacy momentum flag (round-1 readers)
         "opt_parts": sorted(parts),
         "opt_scalars": {k: float(v) for k, v in scalars.items()},
         "extra": extra or {},
     }
-    arrays = {"meta": np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)}
+    arrays = {}
     for i, (w, b) in enumerate(flat):
         arrays[f"w{i}"] = w
         arrays[f"b{i}"] = b
@@ -101,16 +175,32 @@ def save_checkpoint(
                 )
             arrays[f"{pw}{i}"] = ow
             arrays[f"{pb}{i}"] = ob
+    # checksum + finiteness are computed over the EXACT arrays written, and
+    # land in the metadata blob inside the same atomic file
+    meta["checksum"] = content_checksum(arrays)
+    meta["all_finite"] = bool(
+        all(np.isfinite(a).all() for a in arrays.values())
+    )
+    arrays["meta"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
     path.parent.mkdir(parents=True, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".npz.tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **arrays)
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+
+    def write_once():
+        # mkstemp INSIDE the retried body: each attempt owns (and on any
+        # failure removes) its own temp file, so a mid-stream exception —
+        # first attempt or last — never leaks a *.npz.tmp beside the target
+        fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".npz.tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return os.path.getsize(path)
+
+    nbytes = retry.retry_call(write_once, attempts=3, retry_on=(OSError,))
+    return nbytes, meta["all_finite"]
 
 
 def _partition(flat, spec: ModelSpec):
@@ -124,6 +214,85 @@ def _partition(flat, spec: ModelSpec):
             k += 1
         out.append(layers)
     return out
+
+
+def _read_arrays(path):
+    """Open ``path`` and return ``(meta, arrays)`` with every failure mode
+    translated into a ``CheckpointError`` naming the path and the suspected
+    cause (raw NumPy/zipfile tracebacks name neither). Verifies the v2
+    content checksum when the metadata carries one."""
+    path = Path(path)
+    try:
+        size = path.stat().st_size
+    except OSError as e:
+        raise CheckpointError(path, f"cannot stat file ({e})") from e
+    if size == 0:
+        raise CheckpointError(
+            path, "file is empty (zero bytes — torn write or placeholder)"
+        )
+    try:
+        with np.load(path) as z:
+            arrays = {name: z[name] for name in z.files}
+    except zipfile.BadZipFile as e:
+        raise CheckpointError(
+            path,
+            f"truncated or corrupt .npz archive ({e}) — the write likely "
+            "died mid-stream",
+        ) from e
+    except (OSError, EOFError) as e:
+        raise CheckpointError(path, f"unreadable ({e})") from e
+    except ValueError as e:
+        raise CheckpointError(
+            path, f"not a .npz checkpoint (wrong format: {e})"
+        ) from e
+    if "meta" not in arrays:
+        raise CheckpointError(
+            path, "no metadata blob — not a shallowspeed checkpoint"
+        )
+    try:
+        meta = json.loads(bytes(arrays["meta"]).decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise CheckpointError(
+            path, f"metadata blob is not valid JSON ({e}) — corrupt file"
+        ) from e
+    if meta.get("format_version") not in SUPPORTED_VERSIONS:
+        raise CheckpointError(
+            path,
+            f"unsupported format version {meta.get('format_version')!r} "
+            f"(this reader understands {SUPPORTED_VERSIONS})",
+        )
+    saved_sum = meta.get("checksum")
+    if saved_sum is not None:
+        actual = content_checksum(arrays)
+        if actual != saved_sum:
+            raise CheckpointError(
+                path,
+                f"content checksum mismatch (stored {saved_sum[:12]}…, "
+                f"recomputed {actual[:12]}…) — torn or corrupted write",
+            )
+    return meta, arrays
+
+
+def verify_checkpoint(path, require_finite=False):
+    """Full verification pass (read + parse + checksum): returns the
+    metadata dict of a trustworthy checkpoint, raises ``CheckpointError``
+    otherwise. ``require_finite=True`` additionally rejects snapshots whose
+    arrays contain NaN/Inf (resume discovery uses this so a checkpoint
+    flushed mid-blow-up is skipped in favor of the last healthy one)."""
+    meta, arrays = _read_arrays(path)
+    if require_finite:
+        finite = meta.get("all_finite")
+        if finite is None:  # v1 file: flag absent, check the arrays
+            finite = all(
+                np.isfinite(a).all()
+                for name, a in arrays.items()
+                if name != "meta" and np.issubdtype(a.dtype, np.floating)
+            )
+        if not finite:
+            raise CheckpointError(
+                path, "contains non-finite values (snapshot of a blown-up run)"
+            )
+    return meta
 
 
 def load_checkpoint(path, n_stages: int, global_batch_size=None, with_opt_state=False):
@@ -140,11 +309,12 @@ def load_checkpoint(path, n_stages: int, global_batch_size=None, with_opt_state=
     returns (params_list, spec, meta, opt_state) where opt_state is
     ``{"parts": {key: ragged_list}, "scalars": {key: float}}`` (each part
     mirrors params_list), or None when the checkpoint stored none.
+
+    An unreadable / truncated / checksum-failing file raises
+    ``CheckpointError`` naming the path and the suspected cause.
     """
-    with np.load(Path(path)) as z:
-        meta = json.loads(bytes(z["meta"]).decode())
-        if meta.get("format_version") != FORMAT_VERSION:
-            raise ValueError(f"unsupported checkpoint version: {meta}")
+    meta, z = _read_arrays(path)
+    try:
         n_layers = len(meta["sizes"]) - 1
         flat = [(z[f"w{i}"], z[f"b{i}"]) for i in range(n_layers)]
         # opt_parts supersedes has_opt_state; round-1 files have only the
@@ -156,6 +326,10 @@ def load_checkpoint(path, n_stages: int, global_batch_size=None, with_opt_state=
         for key in part_keys:
             pw, pb = _opt_prefix(key)
             flat_parts[key] = [(z[f"{pw}{i}"], z[f"{pb}{i}"]) for i in range(n_layers)]
+    except KeyError as e:
+        raise CheckpointError(
+            path, f"missing array {e} — truncated or foreign file"
+        ) from e
     if global_batch_size is None:
         global_batch_size = meta["global_batch_size"]
     spec = make_model_spec(meta["sizes"], n_stages, global_batch_size)
@@ -177,3 +351,90 @@ def load_checkpoint(path, n_stages: int, global_batch_size=None, with_opt_state=
             "scalars": dict(meta.get("opt_scalars", {})),
         }
     return params_list, spec, meta, opt_state
+
+
+# ---------------------------------------------------------------------------
+# step-checkpoint directories: rotation + crash-recovery discovery
+# ---------------------------------------------------------------------------
+
+
+def step_checkpoint_path(ckpt_dir, global_step):
+    """Canonical name of the snapshot at ``global_step``: zero-padded so
+    lexical order == step order (``step-00000042.npz``)."""
+    return Path(ckpt_dir) / f"step-{int(global_step):08d}.npz"
+
+
+def list_step_checkpoints(ckpt_dir):
+    """``[(global_step, path), ...]`` ascending by step; [] for a missing
+    directory (a fresh run's ``--resume auto`` finds nothing, starts clean)."""
+    d = Path(ckpt_dir)
+    if not d.is_dir():
+        return []
+    out = []
+    for p in d.iterdir():
+        m = STEP_CHECKPOINT_RE.match(p.name)
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out)
+
+
+def rotate_step_checkpoints(ckpt_dir, keep, trusted=()):
+    """Delete all but ``keep`` step snapshots; returns the removed paths.
+    Retention is the corrupt-newest safety margin: fallback needs older
+    snapshots to still exist.
+
+    Ranking is usability-first, then step: a snapshot that fully verifies
+    (checksum intact, all values finite — exactly ``find_latest_good``'s
+    resume criteria) always outranks one that does not, regardless of step
+    number. A blown-up or bit-rotted run leaves high-step unusable
+    snapshots behind (a blow-up's own saves skip rotation — see
+    ``save_step_checkpoint``); ranked purely by step they would crowd the
+    healthy snapshots out of the keep window and rotation would delete the
+    only ``resume='auto'`` targets — permanently unrecoverable. Instead
+    the stale unusable pile is what rotation reclaims. Verification reads
+    each candidate once per rotation; a caller that just wrote (and
+    checksummed) snapshots in-process can list them in ``trusted`` to skip
+    re-reading them (``TrainingSession`` passes the paths it wrote finite
+    this run)."""
+    if keep < 1:
+        raise ValueError("keep must be >= 1")
+    snaps = list_step_checkpoints(ckpt_dir)
+    if len(snaps) <= keep:
+        return []
+    trusted = {Path(p).resolve() for p in trusted}
+
+    def rank(item):
+        step, path = item
+        if path.resolve() in trusted:
+            return (True, step)
+        try:
+            verify_checkpoint(path, require_finite=True)
+        except CheckpointError:
+            return (False, step)
+        return (True, step)
+
+    victims = [p for _, p in sorted(snaps, key=rank)[:-keep]]
+    for p in victims:
+        try:
+            p.unlink()
+        except OSError:
+            pass  # retention is best-effort; a stale extra snapshot is harmless
+    return victims
+
+
+def find_latest_good(ckpt_dir, require_finite=True):
+    """Crash-recovery discovery: walk the step snapshots NEWEST FIRST,
+    verify each (read + checksum + optional finiteness), and return
+    ``(path, meta, skipped)`` for the first one that verifies — ``skipped``
+    lists ``(path, cause)`` for every newer snapshot that failed (the
+    evidence the recovery record carries). Returns ``(None, None, skipped)``
+    when nothing in the directory verifies (or it is empty/missing)."""
+    skipped = []
+    for _, p in reversed(list_step_checkpoints(ckpt_dir)):
+        try:
+            meta = verify_checkpoint(p, require_finite=require_finite)
+        except CheckpointError as e:
+            skipped.append((p, e.cause))
+            continue
+        return p, meta, skipped
+    return None, None, skipped

@@ -1,0 +1,198 @@
+"""Deterministic fault injection: kill, poison and corrupt ON PURPOSE.
+
+The recovery contract ("crash at any step, resume, and the final weight hash
+is identical") is only worth claiming if something actually crashes real
+runs. This module is that something: a small set of injection points that
+tests, ``make recovery-smoke`` and ad-hoc debugging activate either through
+the API (``TrainingSession(faults=...)``) or the environment
+(``SHALLOWSPEED_FAULTS``, so a *subprocess* train.py can be killed without
+patching it).
+
+Spec grammar — comma-separated injections, each ``kind@step=N[:mode=...]``::
+
+    SHALLOWSPEED_FAULTS="die@step=7:mode=sigkill"     # hard kill at step 7
+    SHALLOWSPEED_FAULTS="die@step=7"                  # raise InjectedFault
+    SHALLOWSPEED_FAULTS="nan@step=3"                  # NaN into the gradients
+    SHALLOWSPEED_FAULTS="die@step=9,nan@step=3"       # compose
+
+Steps are GLOBAL optimizer-step indices (epoch * batches_per_epoch +
+step_in_epoch — the same cursor the step checkpoints store).
+
+Injection points (all driven from the host-side step loop, never from
+inside a jitted program — an instrumented run executes the same XLA):
+
+- ``die``   fire when the run reaches step N, BEFORE step N's update:
+            ``mode=exc`` (default) raises ``InjectedFault``; ``mode=sigkill``
+            sends SIGKILL to the current process — the real preemption
+            shape, nothing flushes, no atexit runs.
+- ``nan``   poison the parameters right before step N dispatches, so step
+            N's gradients (and loss) come out NaN — the deterministic
+            blow-up the numerics health monitor exists to catch.
+
+Checkpoint corruption is a function, not a step trigger (tests corrupt
+files directly): ``corrupt_checkpoint_bytes(path)`` flips bytes inside an
+existing checkpoint so its content checksum can no longer verify —
+deterministic given ``seed``.
+"""
+
+import os
+import signal
+
+import numpy as np
+
+ENV_VAR = "SHALLOWSPEED_FAULTS"
+KINDS = ("die", "nan")
+DIE_MODES = ("exc", "sigkill")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a ``die`` injection with ``mode=exc`` (the soft kill)."""
+
+
+class Fault:
+    """One parsed injection: ``kind`` at global ``step`` (+ ``mode``)."""
+
+    __slots__ = ("kind", "step", "mode", "fired")
+
+    def __init__(self, kind, step, mode=None):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} (have {KINDS})")
+        if step < 0:
+            raise ValueError(f"fault step must be >= 0, got {step}")
+        if kind == "die":
+            mode = mode or "exc"
+            if mode not in DIE_MODES:
+                raise ValueError(
+                    f"die mode must be one of {DIE_MODES}, got {mode!r}"
+                )
+        elif mode is not None:
+            raise ValueError(f"fault kind {kind!r} takes no mode")
+        self.kind = kind
+        self.step = int(step)
+        self.mode = mode
+        self.fired = False
+
+    def __repr__(self):
+        mode = f":mode={self.mode}" if self.kind == "die" else ""
+        return f"{self.kind}@step={self.step}{mode}"
+
+
+class FaultPlan:
+    """The active injections of one run; consulted at step boundaries."""
+
+    def __init__(self, faults=()):
+        self.faults = list(faults)
+
+    @classmethod
+    def parse(cls, spec):
+        """Parse the spec grammar (see module docstring). ``None``/empty ->
+        an empty plan; malformed specs raise ValueError naming the part."""
+        faults = []
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                kind, _, rest = part.partition("@")
+                fields = dict(
+                    kv.split("=", 1) for kv in rest.split(":") if kv
+                )
+                faults.append(
+                    Fault(
+                        kind.strip(),
+                        int(fields.pop("step")),
+                        mode=fields.pop("mode", None),
+                    )
+                )
+                if fields:
+                    raise ValueError(f"unknown fields {sorted(fields)}")
+            except (KeyError, ValueError) as e:
+                raise ValueError(f"bad fault spec {part!r}: {e}") from None
+        return cls(faults)
+
+    def __bool__(self):
+        return bool(self.faults)
+
+    @property
+    def pending(self):
+        """Injections that have not fired yet — non-empty means the run
+        still needs step boundaries (``train_steps``) for them to land."""
+        return [f for f in self.faults if not f.fired]
+
+    def first_in(self, lo, hi):
+        """Earliest un-fired fault with ``lo <= step < hi``, or None — the
+        step loop truncates its dispatch chunks at this boundary so every
+        injection lands exactly on its step."""
+        pending = [f for f in self.faults if not f.fired and lo <= f.step < hi]
+        return min(pending, key=lambda f: f.step) if pending else None
+
+    def fire_die(self, fault):
+        """Execute a ``die`` fault: SIGKILL the process (nothing flushes —
+        the honest preemption) or raise InjectedFault."""
+        fault.fired = True
+        if fault.mode == "sigkill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        raise InjectedFault(f"injected fault: {fault!r}")
+
+
+def from_env(environ=None):
+    """The plan configured in ``SHALLOWSPEED_FAULTS`` (empty when unset)."""
+    return FaultPlan.parse((environ or os.environ).get(ENV_VAR, ""))
+
+
+def make_plan(faults):
+    """Normalize the ``faults=`` argument surface: None -> the env plan,
+    a spec string -> parsed, a FaultPlan -> itself."""
+    if faults is None:
+        return from_env()
+    if isinstance(faults, FaultPlan):
+        return faults
+    return FaultPlan.parse(faults)
+
+
+def poison_nan(params):
+    """The ``nan`` injection body: return ``params`` with one element of the
+    first weight matrix set to NaN (host-side tree surgery — the poisoned
+    value flows into the next step's forward, so that step's loss and every
+    gradient behind it are NaN). Works on both layouts' param trees."""
+    import jax
+    import jax.numpy as jnp
+
+    poisoned = [False]
+
+    def poison(x):
+        if poisoned[0] or not hasattr(x, "shape") or x.ndim < 1 or x.size == 0:
+            return x
+        poisoned[0] = True
+        flat = jnp.ravel(jnp.asarray(x)).at[0].set(jnp.nan)
+        return flat.reshape(x.shape).astype(x.dtype)
+
+    out = jax.tree.map(poison, params)
+    if not poisoned[0]:
+        raise ValueError("no array leaf to poison in params")
+    return out
+
+
+def corrupt_checkpoint_bytes(path, nbytes=16, seed=0):
+    """Deterministically flip ``nbytes`` bytes in the middle of ``path`` —
+    past the zip local-file header so the file still LOOKS like a .npz and
+    only the content checksum (or the array parse) can catch it. Returns
+    the byte offsets touched (for test assertions)."""
+    path = os.fspath(path)
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"{path} is empty — nothing to corrupt")
+    rng = np.random.RandomState(seed)
+    # keep clear of the first 64 bytes (zip magic) when the file allows it
+    lo = min(64, size - 1)
+    offsets = sorted(
+        int(o) for o in rng.choice(range(lo, size), size=min(nbytes, size - lo),
+                                   replace=False)
+    )
+    with open(path, "r+b") as f:
+        for off in offsets:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+    return offsets

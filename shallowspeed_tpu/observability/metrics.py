@@ -40,6 +40,12 @@ Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
     {"v": 3, "ts": ..., "kind": "xla_audit", "name": <program>,
      "census": {...}, "memory": {...}, "expected": {...},
      "census_ok": bool|null, **audit}                                [v3+]
+    {"v": 4, "ts": ..., "kind": "checkpoint", "name": <reason>,
+     "path": ..., "epoch": e, "step_in_epoch": s, "global_step": g,
+     "bytes": n, "wall_s": ...}                                      [v4+]
+    {"v": 4, "ts": ..., "kind": "recovery",  "name": <verdict>,
+     "resumed_from": path|null, "epoch": e, "step_in_epoch": s,
+     "global_step": g, "skipped": [...], **fields}                   [v4+]
 
 Schema compatibility rules (SCHEMA_VERSION history):
 
@@ -55,6 +61,13 @@ Schema compatibility rules (SCHEMA_VERSION history):
   time — observability/program_audit.py). Again no existing kind or
   field changed meaning, so the v3 reader accepts v1 AND v2 files
   unchanged and the strict refusal stays one-directional.
+- v4  ADDITIVE: the ``checkpoint`` (one step/epoch/halt snapshot write,
+  named by its reason, carrying the step cursor + bytes + wall clock)
+  and ``recovery`` (one resume decision, named by its verdict —
+  ``resumed``/``fresh_start`` — carrying what was restored and every
+  corrupt snapshot skipped on the way) kinds, the evidence stream behind
+  the report CLI's Reliability section. No existing kind or field
+  changed meaning; the v4 reader accepts v1–v3 files unchanged.
 
 The contract for future bumps: additive kinds/fields bump the version and
 must keep old records readable; any change to an EXISTING kind's meaning
@@ -79,7 +92,7 @@ import time
 
 from shallowspeed_tpu.observability.spans import Span
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 SCHEMA_NAME = "shallowspeed_tpu.metrics"
 
 
@@ -131,6 +144,12 @@ class NullMetrics:
         pass
 
     def audit(self, name, **fields):
+        pass
+
+    def checkpoint(self, name, **fields):
+        pass
+
+    def recovery(self, name, **fields):
         pass
 
     def flush(self):
@@ -208,6 +227,12 @@ class MetricsRecorder:
 
     def audit(self, name, **fields):
         self._emit({"kind": "xla_audit", "name": name, **fields})
+
+    def checkpoint(self, name, **fields):
+        self._emit({"kind": "checkpoint", "name": name, **fields})
+
+    def recovery(self, name, **fields):
+        self._emit({"kind": "recovery", "name": name, **fields})
 
     # -- recorder-internal hooks --------------------------------------------
 

@@ -33,7 +33,14 @@ human or a bench gate actually asks of a run:
   FLOP-weighted (the weighted row is what moves under ``--backward-split``:
   deferred B-weights pack into bubble ticks, see docs/lowering.md);
 - a step-loss sparkline from the flight-recorder ``step`` records;
-- the numerics health verdict (ok / N findings / halted-at-step).
+- the numerics health verdict (ok / N findings / halted-at-step);
+- a RELIABILITY section (schema-v4 ``checkpoint``/``recovery`` records):
+  checkpoint count + cadence + the overhead fraction (checkpoint wall
+  over checkpoint + train-dispatch wall), and the recovery verdict —
+  what was restored, every corrupt snapshot skipped, and the steps lost
+  to replay when the stream holds the killed run's step records (feed
+  the killed run's JSONL and the resumed run's concatenated, as
+  ``make recovery-smoke`` does, and the loss is measured, not guessed).
 
 ``--baseline`` compares throughput against another run's JSONL or a
 bench-style JSON record (``{"value": ..., "unit": "samples/s"}``, or a
@@ -209,6 +216,7 @@ def build_report(records, source="", trace=None):
         accuracy = gauges.get("val_accuracy")
 
     overlap = _overlap_info(audit, trace)
+    reliability = _reliability_info(records, spans)
 
     return {
         "source": source,
@@ -248,6 +256,79 @@ def build_report(records, source="", trace=None):
             "by_check": by_check,
             "halted": bool(halted),
         },
+        "reliability": reliability,
+    }
+
+
+def _reliability_info(records, spans):
+    """Fold the schema-v4 ``checkpoint``/``recovery`` records into the
+    Reliability story; None when the run recorded neither (the section is
+    then omitted — pre-v4 files render exactly as before).
+
+    ``steps lost to replay`` is measured from EVIDENCE, never guessed: it
+    needs the killed run's ``step`` records in the same stream before the
+    recovery record (concatenate killed + resumed JSONL), and is the gap
+    between the last step the dead run trained and the step the restore
+    landed on. Without that evidence the field stays None (rendered as
+    unknown)."""
+    ckpts = [r for r in records if r.get("kind") == "checkpoint"]
+    recoveries = []
+    max_step_before = None
+    last_step = None
+    for r in records:
+        if r.get("kind") == "step" and isinstance(r.get("step"), (int, float)):
+            last_step = max(last_step or 0, int(r["step"]))
+        elif r.get("kind") == "recovery":
+            recoveries.append(r)
+            max_step_before = last_step
+    if not ckpts and not recoveries:
+        return None
+    ckpt_wall = sum(r["wall_s"] for r in ckpts if _finite(r.get("wall_s")))
+    train_wall = sum(
+        a["total_s"]
+        for n, a in spans.items()
+        if n in ("train_epoch", "train_steps", "train_run")
+    )
+    overhead = (
+        ckpt_wall / (ckpt_wall + train_wall)
+        if (ckpt_wall + train_wall) > 0
+        else None
+    )
+    gsteps = sorted(
+        int(r["global_step"]) for r in ckpts
+        if isinstance(r.get("global_step"), (int, float))
+    )
+    cadence = None
+    if len(gsteps) >= 2:
+        deltas = [b - a for a, b in zip(gsteps, gsteps[1:])]
+        cadence = _median(deltas)
+    recovery = None
+    if recoveries:
+        rec = recoveries[-1]  # the decision that produced THIS run's state
+        steps_lost = None
+        resumed_at = rec.get("global_step")
+        if isinstance(resumed_at, (int, float)) and max_step_before is not None:
+            # the killed run's evidence IS in this stream — a kill that
+            # landed exactly on a checkpointed step is a measured 0, not
+            # unknown (clamped: a snapshot ahead of the step evidence can
+            # never make the loss negative)
+            steps_lost = max(0, int(max_step_before + 1 - resumed_at))
+        recovery = {
+            "verdict": rec.get("name"),
+            "resumed_from": rec.get("resumed_from"),
+            "epoch": rec.get("epoch"),
+            "step_in_epoch": rec.get("step_in_epoch"),
+            "global_step": resumed_at,
+            "skipped": rec.get("skipped") or [],
+            "steps_lost_to_replay": steps_lost,
+        }
+    return {
+        "checkpoints": len(ckpts),
+        "checkpoint_wall_s": round(ckpt_wall, 4),
+        "checkpoint_overhead_fraction": overhead,
+        "checkpoint_cadence_steps": cadence,
+        "last_checkpoint_bytes": ckpts[-1].get("bytes") if ckpts else None,
+        "recovery": recovery,
     }
 
 
@@ -552,6 +633,56 @@ def _comms_lines(audit, md):
     return lines
 
 
+def _reliability_lines(rel, md):
+    """The Reliability section: checkpoint overhead, cadence, and the
+    recovery verdict with its evidence (skipped snapshots, replay loss)."""
+    if not rel:
+        return []
+    lines = ["## Reliability" if md else "reliability:"]
+    if rel["checkpoints"]:
+        line = (
+            f"checkpoints: {rel['checkpoints']} written "
+            f"({_fmt_time_s(rel['checkpoint_wall_s'])} total"
+        )
+        if rel.get("checkpoint_overhead_fraction") is not None:
+            line += (
+                f" — {rel['checkpoint_overhead_fraction'] * 100:.1f}% "
+                f"overhead vs train dispatch"
+            )
+        line += ")"
+        if rel.get("checkpoint_cadence_steps") is not None:
+            line += f", every ~{rel['checkpoint_cadence_steps']:.0f} steps"
+        if rel.get("last_checkpoint_bytes") is not None:
+            line += f", {format_bytes(rel['last_checkpoint_bytes'])} each"
+        lines.append(line)
+    rec = rel.get("recovery")
+    if rec is not None:
+        if rec["verdict"] == "resumed":
+            where = f"epoch {rec.get('epoch')}, step {rec.get('step_in_epoch')}"
+            line = (
+                f"recovery: resumed from {rec.get('resumed_from')} at {where} "
+                f"(global step {rec.get('global_step')})"
+            )
+        else:
+            line = "recovery: fresh start (no resumable snapshot found)"
+        if rec["skipped"]:
+            line += f"; {len(rec['skipped'])} corrupt snapshot(s) skipped"
+        lines.append(line)
+        for s in rec["skipped"]:
+            lines.append(f"  skipped {s.get('path')}: {s.get('cause')}")
+        lost = rec.get("steps_lost_to_replay")
+        lines.append(
+            f"steps lost to replay: "
+            + (
+                f"{lost} (re-trained after restore — bit-identical by contract)"
+                if lost is not None
+                else "unknown (killed run's step records not in this stream)"
+            )
+        )
+    lines.append("")
+    return lines
+
+
 def render(report, fmt, comparison=None):
     if fmt == "json":
         out = dict(report)
@@ -575,6 +706,7 @@ def render(report, fmt, comparison=None):
     lines.append("")
     lines.extend(_memory_lines(report.get("xla_audit"), md))
     lines.extend(_comms_lines(report.get("xla_audit"), md))
+    lines.extend(_reliability_lines(report.get("reliability"), md))
     header = "## Span breakdown" if md else "span breakdown:"
     lines.append(header)
     if report["spans"]:

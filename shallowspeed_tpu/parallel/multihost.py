@@ -50,6 +50,26 @@ def _distributed_is_initialized() -> bool:
     return getattr(global_state, "client", None) is not None
 
 
+def _reset_half_initialized_state():
+    """Best-effort teardown after a FAILED ``jax.distributed.initialize``
+    so a retried join starts clean. ``jax.distributed.shutdown()`` is the
+    public path, but it can itself raise on a never-connected client (and
+    then leaves ``global_state.client`` set), so fall back to nulling the
+    state fields directly — the same fields ``State.shutdown`` nulls."""
+    try:
+        jax.distributed.shutdown()
+        return
+    except Exception:
+        pass
+    try:
+        from jax._src.distributed import global_state
+    except ImportError:  # pragma: no cover - no private state to clear
+        return
+    for field in ("client", "service", "preemption_sync_manager"):
+        if hasattr(global_state, field):
+            setattr(global_state, field, None)
+
+
 def initialize(coordinator_address=None, num_processes=None, process_id=None):
     """Join the global JAX runtime; must run BEFORE any other JAX call that
     initializes a backend (jax.devices(), first jit, ...). No-op when the
@@ -58,7 +78,11 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
 
     On TPU pods all three arguments are inferred from the environment
     (``jax.distributed.initialize()`` with no args); pass them explicitly for
-    CPU/GPU clusters.
+    CPU/GPU clusters. With an EXPLICIT coordinator the join is retried with
+    the shared bounded backoff (shallowspeed_tpu.retry): on real clusters
+    the coordinator process races the workers up, and a worker that dials a
+    not-yet-listening coordinator should wait out the race, not crash the
+    fleet.
     """
     # NOTE: deliberately no jax.devices()/process_count() probe here — those
     # initialize the XLA backend and would make distributed init impossible.
@@ -71,12 +95,36 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
             num_processes=num_processes,
             process_id=process_id,
         )
+    def _join_once():
+        # a failed connect leaves jax's global_state.client assigned (it is
+        # set BEFORE the connect that can fail), and a second initialize
+        # would then refuse with "should only be called once" — masking the
+        # real error and defeating the retry. Tear the half-initialized
+        # state down before re-raising so every retry is a fresh join.
+        try:
+            jax.distributed.initialize(**kwargs)
+        except BaseException:
+            _reset_half_initialized_state()
+            raise
+
     try:
-        jax.distributed.initialize(**kwargs)
+        if coordinator_address is not None:
+            from shallowspeed_tpu import retry
+
+            retry.retry_call(
+                _join_once,
+                attempts=4,
+                base=0.5,
+                max_delay=10.0,
+                retry_on=(RuntimeError, ConnectionError, OSError),
+            )
+        else:
+            jax.distributed.initialize(**kwargs)
     except (ValueError, RuntimeError) as e:
         # no coordinator given and none configured in the environment:
         # a plain single-process run — fine. Explicit args must not fail
-        # silently, and the cause stays in the log either way.
+        # silently (the retry budget above is already spent), and the cause
+        # stays in the log either way.
         if coordinator_address is not None:
             raise
         import logging

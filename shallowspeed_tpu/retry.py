@@ -1,0 +1,136 @@
+"""Bounded exponential-backoff retry: the ONE retry policy for flaky host I/O.
+
+Three consumers share this module so their retry behaviour can never drift:
+
+- checkpoint writes (``checkpoint.save_checkpoint`` retries the atomic
+  tmp-write + rename on transient ``OSError`` — a preemption-safe step
+  checkpoint that dies to one flaky NFS write defeats its purpose);
+- distributed init (``parallel.multihost.initialize`` with an EXPLICIT
+  coordinator retries the join — the coordinator process races the workers
+  up on real clusters);
+- the TPU tunnel tooling (``scripts/tunnel_watch.sh`` asks the CLI below for
+  its probe schedule; ``bench._ensure_responsive_backend`` — which
+  ``scripts/tpu_capture.py`` fronts — sleeps ``backoff_delay`` between
+  probes). The motivating incident: the tunnel watcher hammered a dead
+  tunnel on a fixed 10-minute cadence for 48 consecutive probes; bounded
+  growth + jitter probes often early and rarely late instead.
+
+Policy: delay for attempt ``i`` (0-based, i.e. before retry ``i+1``) is
+``min(base * factor**i, max_delay)`` plus uniform jitter in
+``[-jitter, +jitter] * delay``. Jitter is DETERMINISTIC given ``seed`` —
+everything in this repo that can replay must replay (the same property the
+checkpoints guarantee), and the tests pin the schedule.
+
+CLI (for shell consumers — prints one delay per line, in seconds)::
+
+    python -m shallowspeed_tpu.retry --attempts 8 --base 60 --max 1200
+"""
+
+import argparse
+import random
+import sys
+import time
+
+
+def backoff_delay(
+    attempt, base=1.0, factor=2.0, max_delay=60.0, jitter=0.1, seed=None
+):
+    """Delay in seconds before retry ``attempt + 1`` (attempt is 0-based).
+
+    Exponential growth capped at ``max_delay``, with deterministic uniform
+    jitter of ±``jitter`` (a fraction of the delay) drawn from a string
+    seed over (seed, attempt) — the same pair always produces the same
+    delay (independent of PYTHONHASHSEED), so schedules are reproducible
+    and testable. ``jitter=0`` disables it. Never returns a negative delay.
+    """
+    if attempt < 0:
+        raise ValueError("attempt must be >= 0")
+    if base < 0 or factor < 1.0 or max_delay < 0:
+        raise ValueError("need base >= 0, factor >= 1, max_delay >= 0")
+    if not 0 <= jitter < 1:
+        raise ValueError("jitter must be in [0, 1)")
+    delay = min(base * factor**attempt, max_delay)
+    if jitter:
+        rng = random.Random(f"{seed}:{attempt}")
+        delay *= 1.0 + rng.uniform(-jitter, jitter)
+    return max(0.0, delay)
+
+
+def backoff_delays(attempts, **kwargs):
+    """The full schedule: ``[backoff_delay(0), ..., backoff_delay(n-1)]``."""
+    return [backoff_delay(i, **kwargs) for i in range(attempts)]
+
+
+def retry_call(
+    fn,
+    *,
+    attempts=3,
+    base=0.1,
+    factor=2.0,
+    max_delay=5.0,
+    jitter=0.1,
+    seed=None,
+    retry_on=(OSError,),
+    on_retry=None,
+    sleep=time.sleep,
+):
+    """Call ``fn()`` with bounded exponential-backoff retries.
+
+    Retries only on exception types in ``retry_on`` (everything else —
+    including the final failing attempt — propagates unwrapped, so callers'
+    existing except clauses keep working). ``on_retry(attempt, exc, delay)``
+    is the observability hook (attempt is 0-based); ``sleep`` is injectable
+    for tests. ``attempts`` is the TOTAL call budget (>= 1), so the worst
+    case is strictly bounded: ``attempts`` calls and ``attempts - 1`` sleeps.
+    """
+    if attempts < 1:
+        raise ValueError("attempts must be >= 1")
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt == attempts - 1:
+                raise
+            delay = backoff_delay(
+                attempt, base=base, factor=factor, max_delay=max_delay,
+                jitter=jitter, seed=seed,
+            )
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            sleep(delay)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m shallowspeed_tpu.retry",
+        description="Print a bounded exponential-backoff schedule, one delay "
+        "(integer seconds) per line — for shell consumers like "
+        "scripts/tunnel_watch.sh.",
+    )
+    ap.add_argument("--attempts", type=int, default=8)
+    ap.add_argument("--base", type=float, default=1.0)
+    ap.add_argument("--factor", type=float, default=2.0)
+    ap.add_argument("--max", dest="max_delay", type=float, default=60.0)
+    ap.add_argument("--jitter", type=float, default=0.1)
+    ap.add_argument(
+        "--seed",
+        type=int,
+        default=0,
+        help="jitter seed (schedules are deterministic per seed)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        delays = backoff_delays(
+            args.attempts, base=args.base, factor=args.factor,
+            max_delay=args.max_delay, jitter=args.jitter, seed=args.seed,
+        )
+    except ValueError as e:
+        print(f"retry: {e}", file=sys.stderr)
+        return 1
+    for d in delays:
+        print(int(round(d)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

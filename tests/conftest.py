@@ -42,3 +42,12 @@ if _jax_version >= (0, 5):
     jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: the marker gates tests whose coverage is
+    # duplicated by a Makefile smoke target (e.g. the CLI SIGKILL round
+    # trip, recovery-smoke's in-suite twin) out of the bounded gate
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (-m 'not slow')"
+    )

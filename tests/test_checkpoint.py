@@ -1,8 +1,12 @@
-"""Checkpoint tests: round-trip fidelity + cross-layout resume.
+"""Checkpoint tests: round-trip fidelity, cross-layout resume, and the
+format-v2 fault-tolerance surface.
 
 The design property under test: a checkpoint stores logical per-layer blocks
 in global layer order, so save-from-one-layout / resume-into-another is exact
-(the reference framework has no checkpointing at all, SURVEY §5.4).
+(the reference framework has no checkpointing at all, SURVEY §5.4). Format
+v2 (docs/robustness.md) adds the step cursor, the content checksum that
+detects torn/corrupted files, rotating step-snapshot retention, and
+newest-first crash-recovery discovery that falls back past corrupt files.
 """
 
 import jax
@@ -10,10 +14,21 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from shallowspeed_tpu import checkpoint as C
+from shallowspeed_tpu import faults
 from shallowspeed_tpu import model as Mo
 from shallowspeed_tpu import schedules as S
 from shallowspeed_tpu import trainer
-from shallowspeed_tpu.checkpoint import load_checkpoint, save_checkpoint
+from shallowspeed_tpu.checkpoint import (
+    CheckpointError,
+    find_latest_good,
+    list_step_checkpoints,
+    load_checkpoint,
+    rotate_step_checkpoints,
+    save_checkpoint,
+    step_checkpoint_path,
+    verify_checkpoint,
+)
 from shallowspeed_tpu.optimizer import SGD
 from shallowspeed_tpu.parallel import executor as E
 from shallowspeed_tpu.parallel import lower_schedule, make_mesh
@@ -127,3 +142,232 @@ def test_wrong_stage_count_shape_check(tmp_path):
     save_checkpoint(p, params, spec, epoch=0)
     with pytest.raises(ValueError):
         load_checkpoint(p, 3)  # 8 sizes not divisible by 3 stages
+
+
+# ---------------------------------------------------------------------------
+# format v2: error surface, checksum, step cursor, rotation, discovery
+# ---------------------------------------------------------------------------
+
+
+def _params_and_spec():
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    return jax.tree.map(jnp.asarray, Mo.init_model(spec)), spec
+
+
+def test_save_failure_never_leaks_a_temp_file(tmp_path, monkeypatch):
+    """The mid-stream-failure satellite: an exception between mkstemp and
+    the atomic rename must remove the attempt's temp file, whether the
+    failure is terminal (non-retried) or exhausts the retry budget."""
+    params, spec = _params_and_spec()
+    p = tmp_path / "ck.npz"
+
+    def boom(*a, **kw):
+        raise RuntimeError("disk detached mid-write")
+
+    monkeypatch.setattr(C.np, "savez", boom)
+    with pytest.raises(RuntimeError, match="mid-write"):
+        save_checkpoint(p, params, spec, epoch=0)
+    assert not p.exists()
+    assert list(tmp_path.iterdir()) == []  # no *.npz.tmp beside the target
+
+    # transient OSError: retried with bounded backoff, then the leak-free
+    # guarantee still holds when the budget is exhausted
+    calls = []
+
+    def flaky(*a, **kw):
+        calls.append(1)
+        raise OSError("NFS hiccup")
+
+    monkeypatch.setattr(C.np, "savez", flaky)
+    monkeypatch.setattr(C.retry.time, "sleep", lambda s: None)
+    with pytest.raises(OSError, match="NFS"):
+        save_checkpoint(p, params, spec, epoch=0)
+    assert len(calls) == 3  # the bounded retry budget, not one attempt
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_save_retries_transient_oserror_then_succeeds(tmp_path, monkeypatch):
+    params, spec = _params_and_spec()
+    p = tmp_path / "ck.npz"
+    real_savez = np.savez
+    attempts = []
+
+    def flaky_then_ok(f, **arrays):
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        real_savez(f, **arrays)
+
+    monkeypatch.setattr(C.np, "savez", flaky_then_ok)
+    monkeypatch.setattr(C.retry.time, "sleep", lambda s: None)
+    nbytes, finite = save_checkpoint(p, params, spec, epoch=2)
+    assert len(attempts) == 3
+    assert nbytes == p.stat().st_size > 0
+    assert finite is True  # healthy params: the retention-gate flag
+    assert verify_checkpoint(p)["epoch"] == 2
+    assert list(tmp_path.glob("*.tmp")) == []
+
+
+def test_load_corrupt_files_raise_checkpoint_error(tmp_path):
+    """The loader-satellite contract: truncated, zero-byte, wrong-format
+    and missing files all surface as CheckpointError naming the path and
+    the suspected cause — never a raw NumPy/zipfile traceback."""
+    params, spec = _params_and_spec()
+    good = tmp_path / "good.npz"
+    save_checkpoint(good, params, spec, epoch=0)
+
+    zero = tmp_path / "zero.npz"
+    zero.touch()
+    with pytest.raises(CheckpointError, match=r"zero\.npz.*zero bytes"):
+        load_checkpoint(zero, 1)
+
+    truncated = tmp_path / "trunc.npz"
+    truncated.write_bytes(good.read_bytes()[: good.stat().st_size // 2])
+    with pytest.raises(CheckpointError, match=r"trunc\.npz.*truncated|corrupt"):
+        load_checkpoint(truncated, 1)
+
+    wrong = tmp_path / "wrong.npz"
+    wrong.write_text("just some text, not a zip archive\n")
+    with pytest.raises(CheckpointError, match=r"wrong\.npz"):
+        load_checkpoint(wrong, 1)
+
+    with pytest.raises(CheckpointError, match="cannot stat"):
+        load_checkpoint(tmp_path / "missing.npz", 1)
+
+    # a foreign .npz (no metadata blob) is named as such
+    foreign = tmp_path / "foreign.npz"
+    np.savez(foreign, x=np.zeros(3))
+    with pytest.raises(CheckpointError, match="no metadata blob"):
+        load_checkpoint(foreign, 1)
+
+
+def test_checksum_detects_bitflips(tmp_path):
+    """The content checksum catches silent corruption the zip layer passes
+    through — injected with the fault harness's deterministic byte
+    flipper, which stays clear of the archive magic on purpose."""
+    params, spec = _params_and_spec()
+    p = tmp_path / "ck.npz"
+    save_checkpoint(p, params, spec, epoch=0)
+    verify_checkpoint(p)  # pristine file verifies
+    offsets = faults.corrupt_checkpoint_bytes(p, nbytes=8, seed=1)
+    assert offsets and all(o >= 64 for o in offsets)
+    with pytest.raises(CheckpointError) as ei:
+        verify_checkpoint(p)
+    assert "ck.npz" in str(ei.value)
+
+
+def test_step_cursor_round_trip_and_finiteness_flag(tmp_path):
+    """v2 metadata: the step cursor survives the round trip, and a snapshot
+    holding non-finite values is flagged at save time and rejected by
+    require_finite verification (the halt-flush discovery filter)."""
+    params, spec = _params_and_spec()
+    p = tmp_path / "ck.npz"
+    save_checkpoint(p, params, spec, epoch=3, step_in_epoch=5, global_step=29)
+    meta = verify_checkpoint(p, require_finite=True)
+    assert meta["epoch"] == 3
+    assert meta["step_in_epoch"] == 5 and meta["global_step"] == 29
+    assert meta["all_finite"] is True
+
+    bad = [
+        [{"W": np.asarray(l["W"]).copy(), "b": np.asarray(l["b"]).copy()}
+         for l in s]
+        for s in params
+    ]
+    bad[0][0]["W"][0, 0] = np.nan
+    pb = tmp_path / "blown.npz"
+    save_checkpoint(pb, bad, spec, epoch=3, step_in_epoch=6, global_step=30)
+    assert verify_checkpoint(pb)["all_finite"] is False  # checksum still ok
+    with pytest.raises(CheckpointError, match="non-finite"):
+        verify_checkpoint(pb, require_finite=True)
+
+
+def test_rotation_keeps_newest_k(tmp_path):
+    params, spec = _params_and_spec()
+    for gs in (4, 8, 12, 16):
+        save_checkpoint(
+            step_checkpoint_path(tmp_path, gs), params, spec,
+            epoch=gs // 8, step_in_epoch=gs % 8, global_step=gs,
+        )
+    removed = rotate_step_checkpoints(tmp_path, keep=2)
+    assert sorted(p.name for p in removed) == [
+        "step-00000004.npz", "step-00000008.npz"
+    ]
+    assert [gs for gs, _ in list_step_checkpoints(tmp_path)] == [12, 16]
+    with pytest.raises(ValueError):
+        rotate_step_checkpoints(tmp_path, keep=0)
+
+
+def test_rotation_finite_snapshots_outrank_stale_nonfinite_pile(tmp_path):
+    """The blown-up-run recovery hazard: a run that diverged without a halt
+    leaves high-step non-finite snapshots behind (its own saves skip
+    rotation); after resuming from the last healthy snapshot, the fresh
+    FINITE snapshots land at lower step numbers than the stale pile. Pure
+    step-ranked rotation would keep only the non-finite pile — exactly the
+    snapshots resume='auto' skips — so rotation must rank finite first."""
+    params, spec = _params_and_spec()
+    bad = [
+        [{"W": np.asarray(l["W"]).copy(), "b": np.asarray(l["b"]).copy()}
+         for l in s]
+        for s in params
+    ]
+    bad[0][0]["W"][0, 0] = np.nan
+    # healthy step 4, then the dead run's non-finite grid at 8..20
+    save_checkpoint(step_checkpoint_path(tmp_path, 4), params, spec,
+                    epoch=0, step_in_epoch=4, global_step=4)
+    for gs in (8, 12, 16, 20):
+        save_checkpoint(step_checkpoint_path(tmp_path, gs), bad, spec,
+                        epoch=0, step_in_epoch=gs, global_step=gs)
+    # the resumed run writes a fresh finite snapshot at step 8 (overwriting
+    # the stale one) and rotation fires with keep=3
+    save_checkpoint(step_checkpoint_path(tmp_path, 8), params, spec,
+                    epoch=0, step_in_epoch=8, global_step=8)
+    rotate_step_checkpoints(tmp_path, keep=3)
+    kept = list_step_checkpoints(tmp_path)
+    assert [gs for gs, _ in kept] == [4, 8, 20]  # both finite + newest stale
+    path, meta, _ = find_latest_good(tmp_path)
+    assert meta["global_step"] == 8  # recovery target survived rotation
+
+
+def test_rotation_checksum_corrupt_snapshot_cannot_evict_good(tmp_path):
+    """The corruption flavor of the crowd-out hazard: a bit-rotted
+    high-step snapshot whose zip structure (and meta member) may survive
+    must not outrank a verifying one — rotation ranks by the FULL resume
+    criteria (checksum + finiteness), not by metadata alone."""
+    params, spec = _params_and_spec()
+    for gs in (8, 20):
+        save_checkpoint(step_checkpoint_path(tmp_path, gs), params, spec,
+                        epoch=0, step_in_epoch=gs, global_step=gs)
+    faults.corrupt_checkpoint_bytes(step_checkpoint_path(tmp_path, 20))
+    removed = rotate_step_checkpoints(tmp_path, keep=1)
+    assert [p.name for p in removed] == ["step-00000020.npz"]
+    path, meta, _ = find_latest_good(tmp_path)
+    assert meta["global_step"] == 8  # the only usable snapshot survived
+
+
+def test_corrupt_newest_falls_back_to_previous_good(tmp_path):
+    """The acceptance criterion: discovery walks newest-first, detects the
+    corrupted newest snapshot via its checksum, and lands on the previous
+    good one — reporting the skip with its cause."""
+    params, spec = _params_and_spec()
+    for gs in (4, 8, 12):
+        save_checkpoint(
+            step_checkpoint_path(tmp_path, gs), params, spec,
+            epoch=0, step_in_epoch=gs, global_step=gs,
+        )
+    newest = step_checkpoint_path(tmp_path, 12)
+    faults.corrupt_checkpoint_bytes(newest, nbytes=8, seed=3)
+    path, meta, skipped = find_latest_good(tmp_path)
+    assert path == step_checkpoint_path(tmp_path, 8)
+    assert meta["global_step"] == 8
+    assert [p for p, _ in skipped] == [newest]
+    assert skipped[0][1]  # a human-readable cause rides along
+
+    # empty / missing directory: a fresh start, not an error
+    assert find_latest_good(tmp_path / "nope") == (None, None, [])
+    # nothing verifies: (None, None, every-candidate-with-cause)
+    for gs in (4, 8):
+        faults.corrupt_checkpoint_bytes(
+            step_checkpoint_path(tmp_path, gs), nbytes=8, seed=gs
+        )
+    path, meta, skipped = find_latest_good(tmp_path)
+    assert path is None and meta is None and len(skipped) == 3

@@ -32,12 +32,12 @@ def tiny_data(tmp_path_factory):
     return d
 
 
-def _run(args, data_dir, extra_env=None):
+def _run_raw(args, data_dir, extra_env=None):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU tunnel in tests
     env["JAX_PLATFORMS"] = "cpu"
     env.update(extra_env or {})
-    r = subprocess.run(
+    return subprocess.run(
         [sys.executable, str(ROOT / "train.py"), "--data-dir", str(data_dir), *args],
         capture_output=True,
         text=True,
@@ -45,6 +45,10 @@ def _run(args, data_dir, extra_env=None):
         cwd=ROOT,
         env=env,
     )
+
+
+def _run(args, data_dir, extra_env=None):
+    r = _run_raw(args, data_dir, extra_env=extra_env)
     assert r.returncode == 0, r.stderr[-2000:]
     return r.stdout
 
@@ -254,3 +258,170 @@ def test_sequential_cli_run_kernel_matches_fused(tiny_data):
         k: _re.findall(r"mean train loss: ([0-9.]+)", v) for k, v in outs.items()
     }
     assert losses[False] == losses[True] and len(losses[True]) == 2
+
+
+# ---------------------------------------------------------------------------
+# fault-tolerance CLI contracts (docs/robustness.md)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_run_checkpoint_contract(tiny_data, tmp_path):
+    """The pinned --checkpoint x --fused-run contract: the fused run is ONE
+    dispatch, so --checkpoint saves exactly once, after it returns — and
+    the STEP-checkpoint flags (which need a host step boundary) fail fast
+    at argparse time with a message naming the conflict."""
+    ck = tmp_path / "fused.npz"
+    out = _run(
+        ["--epochs", "2", "--global-batch-size", "32", "--mubatches", "2",
+         "--no-eval", "--fused-run", "--checkpoint", str(ck)],
+        tiny_data,
+    )
+    assert ck.exists()
+    from shallowspeed_tpu.checkpoint import verify_checkpoint
+
+    # one snapshot, of the post-run state: epoch = last COMPLETED epoch
+    assert verify_checkpoint(ck)["epoch"] == 1
+    assert re.search(r"final model hash: [0-9a-f]{40}", out)
+
+    # step checkpointing and auto-resume have no fused-run entry point
+    r = _run_raw(
+        ["--fused-run", "--checkpoint-every-steps", "2",
+         "--checkpoint-dir", str(tmp_path / "d")],
+        tiny_data,
+    )
+    assert r.returncode == 2  # argparse contract violation, pre-backend
+    assert "incompatible with --fused-run" in r.stderr
+    r = _run_raw(
+        ["--fused-run", "--resume", "auto", "--checkpoint-dir",
+         str(tmp_path / "d")],
+        tiny_data,
+    )
+    assert r.returncode == 2
+    assert "no mid-epoch entry point" in r.stderr
+    # incoherent flag combinations fail the same fast way
+    r = _run_raw(["--checkpoint-every-steps", "2"], tiny_data)
+    assert r.returncode == 2 and "--checkpoint-dir" in r.stderr
+    r = _run_raw(["--resume", "auto"], tiny_data)
+    assert r.returncode == 2 and "--checkpoint-dir" in r.stderr
+    # an active env fault plan needs the step loop — silently completing
+    # the uninjected fused run would fake a survived crash
+    r = _run_raw(
+        ["--fused-run", "--epochs", "1", "--no-eval"],
+        tiny_data,
+        extra_env={"SHALLOWSPEED_FAULTS": "die@step=3:mode=sigkill"},
+    )
+    assert r.returncode == 2
+    assert "SHALLOWSPEED_FAULTS" in r.stderr and "step loop" in r.stderr
+
+
+def test_fused_run_rejects_explicit_mid_epoch_resume(tiny_data, tmp_path):
+    """--resume <path> escapes the argparse-time net (the snapshot's cursor
+    is only known after reading it): restoring a MID-EPOCH snapshot under
+    --fused-run must exit 2 with the same clean contract message as the
+    argparse checks — not a raw mid-flight traceback out of the fused
+    dispatch (which drivers would misread as an infrastructure crash)."""
+    ck_dir = tmp_path / "ck"
+    r = _run_raw(
+        ["--epochs", "1", "--global-batch-size", "32", "--mubatches", "2",
+         "--no-eval", "--checkpoint-dir", str(ck_dir),
+         "--checkpoint-every-steps", "2"],
+        tiny_data,
+        extra_env={"SHALLOWSPEED_FAULTS": "die@step=3"},
+    )
+    assert r.returncode != 0  # the injected death left a mid-epoch snapshot
+    snap = ck_dir / "step-00000002.npz"
+    assert snap.exists()
+    r = _run_raw(
+        ["--epochs", "1", "--global-batch-size", "32", "--mubatches", "2",
+         "--no-eval", "--fused-run", "--resume", str(snap)],
+        tiny_data,
+    )
+    assert r.returncode == 2, (r.stdout, r.stderr[-2000:])
+    assert "no mid-epoch entry point" in r.stderr
+    assert "Traceback" not in r.stderr
+
+
+def test_exit_code_3_on_health_halt(tiny_data):
+    """The exit-code contract (README): a numerics halt exits 3 — here a
+    NaN injected into the params at step 2 via the env-var fault harness,
+    caught by --health halt, after flushing the finding to telemetry."""
+    r = _run_raw(
+        ["--epochs", "1", "--global-batch-size", "32", "--mubatches", "2",
+         "--no-eval", "--health", "halt"],
+        tiny_data,
+        extra_env={"SHALLOWSPEED_FAULTS": "nan@step=2"},
+    )
+    assert r.returncode == 3, (r.stdout, r.stderr[-2000:])
+    assert "HEALTH HALT" in r.stderr
+
+
+def test_exit_code_4_on_unrecoverable_checkpoint_state(tiny_data, tmp_path):
+    """The exit-code contract (README): --resume auto over a directory
+    where NO snapshot verifies exits 4 (unrecoverable checkpoint state),
+    naming every candidate and its failure cause."""
+    ck_dir = tmp_path / "ck"
+    ck_dir.mkdir()
+    (ck_dir / "step-00000004.npz").write_bytes(b"not a zip archive")
+    r = _run_raw(
+        ["--epochs", "1", "--global-batch-size", "32", "--mubatches", "2",
+         "--no-eval", "--resume", "auto", "--checkpoint-dir", str(ck_dir)],
+        tiny_data,
+    )
+    assert r.returncode == 4, (r.stdout, r.stderr[-2000:])
+    assert "CHECKPOINT UNRECOVERABLE" in r.stderr
+    assert "step-00000004.npz" in r.stderr
+
+
+@pytest.mark.slow
+def test_sigkill_and_resume_auto_round_trip(tiny_data, tmp_path):
+    """The real preemption shape through the real CLI (the in-suite twin of
+    `make recovery-smoke`): SIGKILL a checkpointing run at an injected
+    step — nothing flushes — then `--resume auto` finishes on exactly the
+    uninterrupted twin's final hash."""
+    common = ["--epochs", "2", "--global-batch-size", "32", "--mubatches",
+              "2", "--no-eval"]
+    twin = _run(common, tiny_data)
+    ck_dir = tmp_path / "ck"
+    r = _run_raw(
+        common + ["--checkpoint-dir", str(ck_dir),
+                  "--checkpoint-every-steps", "4"],
+        tiny_data,
+        extra_env={"SHALLOWSPEED_FAULTS": "die@step=11:mode=sigkill"},
+    )
+    assert r.returncode == -9  # killed, not exited
+    assert (ck_dir / "step-00000008.npz").exists()
+    out = _run(
+        common + ["--checkpoint-dir", str(ck_dir),
+                  "--checkpoint-every-steps", "4", "--resume", "auto"],
+        tiny_data,
+    )
+    assert "resumed at epoch 1" in out
+    want = re.search(r"final model hash: ([0-9a-f]{40})", twin).group(1)
+    got = re.search(r"final model hash: ([0-9a-f]{40})", out).group(1)
+    assert got == want
+
+
+def test_resume_auto_epoch_boundary_honors_total_epochs(tiny_data, tmp_path):
+    """--resume auto's TOTAL-epochs contract holds even when the restored
+    cursor sits ON an epoch boundary and no step grid is active on the
+    resuming run: 1 epoch trained + resume --epochs 2 == exactly one more
+    epoch, bitwise equal to the uninterrupted 2-epoch twin."""
+    common = ["--global-batch-size", "32", "--mubatches", "2", "--no-eval"]
+    twin = _run(common + ["--epochs", "2"], tiny_data)
+    ck_dir = tmp_path / "ck"
+    _run(
+        common + ["--epochs", "1", "--checkpoint-dir", str(ck_dir),
+                  "--checkpoint-every-steps", "8"],
+        tiny_data,
+    )
+    assert (ck_dir / "step-00000008.npz").exists()  # the epoch boundary
+    out = _run(
+        common + ["--epochs", "2", "--checkpoint-dir", str(ck_dir),
+                  "--resume", "auto"],
+        tiny_data,
+    )
+    assert "resumed at epoch 1" in out
+    assert out.count("mean train loss") == 1  # ONE more epoch, not two
+    want = re.search(r"final model hash: ([0-9a-f]{40})", twin).group(1)
+    got = re.search(r"final model hash: ([0-9a-f]{40})", out).group(1)
+    assert got == want

@@ -361,6 +361,117 @@ def test_backward_split_bitwise_identical_to_unsplit(layout):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=layout)
 
 
+KILL_RESUME_LAYOUTS = {
+    # layout -> (killed-run session kwargs, resumed-run session kwargs) —
+    # they differ only for the elastic case, which restores a dp=2 run's
+    # snapshot onto a dp=4 mesh (same global batch, so the deterministic
+    # data order — the bit-identity prerequisite — is unchanged)
+    "dp2": (dict(dp=2), dict(dp=2)),
+    "gpipe-pp4": (
+        dict(pp=4, schedule="gpipe", mubatches=4),
+        dict(pp=4, schedule="gpipe", mubatches=4),
+    ),
+    "zero1": (
+        dict(dp=2, pp=2, schedule="gpipe", zero1=True, optimizer="momentum"),
+        dict(dp=2, pp=2, schedule="gpipe", zero1=True, optimizer="momentum"),
+    ),
+    "bucketed": (
+        dict(dp=2, grad_bucket_bytes=1024),
+        dict(dp=2, grad_bucket_bytes=1024),
+    ),
+    "bsplit": (
+        dict(pp=4, schedule="pipedream", backward_split=True, mubatches=4),
+        dict(pp=4, schedule="pipedream", backward_split=True, mubatches=4),
+    ),
+    "elastic-dp2-to-dp4": (
+        dict(dp=2, optimizer="momentum"),
+        dict(dp=4, optimizer="momentum"),
+    ),
+}
+
+
+@pytest.fixture(scope="module")
+def session_data_dir(tmp_path_factory):
+    sizes = (24, 20, 18, 16, 14, 12, 11, 10)
+    d = tmp_path_factory.mktemp("kill_resume_data")
+    rng = np.random.RandomState(0)
+    for suffix, n in (("train", 256), ("val", 96)):
+        np.save(d / f"x_{suffix}.npy", rng.randn(n, sizes[0]).astype(np.float32))
+        np.save(
+            d / f"y_{suffix}.npy",
+            np.eye(sizes[-1], dtype=np.float32)[rng.randint(0, sizes[-1], n)],
+        )
+    return d
+
+
+@pytest.mark.parametrize("layout", sorted(KILL_RESUME_LAYOUTS))
+def test_kill_and_resume_bitwise_identical_to_uninterrupted(
+    layout, session_data_dir, tmp_path
+):
+    """The kill-and-resume lattice dimension (docs/robustness.md): on every
+    feature layout — dp, pipeline, ZeRO-1, bucketed grad sync, split
+    backward — a run killed by an injected fault at a mid-epoch step and
+    resumed from its last step snapshot finishes on exactly the bits of
+    the uninterrupted twin. The ELASTIC dp=2 -> dp=4 restore is exact at
+    the restore point (same logical snapshot, bit-identical load onto the
+    wider mesh) and float-equivalent at the finish line — a different dp
+    width reassociates the gradient all-reduce sum, so the cross-WIDTH
+    comparison carries the repo's cross-layout tolerance, not bitwise."""
+    from shallowspeed_tpu.api import TrainingSession
+    from shallowspeed_tpu.faults import InjectedFault
+
+    kw_killed, kw_resumed = KILL_RESUME_LAYOUTS[layout]
+    # pp=4 needs 8 sizes (2 per stage); everything shallower runs a 3-layer
+    # model — the recovery contract is about state capture, not depth, and
+    # compile time is what this lattice mostly spends
+    pp = kw_killed.get("pp", 1)
+    common = dict(
+        sizes=(24, 20, 18, 16, 14, 12, 11, 10) if pp == 4 else (24, 18, 14, 10),
+        global_batch_size=64,  # 4 steps/epoch over the 256-sample shard
+        lr=0.01,
+        data_dir=session_data_dir,
+    )
+    twin = TrainingSession(**common, **kw_killed)
+    for _ in range(2):
+        twin.train_epoch()
+
+    ck = tmp_path / "ck"
+    run = TrainingSession(
+        **common, **kw_killed, checkpoint_dir=ck, faults="die@step=5"
+    )
+    with pytest.raises(InjectedFault):
+        while run.epoch < 2:
+            run.train_steps(2)
+            run.save_step_checkpoint()
+
+    res = TrainingSession(
+        **common, **kw_resumed, checkpoint_dir=ck, resume="auto"
+    )
+    assert res.resumed_from is not None and res.global_step == 5, layout
+    elastic = kw_killed != kw_resumed
+    if elastic:
+        # the restore itself is exact across widths: at the restore point
+        # the dp=4 session's layout-independent hash equals the snapshot's
+        # logical params hash, bit for bit
+        from shallowspeed_tpu import utils
+        from shallowspeed_tpu.checkpoint import load_checkpoint
+
+        snap_params, _, _ = load_checkpoint(res.resumed_from, 1)
+        assert res.model_hash() == utils.model_hash(snap_params), layout
+    while res.epoch < 2:
+        res.train_steps(2)
+    if not elastic:
+        assert res.model_hash() == twin.model_hash(), layout
+    else:
+        want = [l for st in twin.params() for l in st]
+        got = [l for st in res.params() for l in st]
+        for a, b in zip(want, got):
+            np.testing.assert_allclose(
+                np.asarray(a["W"]), np.asarray(b["W"]),
+                rtol=3e-4, atol=3e-6, err_msg=layout,
+            )
+
+
 @pytest.mark.parametrize("seed", range(12))
 def test_random_kernel_variant_fuzz(seed):
     """Sequential kernel-variant fuzz: random single-stage shapes, optimizer,

@@ -104,6 +104,8 @@ def test_null_metrics_hot_path_zero_net_allocation():
             with m.span("s"):
                 pass
             m.audit("a")  # the v3 audit hook keeps the guarantee too
+            m.checkpoint("c")  # ... and the v4 fault-tolerance hooks
+            m.recovery("r")
 
     burst(100)  # warm up caches (method cache, code objects)
     # background threads (XLA's pools) can allocate a handful of blocks at
@@ -516,7 +518,6 @@ def test_jsonl_stays_strict_json_under_non_finite_values(tmp_path):
 def test_schema_v2_and_v3_kinds(tmp_path):
     """Schema v2/v3: the step/health/xla_audit record kinds round-trip with
     the version stamp, and NullMetrics no-ops them."""
-    assert SCHEMA_VERSION == 3
     path = tmp_path / "v3.jsonl"
     with JsonlMetrics(path) as m:
         m.step("train", step=0, epoch=0, loss=0.5, grad_norm=0.1, param_norm=9.0)
@@ -528,7 +529,7 @@ def test_schema_v2_and_v3_kinds(tmp_path):
         )
     recs = read_jsonl(path)
     assert [r["kind"] for r in recs] == ["meta", "step", "health", "xla_audit"]
-    assert all(r["v"] == 3 for r in recs)
+    assert all(r["v"] == SCHEMA_VERSION for r in recs)
     assert recs[1]["step"] == 0 and recs[1]["param_norm"] == 9.0
     assert recs[2]["name"] == "non_finite" and recs[2]["action"] == "warn"
     assert recs[3]["name"] == "epoch_program" and recs[3]["census_ok"] is True
@@ -579,6 +580,48 @@ def test_schema_v3_reader_accepts_v1_and_v2_unchanged(tmp_path):
     assert raw[1]["expected"]["comms_time_per_step_s"] == "Infinity"
     assert raw[1]["expected"]["bytes"] == [1.0, "NaN"]
     assert read_jsonl(path)[1]["census_ok"] is True
+
+
+def test_schema_v4_checkpoint_and_recovery_kinds(tmp_path):
+    """Schema v4 (additive): the checkpoint/recovery record kinds round-trip
+    with the version stamp, the v4 reader accepts v1-v3 files unchanged
+    (the refusal stays one-directional), and NullMetrics no-ops the new
+    hooks."""
+    assert SCHEMA_VERSION == 4
+    path = tmp_path / "v4.jsonl"
+    with JsonlMetrics(path) as m:
+        m.checkpoint(
+            "step", path="/tmp/ck/step-00000008.npz", epoch=1,
+            step_in_epoch=0, global_step=8, bytes=4096, wall_s=0.01,
+        )
+        m.recovery(
+            "resumed", resumed_from="/tmp/ck/step-00000008.npz", epoch=1,
+            step_in_epoch=0, global_step=8,
+            skipped=[{"path": "/tmp/ck/step-00000012.npz",
+                      "cause": "content checksum mismatch"}],
+        )
+    recs = read_jsonl(path)
+    assert [r["kind"] for r in recs] == ["meta", "checkpoint", "recovery"]
+    assert all(r["v"] == 4 for r in recs)
+    assert recs[1]["name"] == "step" and recs[1]["global_step"] == 8
+    assert recs[2]["name"] == "resumed"
+    assert recs[2]["skipped"][0]["cause"] == "content checksum mismatch"
+    # v1-v3 files load unchanged under the v4 reader
+    for v, rec in (
+        (1, {"kind": "event", "name": "epoch", "epoch": 0, "loss": 0.5}),
+        (2, {"kind": "step", "name": "train", "step": 0, "loss": 0.5}),
+        (3, {"kind": "xla_audit", "name": "epoch_program", "census_ok": True}),
+    ):
+        p = tmp_path / f"old-v{v}.jsonl"
+        p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
+        assert read_jsonl(p)[0]["kind"] == rec["kind"]
+    v5 = tmp_path / "v5.jsonl"
+    v5.write_text(json.dumps({"v": SCHEMA_VERSION + 1, "kind": "event"}) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        read_jsonl(v5)
+    n = NullMetrics()
+    n.checkpoint("step", global_step=8)
+    n.recovery("resumed", global_step=8)
 
 
 def test_jsonl_multihost_shard_suffix_and_glob_read(tmp_path, monkeypatch):

@@ -639,3 +639,49 @@ def test_fused_run_audits_run_program(data_dir, tmp_path):
     for a in audits:
         assert a["census_ok"] is True
         assert a["census"]["all_reduce"]["count"] >= 1
+
+
+def test_chunked_train_steps_audits_chunk_programs(data_dir, tmp_path):
+    """A train_steps slice shorter than the epoch is a DISTINCT XLA
+    program — the audit contract ("a mislowered layout never trains a
+    step") must census IT, not the never-dispatched full-epoch program.
+    One audit per distinct chunk length (the scan body is
+    length-independent), and a full-epoch slice takes the epoch path."""
+    path = tmp_path / "chunks.jsonl"
+    with JsonlMetrics(path) as m:
+        run = _mesh_session(data_dir, dp=2, metrics=m, audit=True)
+        assert run.batches_per_epoch == 4
+        run.train_steps(1)
+        run.train_steps(1)  # same chunk length: deduped, no second audit
+        run.train_steps(2)  # new chunk length: its own audit
+    audits = [r for r in read_jsonl(path) if r.get("kind") == "xla_audit"]
+    assert [a["name"] for a in audits] == ["chunk_program", "chunk_program"]
+    for a in audits:
+        assert a["census_ok"] is True
+        assert a["census"]["all_reduce"]["count"] >= 1
+
+    # a slice spanning the whole epoch is the epoch program (and a chunked
+    # session that later goes whole-epoch reuses that one audit)
+    with JsonlMetrics(tmp_path / "full.jsonl") as m:
+        run2 = _mesh_session(data_dir, dp=2, metrics=m, audit=True)
+        run2.train_steps(run2.batches_per_epoch)
+        run2.train_epoch()
+    audits2 = [
+        r for r in read_jsonl(tmp_path / "full.jsonl")
+        if r.get("kind") == "xla_audit"
+    ]
+    assert [a["name"] for a in audits2] == ["epoch_program"]
+
+
+def test_chunked_train_steps_audit_refuses_before_dispatch(data_dir):
+    """audit=True refuses a mislowered CHUNK program before it trains a
+    step — same unlatched strictness as the epoch path."""
+    run = _mesh_session(data_dir, dp=2, audit=True)
+    run._expected_comms = dict(
+        run._expected_comms, required=["all_to_all"], forbidden=["all_reduce"]
+    )
+    with pytest.raises(pa.AuditMismatchError, match="all_to_all"):
+        run.train_steps(1)
+    assert run.step_in_epoch == 0  # nothing trained
+    with pytest.raises(pa.AuditMismatchError, match="all_to_all"):
+        run.train_steps(1)

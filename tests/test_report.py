@@ -503,3 +503,93 @@ def test_report_reads_multihost_shard_glob(tmp_path, capsys):
     # bare path that never existed resolves to its shards
     assert report.main([str(tmp_path / "run.jsonl"), "--format", "json"]) == 0
     assert json.loads(capsys.readouterr().out)["epochs"] == 2
+
+
+def test_report_reliability_section(tmp_path, capsys):
+    """The schema-v4 Reliability story: checkpoint overhead + cadence from
+    the checkpoint records, and the recovery verdict with steps-lost-to-
+    replay MEASURED from the killed run's step records when the streams
+    are concatenated (the `make recovery-smoke` shape)."""
+    killed = tmp_path / "killed.jsonl"
+    with JsonlMetrics(killed) as m:
+        with m.span("train_steps"):
+            pass
+        for gs in (4, 8):
+            m.checkpoint(
+                "step", path=f"/ck/step-{gs:08d}.npz", epoch=0,
+                step_in_epoch=gs, global_step=gs, bytes=4096, wall_s=0.25,
+            )
+        for s in range(12):  # the dead run trained through step 11
+            m.step("train", step=s, epoch=0, loss=0.5)
+    resumed = tmp_path / "resumed.jsonl"
+    with JsonlMetrics(resumed) as m:
+        m.recovery(
+            "resumed", resumed_from="/ck/step-00000008.npz", epoch=0,
+            step_in_epoch=8, global_step=8,
+            skipped=[{"path": "/ck/step-00000012.npz",
+                      "cause": "content checksum mismatch"}],
+        )
+        m.event("epoch", epoch=0, loss=0.4, samples_per_sec=10.0, wall_s=1.0)
+    combined = tmp_path / "combined.jsonl"
+    combined.write_text(killed.read_text() + resumed.read_text())
+
+    rep = report.build_report(read_jsonl(combined))
+    rel = rep["reliability"]
+    assert rel["checkpoints"] == 2
+    assert rel["checkpoint_wall_s"] == pytest.approx(0.5)
+    assert 0 < rel["checkpoint_overhead_fraction"] <= 1
+    assert rel["checkpoint_cadence_steps"] == 4
+    assert rel["recovery"]["verdict"] == "resumed"
+    # the kill happened after step 11 trained, the restore landed on 8
+    assert rel["recovery"]["steps_lost_to_replay"] == 12 - 8
+    assert rel["recovery"]["skipped"][0]["cause"] == "content checksum mismatch"
+
+    assert report.main([str(combined), "--format", "md"]) == 0
+    out = capsys.readouterr().out
+    assert "## Reliability" in out
+    assert "recovery: resumed from /ck/step-00000008.npz" in out
+    assert "steps lost to replay: 4" in out
+    assert "1 corrupt snapshot(s) skipped" in out
+
+    # the resumed stream ALONE has no step evidence before the recovery
+    # record: the loss is honestly unknown, never guessed
+    rep2 = report.build_report(read_jsonl(resumed))
+    assert rep2["reliability"]["recovery"]["steps_lost_to_replay"] is None
+    assert report.main([str(resumed), "--format", "md"]) == 0
+    assert "steps lost to replay: unknown" in capsys.readouterr().out
+
+    # a kill that landed exactly on a checkpointed step is a MEASURED 0,
+    # not unknown — the killed run's evidence IS in the stream
+    zero = tmp_path / "zero.jsonl"
+    with JsonlMetrics(zero) as m:
+        for s in range(8):  # trained through step 7, snapshot at 8
+            m.step("train", step=s, epoch=0, loss=0.5)
+        m.recovery(
+            "resumed", resumed_from="/ck/step-00000008.npz", epoch=0,
+            step_in_epoch=8, global_step=8, skipped=[],
+        )
+    rep3 = report.build_report(read_jsonl(zero))
+    assert rep3["reliability"]["recovery"]["steps_lost_to_replay"] == 0
+    assert report.main([str(zero), "--format", "md"]) == 0
+    assert "steps lost to replay: 0" in capsys.readouterr().out
+
+
+def test_report_reliability_omitted_without_v4_records(tmp_path, capsys):
+    """Pre-v4 runs render exactly as before: reliability is null in JSON
+    and the section is absent from the text rendering; a fresh_start
+    recovery renders its own verdict line."""
+    plain = tmp_path / "plain.jsonl"
+    with JsonlMetrics(plain) as m:
+        m.event("epoch", epoch=0, loss=0.5, samples_per_sec=10.0, wall_s=1.0)
+    assert report.build_report(read_jsonl(plain))["reliability"] is None
+    assert report.main([str(plain), "--format", "md"]) == 0
+    assert "Reliability" not in capsys.readouterr().out
+
+    fresh = tmp_path / "fresh.jsonl"
+    with JsonlMetrics(fresh) as m:
+        m.recovery("fresh_start", resumed_from=None, epoch=0,
+                   step_in_epoch=0, global_step=0, skipped=[])
+        m.event("epoch", epoch=0, loss=0.5, samples_per_sec=10.0, wall_s=1.0)
+    assert report.main([str(fresh), "--format", "md"]) == 0
+    out = capsys.readouterr().out
+    assert "recovery: fresh start" in out

@@ -505,7 +505,10 @@ def test_probe_patience_respects_budget(monkeypatch):
     tag, diag = bench._ensure_responsive_backend(probe_timeout_s=180, patience_s=600)
     assert tag == "_CPU_FALLBACK_TUNNEL_UNRESPONSIVE"
     assert fake["t"] <= 600  # never overshoots the documented budget
-    assert [p["outcome"] for p in diag["probes"]] == ["timeout", "timeout"]
+    # between-probe sleeps now follow the shared bounded backoff (short
+    # early delays, clamped so the last probe still fits the budget): the
+    # 600 s window fits exactly three 180 s probes at every jitter draw
+    assert [p["outcome"] for p in diag["probes"]] == ["timeout"] * 3
     assert diag["patience_s"] == 600 and "unresponsive" in diag["failure"]
 
 

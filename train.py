@@ -28,6 +28,7 @@ On a single-chip host, multi-device layouts run on emulated CPU devices:
 
 import argparse
 import contextlib
+import os
 import sys
 import time
 
@@ -115,12 +116,50 @@ def main():
         "writes once at the end instead of per epoch.",
     )
     ap.add_argument(
-        "--checkpoint", default=None, help="path to save a checkpoint after each epoch"
+        "--checkpoint",
+        default=None,
+        help="path to save a checkpoint after each epoch (with --fused-run: "
+        "the whole run is ONE dispatch, so exactly one checkpoint is saved, "
+        "after it returns — the pinned contract)",
+    )
+    ap.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="directory for preemption-safe STEP checkpoints "
+        "(step-<global_step>.npz, atomic + checksummed; see "
+        "docs/robustness.md) — required by --checkpoint-every-steps and "
+        "--resume auto",
+    )
+    ap.add_argument(
+        "--checkpoint-every-steps",
+        type=int,
+        default=0,
+        metavar="N",
+        help="write a step checkpoint into --checkpoint-dir every N "
+        "optimizer steps (0 = off). The epoch is dispatched in N-step "
+        "chunks — bitwise-identical weights to whole-epoch dispatch — and "
+        "a killed run resumes from the last snapshot with --resume auto",
+    )
+    ap.add_argument(
+        "--keep",
+        type=int,
+        default=3,
+        metavar="K",
+        help="step-checkpoint retention: keep the newest K snapshots "
+        "(older ones are rotated away; >1 keeps fallbacks for corrupt-"
+        "newest recovery)",
     )
     ap.add_argument(
         "--resume",
         default=None,
-        help="checkpoint to resume from (any layout -> any layout)",
+        help="checkpoint to resume from (any layout -> any layout), or "
+        "'auto': discover the newest VERIFYING step checkpoint in "
+        "--checkpoint-dir (corrupt/torn/non-finite snapshots are skipped), "
+        "resume mid-epoch at its exact step — or start fresh when the "
+        "directory is empty. With 'auto', --epochs is the run's TOTAL "
+        "epoch target (so a killed-and-resumed run ends where its "
+        "uninterrupted twin does); with an explicit path it stays the "
+        "number of ADDITIONAL epochs (the historical contract)",
     )
     ap.add_argument(
         "--profile-dir",
@@ -235,41 +274,104 @@ def main():
     )
     args = ap.parse_args()
 
+    # fail fast on incoherent fault-tolerance flag combinations — at
+    # argparse time, before any backend or data is touched
+    if args.checkpoint_every_steps < 0:
+        ap.error("--checkpoint-every-steps must be >= 0")
+    if args.checkpoint_every_steps and args.checkpoint_dir is None:
+        ap.error("--checkpoint-every-steps needs --checkpoint-dir")
+    if args.checkpoint_every_steps and args.fused_run:
+        ap.error(
+            "--checkpoint-every-steps is incompatible with --fused-run: the "
+            "fused run is ONE on-device dispatch, so there is no step "
+            "boundary for the host to checkpoint at — drop --fused-run for "
+            "preemption-safe runs (--checkpoint still saves once after the "
+            "fused dispatch)"
+        )
+    if args.resume == "auto" and args.checkpoint_dir is None:
+        ap.error("--resume auto discovers snapshots in --checkpoint-dir")
+    if args.resume == "auto" and args.fused_run:
+        ap.error(
+            "--resume auto may land mid-epoch, and the fused run has no "
+            "mid-epoch entry point — drop --fused-run to recover"
+        )
+    if args.keep < 1:
+        ap.error("--keep must be >= 1")
+    # "plan is active" mirrors faults.FaultPlan.parse: any non-empty
+    # comma-separated part is an injection (checked without importing the
+    # package — argparse time stays jax-free)
+    faults_env = os.environ.get("SHALLOWSPEED_FAULTS", "")
+    if args.fused_run and any(p.strip() for p in faults_env.split(",")):
+        ap.error(
+            f"SHALLOWSPEED_FAULTS={faults_env!r} is set but --fused-run "
+            "dispatches the whole run as ONE program — step-granular "
+            "injections can never fire, and a recovery driver would "
+            "mistake the uninjected run for a survived crash; drop "
+            "--fused-run (the fault harness needs the step loop)"
+        )
+
     import jax
 
     from shallowspeed_tpu.api import TrainingSession
+    from shallowspeed_tpu.checkpoint import CheckpointError
     from shallowspeed_tpu.observability import HealthError, JsonlMetrics, capture
 
     metrics = JsonlMetrics(args.metrics_out) if args.metrics_out else None
-    run = TrainingSession(
-        metrics=metrics,
-        health=args.health,
-        audit=args.audit,
-        dp=args.dp,
-        pp=args.pp,
-        schedule=args.schedule,
-        global_batch_size=args.global_batch_size,
-        mubatches=args.mubatches,
-        lr=args.lr,
-        precision=args.precision,
-        data_dir=args.data_dir,
-        resume=args.resume,
-        fuse_mubatches=args.fuse_mubatches,
-        megakernel=args.megakernel,
-        epoch_kernel=args.epoch_kernel,
-        run_kernel=args.run_kernel,
-        optimizer=args.optimizer,
-        momentum=args.momentum,
-        virtual_stages=args.virtual_stages,
-        zero1=args.zero1,
-        grad_bucket_bytes=args.grad_bucket_bytes,
-        backward_split=args.backward_split,
-        scan_unroll=args.scan_unroll,
-        tick_unroll=args.tick_unroll,
-        weight_decay=args.weight_decay,
-        clip_norm=args.clip_norm,
-        kernel_backend=args.kernel_backend,
-    )
+    try:
+        run = TrainingSession(
+            metrics=metrics,
+            health=args.health,
+            audit=args.audit,
+            dp=args.dp,
+            pp=args.pp,
+            schedule=args.schedule,
+            global_batch_size=args.global_batch_size,
+            mubatches=args.mubatches,
+            lr=args.lr,
+            precision=args.precision,
+            data_dir=args.data_dir,
+            resume=args.resume,
+            fuse_mubatches=args.fuse_mubatches,
+            megakernel=args.megakernel,
+            epoch_kernel=args.epoch_kernel,
+            run_kernel=args.run_kernel,
+            optimizer=args.optimizer,
+            momentum=args.momentum,
+            virtual_stages=args.virtual_stages,
+            zero1=args.zero1,
+            grad_bucket_bytes=args.grad_bucket_bytes,
+            backward_split=args.backward_split,
+            scan_unroll=args.scan_unroll,
+            tick_unroll=args.tick_unroll,
+            weight_decay=args.weight_decay,
+            clip_norm=args.clip_norm,
+            kernel_backend=args.kernel_backend,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_keep=args.keep,
+        )
+    except CheckpointError as e:
+        # unrecoverable checkpoint state: the named file (or every snapshot
+        # in the discovery directory) fails verification — distinct exit
+        # code so drivers can tell "restore is impossible" from a crash
+        # (exit-code contract: README / docs/observability.md)
+        print(f"CHECKPOINT UNRECOVERABLE: {e}", file=sys.stderr)
+        if metrics is not None:
+            metrics.close()
+        sys.exit(4)
+    if args.fused_run and run.step_in_epoch > 0:
+        # the late half of the fail-fast net: an EXPLICIT --resume
+        # snapshot's cursor is only known after reading it, so this
+        # contract violation surfaces post-restore — same clean message
+        # and exit code (2) as the argparse-time checks, never a raw
+        # mid-flight traceback out of the fused dispatch
+        if metrics is not None:
+            metrics.close()
+        ap.error(
+            f"--resume {args.resume} restored a mid-epoch cursor (epoch "
+            f"{run.epoch}, step {run.step_in_epoch}), and the fused run "
+            "has no mid-epoch entry point — drop --fused-run to finish "
+            "the epoch with the step loop"
+        )
     if args.dp == 1 and args.pp == 1 and args.virtual_stages == 1:
         layout = "sequential"
     elif args.pp == 1 and args.virtual_stages == 1:
@@ -278,10 +380,17 @@ def main():
         layout = f"interleaved pipeline, V={args.virtual_stages}"
     else:
         layout = f"{args.schedule} pipeline"
+    note = ""
+    if args.resume:
+        if run.resumed_from is not None:
+            note = f" resumed at epoch {run.epoch}"
+            if run.step_in_epoch:
+                note += f", step {run.step_in_epoch}"
+        else:  # --resume auto on an empty checkpoint dir
+            note = " no resumable checkpoint found — fresh start"
     print(
         f"devices={jax.devices()} layout: DP={args.dp} x PP={args.pp} ({layout}) "
-        f"batches/epoch={run.batches_per_epoch}"
-        + (f" resumed at epoch {run.epoch}" if args.resume else "")
+        f"batches/epoch={run.batches_per_epoch}" + note
     )
 
     def profiled(i):
@@ -317,6 +426,62 @@ def main():
             if args.checkpoint:
                 run.save(args.checkpoint)
             final_acc = accs[-1] if accs else run.accuracy()
+        elif (
+            args.checkpoint_every_steps
+            or run.faults_active
+            or run.step_in_epoch > 0
+            or args.resume == "auto"
+        ):
+            # the preemption-safe STEP loop: the epoch is dispatched in
+            # chunks cut at the checkpoint grid (and at fault-injection
+            # steps), bitwise-identical to whole-epoch dispatch; a snapshot
+            # is written whenever global_step lands on the grid. With
+            # --resume auto, --epochs is the TOTAL target so a resumed run
+            # ends exactly where its uninterrupted twin does — which is why
+            # resume-auto runs ALWAYS take this loop, even when the restored
+            # cursor sits on an epoch boundary and no step grid is active.
+            every = args.checkpoint_every_steps
+            target = (
+                args.epochs if args.resume == "auto"
+                else run.epoch + args.epochs
+            )
+            nb = run.batches_per_epoch
+            # trace one post-compile epoch, like the plain loop's profiled()
+            prof_epoch = (
+                run.epoch + min(1, max(target - run.epoch - 1, 0))
+                if args.profile_dir and target > run.epoch
+                else None
+            )
+            while run.epoch < target:
+                if run.step_in_epoch == 0 and not args.no_eval:
+                    print(
+                        f"Epoch: {run.epoch}, Time Spent: "
+                        f"{time.time() - t0:.2f}s, "
+                        f"Accuracy: {run.accuracy() * 100:.2f}%"
+                    )
+                if every > 0:
+                    n = min(
+                        every - run.global_step % every,
+                        nb - run.step_in_epoch,
+                    )
+                else:
+                    n = nb - run.step_in_epoch
+                with (
+                    capture(args.profile_dir, metrics)
+                    if run.epoch == prof_epoch
+                    else contextlib.nullcontext()
+                ):
+                    _, epoch_loss = run.train_steps(n)
+                if every > 0 and run.global_step % every == 0:
+                    run.save_step_checkpoint()
+                if epoch_loss is not None:
+                    print(
+                        f"Epoch: {run.epoch - 1}, mean train loss: "
+                        f"{epoch_loss:.5f}"
+                    )
+                    if args.checkpoint:
+                        run.save(args.checkpoint)
+            final_acc = run.accuracy()
         else:
             for i in range(args.epochs):
                 if not args.no_eval:
