@@ -7,8 +7,8 @@ CPU_MESH = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 SHELL := /bin/bash
 
 .PHONY: test verify metrics-smoke report-smoke audit-smoke overlap-smoke \
-        split-smoke recovery-smoke data train train-mesh bench bench-scaling \
-        schedules clean
+        split-smoke recovery-smoke serve-smoke bench-serving data train \
+        train-mesh bench bench-scaling schedules clean
 
 test:
 	python -m pytest tests/ -q
@@ -166,6 +166,44 @@ recovery-smoke:
 	  grep -q "steps lost to replay: 3" /tmp/rsmoke/$$lay.report.md; \
 	done
 	@echo "recovery-smoke OK: kill-at-step-11 + resume auto is bitwise identical to the uninterrupted twin on dp2 and gpipe-pp4, Reliability section rendered"
+
+# inference serving end-to-end (docs/serving.md): on a CPU dp2 and a
+# gpipe-pp4 layout, drive 200 seeded Poisson requests through the serving
+# engine with --verify (every response bitwise-equal to a direct predict()
+# of the same rows) and --audit (every compiled inference program's
+# collective census verified against the forward-only serving contract
+# before it serves), assert zero dropped/incorrect responses and that the
+# schema-v5 request/serving records landed, render the report CLI's
+# Serving section with an SLO verdict, then emit the bench_serving
+# offered-load sweep JSON (p50/p99 latency, goodput, queue depth,
+# saturation knee), exit 0 (needs data, like metrics-smoke)
+serve-smoke:
+	rm -f /tmp/serve_dp.jsonl /tmp/serve_pp.jsonl /tmp/serve_bench.json
+	$(CPU_MESH) python -m shallowspeed_tpu.serving --dp 2 \
+	    --requests 200 --rate 300 --seed 0 --slo-ms 2000 --verify --audit \
+	    --metrics-out /tmp/serve_dp.jsonl
+	$(CPU_MESH) python -m shallowspeed_tpu.serving --pp 4 --schedule gpipe \
+	    --requests 200 --rate 300 --seed 0 --slo-ms 2000 --verify --audit \
+	    --metrics-out /tmp/serve_pp.jsonl
+	set -e; for f in /tmp/serve_dp /tmp/serve_pp; do \
+	  python -c "import json,sys; p=sys.argv[1]; recs=[json.loads(l) for l in open(p) if l.strip()]; reqs=[r for r in recs if r.get('kind')=='request']; assert len(reqs)==200, p+': %d request records' % len(reqs); assert all(r['name']=='ok' for r in reqs), p+': dropped/failed requests'; srv=[r for r in recs if r.get('kind')=='serving']; assert srv, p+': no serving summary'; a=[r for r in recs if r.get('kind')=='xla_audit']; assert a and all(r.get('census_ok') for r in a), p+': serving census not clean'; print(p+': 200 ok requests, clean serving census')" $$f.jsonl; \
+	  python -m shallowspeed_tpu.observability.report $$f.jsonl --format md \
+	      --slo-ms 2000 > $$f.report.md; \
+	  grep -q "## Serving" $$f.report.md; \
+	  grep -q "SLO" $$f.report.md; \
+	done
+	$(CPU_MESH) python -m shallowspeed_tpu.serving.bench_serving --dp 2 \
+	    --rates 100,300 --requests 40 --seed 0 --slo-ms 2000 \
+	    --out /tmp/serve_bench.json
+	python -c "import json; rec=json.load(open('/tmp/serve_bench.json')); assert rec['bench']=='serving' and rec['bench_version']==1; rows=rec['sweep']; assert len(rows)==2 and all(r['p50_latency_s'] and r['p99_latency_s'] is not None and r['queue_depth_max'] is not None and r['goodput_rps'] is not None for r in rows), rows; print('bench_serving: %d-rate sweep, knee=%s' % (len(rows), rec['knee_rps']))"
+	@echo "serve-smoke OK: 200 bitwise-verified Poisson requests on dp2 and gpipe-pp4, Serving section + SLO verdict rendered, bench_serving sweep recorded"
+
+# the full offered-load sweep on the default layouts (see docs/serving.md)
+bench-serving:
+	$(CPU_MESH) python -m shallowspeed_tpu.serving.bench_serving --dp 2 \
+	    --slo-ms 100
+	$(CPU_MESH) python -m shallowspeed_tpu.serving.bench_serving --pp 4 \
+	    --schedule gpipe --slo-ms 100
 
 data:
 	python prepare_data.py

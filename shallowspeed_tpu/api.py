@@ -52,6 +52,7 @@ from shallowspeed_tpu.parallel import executor as E
 from shallowspeed_tpu.parallel import gradsync
 from shallowspeed_tpu.parallel import lower_schedule, make_mesh
 from shallowspeed_tpu.parallel.lowering import program_flops, program_stats
+from shallowspeed_tpu.serving import slots as serving_slots
 
 # The reference's canonical training configuration (train.py:56-59,98,107) —
 # the single source of truth for every benchmark script in this repo.
@@ -106,6 +107,8 @@ class TrainingSession:
         checkpoint_dir=None,
         checkpoint_keep=3,
         faults=None,
+        predict_slot_rows=None,
+        predict_slot_ladder=None,
     ):
         # telemetry hook (observability package): None -> the zero-overhead
         # null backend. Everything the session emits — construction spans,
@@ -275,7 +278,28 @@ class TrainingSession:
         # eval-free runs (train.py --no-eval, benchmarks) pay neither the host
         # load nor the device transfer
         self._vx = self._vy = None
-        self._predict_cache = {}  # mesh predict() programs, keyed by row count
+        # inference slot geometry (serving/slots.py): predict(), mesh eval
+        # and the serving engine all dispatch whole microbatch SLOTS of
+        # ``slot_rows`` global rows, with per-dispatch slot counts rounded
+        # up a fixed ladder — so the predict cache holds at most
+        # len(ladder) compiled programs (one per rung) instead of one per
+        # distinct row count, and a request slot computes bitwise-
+        # identically in every rung program (docs/serving.md)
+        if predict_slot_rows is None:
+            self._slot_rows = serving_slots.default_slot_rows(dp)
+        else:
+            self._slot_rows = int(predict_slot_rows)
+            if self._slot_rows < 1 or self._slot_rows % dp:
+                raise ValueError(
+                    f"predict_slot_rows must be a positive multiple of dp="
+                    f"{dp}, got {predict_slot_rows}"
+                )
+        self._slot_ladder = serving_slots.validate_ladder(
+            predict_slot_ladder
+            if predict_slot_ladder is not None
+            else serving_slots.DEFAULT_SLOT_LADDER
+        )
+        self._predict_cache = {}  # inference programs, keyed by ladder rung
         self._run_fns = {}  # fused multi-epoch programs, keyed by with_eval
         self._compiled_runs = {}  # AOT warm_run executables, keyed by (with_eval, epochs)
 
@@ -552,7 +576,6 @@ class TrainingSession:
                 clip_norm=clip_norm, kernel_backend=kernel_backend,
                 grad_bucket_bytes=grad_bucket_bytes,
             )
-            self._eval_step = None  # built lazily, sized to the val split
 
         # analytical cost model + MFU accounting (observability/costmodel):
         # the model-FLOP numerator is known at construction; the XLA
@@ -703,12 +726,14 @@ class TrainingSession:
         self._record_audit(compiled, "chunk_program", dedup=dedup)
         self._record_cost_model()
 
-    def _record_audit(self, compiled, program, dedup=None):
+    def _record_audit(self, compiled, program, dedup=None, expected=None):
         """Jit-time XLA program audit (observability/program_audit.py):
         census the compiled program's collectives, pull its memory
         analysis, and emit one schema-v3 ``xla_audit`` record per DISTINCT
         compiled program (``dedup`` names the compile variant; defaults to
-        the program label). Under ``audit=True`` a census that violates
+        the program label). ``expected`` overrides the session's training
+        contract — the inference programs audit against their own
+        forward-only contract. Under ``audit=True`` a census that violates
         the layout's analytical comms contract raises AuditMismatchError —
         BEFORE the first dispatch, so a mislowered layout never trains a
         step (the program is marked audited only on a pass: a
@@ -719,7 +744,7 @@ class TrainingSession:
             return
         rec = program_audit.audit_compiled(
             compiled,
-            expected=self._expected_comms,
+            expected=expected if expected is not None else self._expected_comms,
             platform=self._cost_model.platform,
             n_devices=self._cost_model.n_devices,
         )
@@ -1257,51 +1282,163 @@ class TrainingSession:
         self._vy = jnp.asarray(val.target_y)
         if not self._sequential:
             n_val = self._vx.shape[0]
-            # one row-shard per dp replica, padded up to a dp multiple
+            # fused-run eval keeps its own whole-split program (one padded
+            # microbatch inside the fused run — one row-shard per dp
+            # replica); the interactive accuracy() path instead loops the
+            # split through the SAME ladder-capped slot programs predict()
+            # and the serving engine dispatch
             eval_rows = -(-n_val // self.dp) * self.dp
             self._vx_padded = jnp.pad(self._vx, ((0, eval_rows - n_val), (0, 0)))
             self._vy_labels = jnp.argmax(self._vy, 1)
-            self._eval_step = self._inference_step(eval_rows)
+
+    @property
+    def sequential(self):
+        """True on the single-device reference path (dp=pp=V=1) — no mesh,
+        no tick programs; inference dispatches one fixed slot program per
+        OCCUPIED slot (the serving engine's padding accounting keys off
+        this: a sequential dispatch never pays the ladder rung tail)."""
+        return self._sequential
+
+    @property
+    def slot_rows(self):
+        """Global rows per inference microbatch slot (docs/serving.md)."""
+        return self._slot_rows
+
+    @property
+    def slot_ladder(self):
+        """Allowed slot counts per inference dispatch — the compile bound:
+        at most len(slot_ladder) cached predict programs per session."""
+        return self._slot_ladder
 
     def predict(self, x):
         """Softmax class probabilities for a (n, in_dim) batch on ANY layout
-        (host numpy in, host numpy out). On mesh layouts rows are padded to a
-        dp multiple and fed through a cached whole-batch inference program
-        (one program per distinct padded row count)."""
+        (host numpy in, host numpy out). Rows are packed into fixed
+        ``slot_rows``-row microbatch slots and dispatched through cached
+        inference programs whose slot counts walk the ``slot_ladder`` —
+        at most len(ladder) compiled programs ever, and each slot computes
+        bitwise-identically in every rung program (the serving engine's
+        parity contract rides on exactly this property)."""
         x = np.asarray(x, np.float32)
         n = x.shape[0]
         out_dim = self.spec.out_dim
-        if self._sequential:
-            if self._predict is None:  # pragma: no cover - always built
-                raise RuntimeError("sequential session has no predict fn")
-            return np.asarray(self._predict(self._params, jnp.asarray(x)))[:, :out_dim]
-        rows = -(-n // self.dp) * self.dp
-        step = self._inference_step(rows)
-        xb = jnp.asarray(np.pad(x, ((0, rows - n), (0, 0))))
-        return np.asarray(step(self._stacked, self._flags, xb))[:n, :out_dim]
+        if n == 0:
+            return np.zeros((0, out_dim), np.float32)
+        S_rows = self._slot_rows
+        cap = self._slot_ladder[-1] * S_rows  # rows per ladder-capped chunk
+        outs = []
+        for i in range(0, n, cap):
+            chunk = x[i : i + cap]
+            m = serving_slots.slots_needed(chunk.shape[0], S_rows)
+            if self._sequential:
+                # one compiled (slot_rows, in_dim) program, dispatched per
+                # slot: a fixed shape is what keeps each slot's rows
+                # bitwise-stable against the batch around them. Only the m
+                # OCCUPIED slots dispatch — the ladder round-up exists to
+                # bound compiled-program count, and the sequential path has
+                # exactly one program however many slots run, so the
+                # pure-padding rung tail would be wasted work
+                xb = np.pad(chunk, ((0, m * S_rows - chunk.shape[0]), (0, 0)))
+                preds = np.concatenate(
+                    [
+                        np.asarray(
+                            self._predict(
+                                self._params,
+                                jnp.asarray(xb[k * S_rows : (k + 1) * S_rows]),
+                            )
+                        )
+                        for k in range(m)
+                    ],
+                    axis=0,
+                )
+            else:
+                rung = serving_slots.rung_for(m, self._slot_ladder)
+                xb = np.pad(chunk, ((0, rung * S_rows - chunk.shape[0]), (0, 0)))
+                step = self._inference_step(rung)
+                packed = serving_slots.pack_slots(
+                    xb.reshape(rung, S_rows, -1), self.dp
+                )
+                preds = serving_slots.unpack_slots(
+                    np.asarray(step(self._stacked, self._flags, jnp.asarray(packed))),
+                    rung,
+                    self.dp,
+                )
+            outs.append(preds[: chunk.shape[0], :out_dim])
+        return np.concatenate(outs, axis=0)
 
-    def _lower_inference_prog(self):
+    def _lower_inference_prog(self, mubatches=1):
         """The layout's inference TickProgram (interleaved-aware) — shared by
-        the cached predict/eval programs and the fused train_run eval."""
+        the cached predict/serving programs (``mubatches`` = the ladder
+        rung's slot count) and the fused train_run eval (one whole-split
+        microbatch)."""
         if self.V > 1:
             return lower_schedule(
-                S.InterleavedInferenceSchedule, 1, self.pp,
+                S.InterleavedInferenceSchedule, mubatches, self.pp,
                 training=False, virtual=self.V,
             )
-        return lower_schedule(S.InferenceSchedule, 1, self.pp, training=False)
+        return lower_schedule(
+            S.InferenceSchedule, mubatches, self.pp, training=False
+        )
 
-    def _inference_step(self, rows):
-        """Cached whole-batch inference program for a padded row count
-        (mesh layouts; shared by predict() and the validation path)."""
-        step = self._predict_cache.get(rows)
+    def _inference_step(self, n_slots):
+        """Cached inference program for a ladder rung of ``n_slots``
+        microbatch slots (mesh layouts; shared by predict(), the mesh
+        accuracy() path and the serving engine). With metrics or strict
+        audit enabled the compiled program is censused against the
+        forward-only inference contract BEFORE it is cached — a serving
+        program that lowers a gradient collective never serves a request
+        (and, like every audit, a failure is never latched)."""
+        step = self._predict_cache.get(n_slots)
         if step is None:
+            prog = self._lower_inference_prog(n_slots)
             step = E.make_pipeline_step(
-                self.mesh, self.spec, self._lower_inference_prog(),
-                rows // self.dp, precision=self.precision,
+                self.mesh, self.spec, prog,
+                self._slot_rows // self.dp, precision=self.precision,
                 kernel_backend=self._kernel_backend,
             )
-            self._predict_cache[rows] = step
+            if self._metrics.enabled or self._audit_strict:
+                expected = program_audit.expected_comms(
+                    self.spec,
+                    self.dp,
+                    self.pp,
+                    prog=prog,
+                    mubatch_size=self._slot_rows // self.dp,
+                    platform=self._cost_model.platform,
+                    precision=self._precision_name,
+                )
+                with self._metrics.span("jit_compile"):
+                    compiled = step.lower(
+                        self._stacked,
+                        self._flags,
+                        jax.ShapeDtypeStruct(
+                            (n_slots * self._slot_rows, self.spec.sizes[0]),
+                            jnp.float32,
+                        ),
+                    ).compile()
+                self._metrics.counter("jit_compiles")
+                self._record_audit(
+                    compiled,
+                    "inference_program",
+                    dedup=("inference", n_slots),
+                    expected=expected,
+                )
+            self._predict_cache[n_slots] = step
         return step
+
+    def inference_latency_bound(self):
+        """Analytical latency floor for one request slot through this
+        layout's inference program: the lockstep tick model's weighted
+        makespan (ticks x per-tick cost from
+        ``costmodel.PIPELINE_OP_COSTS``) at the platform peak — the
+        model-side number the serving bench and report quote next to the
+        measured percentiles (docs/serving.md)."""
+        return costmodel.serving_latency_bound(
+            prog=None if self._sequential else self._lower_inference_prog(1),
+            spec=self.spec,
+            slot_rows=self._slot_rows,
+            dp=self.dp,
+            platform=self._cost_model.platform,
+            precision=self._precision_name,
+        )
 
     def accuracy(self) -> float:
         """Argmax accuracy over the full validation split."""
@@ -1313,13 +1450,13 @@ class TrainingSession:
                     self._predict, self._params, self._vx, self._vy
                 )
             else:
+                # the split flows through the SAME ladder-capped slot
+                # programs predict() and the serving engine dispatch — eval
+                # exercises exactly the compiled path serving exercises
                 n_val = self._vx.shape[0]
-                preds = self._eval_step(
-                    self._stacked, self._flags, self._vx_padded
-                )[:n_val]
-                out_dim = self.spec.out_dim
+                preds = self.predict(np.asarray(self._vx))
                 correct = int(
-                    (jnp.argmax(preds[:, :out_dim], 1) == self._vy_labels).sum()
+                    (np.argmax(preds, 1) == np.asarray(self._vy_labels)).sum()
                 )
                 acc = correct / max(n_val, 1)
         if self._metrics.enabled:

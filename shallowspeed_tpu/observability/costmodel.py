@@ -80,6 +80,53 @@ def peak_flops_per_chip(platform, precision="highest"):
     return PEAK_FLOPS_PER_CHIP[key], source
 
 
+def serving_latency_bound(
+    prog, spec, slot_rows, dp=1, platform="cpu", precision="highest"
+):
+    """Analytical latency floor for ONE request slot through the layout's
+    inference program — the model-side number the serving bench and report
+    quote next to the MEASURED p50/p99 (docs/serving.md).
+
+    Mesh layouts (``prog`` = the single-slot lowered inference program):
+    under the executor's lockstep tick model a dispatch takes
+    ``weighted_makespan(prog)`` forward-units of work (for a forward-only
+    program that is exactly its tick count x ``PIPELINE_OP_COSTS['fwd']``),
+    and one forward-unit is ``2 * (slot_rows/dp) * padded_P`` FLOPs over
+    the PADDED slot stack (``lowering.program_flops``'s per-cell ledger).
+    Sequential (``prog=None``): one slot's logical forward,
+    ``2 * P * slot_rows`` FLOPs. Divided by the platform's peak
+    (``peak_flops_per_chip``) — a lower bound: dispatch overhead, relay
+    bandwidth and queueing all sit on top of it, which is the point of
+    printing it under the measured percentiles.
+
+    Returns ``{"ticks", "weighted_ticks", "flops", "seconds",
+    "peak_flops_per_chip", "peak_source"}`` (``seconds`` None when the
+    platform peak is unknown; ``ticks`` None on the sequential path).
+    """
+    peak, source = peak_flops_per_chip(platform, precision)
+    if prog is None:
+        flops = 2 * sum(
+            spec.sizes[i] * spec.sizes[i + 1] for i in range(len(spec.sizes) - 1)
+        ) * slot_rows
+        ticks = weighted = None
+    else:
+        from shallowspeed_tpu.parallel.executor import slot_shapes
+        from shallowspeed_tpu.parallel.lowering import weighted_makespan
+
+        padded_p = sum(o * i for o, i in slot_shapes(spec))
+        weighted = weighted_makespan(prog)  # forward-units (fwd weight 1.0)
+        ticks = int(prog.num_ticks)
+        flops = weighted * 2 * (slot_rows // dp) * padded_p
+    return {
+        "ticks": ticks,
+        "weighted_ticks": None if prog is None else float(weighted),
+        "flops": float(flops),
+        "seconds": (flops / peak) if peak else None,
+        "peak_flops_per_chip": peak,
+        "peak_source": source,
+    }
+
+
 def compiled_flops(compiled):
     """Pull ``(flops, bytes_accessed)`` from a jax ``Compiled``'s
     ``cost_analysis()`` across jax versions (dict in newer jax, a one-dict

@@ -46,6 +46,14 @@ Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
     {"v": 4, "ts": ..., "kind": "recovery",  "name": <verdict>,
      "resumed_from": path|null, "epoch": e, "step_in_epoch": s,
      "global_step": g, "skipped": [...], **fields}                   [v4+]
+    {"v": 5, "ts": ..., "kind": "request",   "name": <verdict: "ok"|
+     "dropped">, "id": i, "rows": n, "slots": k, "enqueue_ts": ...,
+     "dispatch_ts": ..., "complete_ts": ..., "latency_s": ...,
+     "queue_s": ..., "deadline_ms": ..., "slo_ok": bool|null}        [v5+]
+    {"v": 5, "ts": ..., "kind": "serving",   "name": "summary",
+     "completed": n, "dropped": n, "offered_rps": ..., "p50_latency_s":
+     ..., "p99_latency_s": ..., "goodput_rps": ..., "padding_waste":
+     ..., "queue_depth_max": ..., **fields}                          [v5+]
 
 Schema compatibility rules (SCHEMA_VERSION history):
 
@@ -68,6 +76,15 @@ Schema compatibility rules (SCHEMA_VERSION history):
   corrupt snapshot skipped on the way) kinds, the evidence stream behind
   the report CLI's Reliability section. No existing kind or field
   changed meaning; the v4 reader accepts v1–v3 files unchanged.
+- v5  ADDITIVE: the ``request`` (one served request's accounting —
+  enqueue/dispatch/complete timestamps, rows vs padded slots, latency
+  and queue wait, SLO verdict; named by its outcome) and ``serving``
+  (one load run's aggregate — completion counts, latency percentiles,
+  goodput, padding waste, queue-depth stats) kinds, the evidence
+  stream behind the report CLI's Serving section
+  (shallowspeed_tpu/serving/, docs/serving.md). No existing kind or
+  field changed meaning; the v5 reader accepts v1–v4 files unchanged
+  and the strict refusal stays one-directional (a v6 file is refused).
 
 The contract for future bumps: additive kinds/fields bump the version and
 must keep old records readable; any change to an EXISTING kind's meaning
@@ -92,7 +109,7 @@ import time
 
 from shallowspeed_tpu.observability.spans import Span
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 SCHEMA_NAME = "shallowspeed_tpu.metrics"
 
 
@@ -150,6 +167,12 @@ class NullMetrics:
         pass
 
     def recovery(self, name, **fields):
+        pass
+
+    def request(self, name, **fields):
+        pass
+
+    def serving(self, name, **fields):
         pass
 
     def flush(self):
@@ -233,6 +256,12 @@ class MetricsRecorder:
 
     def recovery(self, name, **fields):
         self._emit({"kind": "recovery", "name": name, **fields})
+
+    def request(self, name, **fields):
+        self._emit({"kind": "request", "name": name, **fields})
+
+    def serving(self, name, **fields):
+        self._emit({"kind": "serving", "name": name, **fields})
 
     # -- recorder-internal hooks --------------------------------------------
 

@@ -300,6 +300,15 @@ def expected_comms(
     compiled program is audited against, and the comms section of the run
     report.
 
+    ``prog`` may be a TRAINING tick program (the default contract below) or
+    an INFERENCE one (``prog.is_training`` False — the serving engine's
+    compiled predict programs): inference keeps the pp-relay leg but
+    forbids the ZeRO collectives outright, and pins ``all_reduce`` at AT
+    MOST ONE op — the lawful preds psum (which survives compilation even
+    at pp=1, measured) — so a serving program that lowers a gradient-sync
+    reduce-scatter/all-gather, or a SECOND all-reduce beyond the preds
+    psum, fails its audit before the first request is served.
+
     Returns a JSON-able dict:
 
     - ``required`` / ``forbidden``: collective kinds the layout's contract
@@ -359,6 +368,7 @@ def expected_comms(
         )
 
         forbidden.append("all_to_all")
+        inference = not prog.is_training
         if pp > 1:
             # only a real pipeline axis demands the relay permutes; at
             # pp == 1 the executor still emits them, but as SELF-LOOPS —
@@ -366,33 +376,70 @@ def expected_comms(
             # (an on-device copy must not inflate the bandwidth bound)
             required.append("collective_permute")
             comm = program_comm_bytes(prog, spec, mubatch_size)
+            # the executor emits BOTH directions every tick, but an
+            # inference program never reads its backward mailbox, so XLA
+            # dead-code-eliminates that whole direction (observed on the
+            # compiled census: exactly one permute survives) — the wire
+            # model and the census rule both count one direction
+            wire = comm["wire_bytes_per_device"]
+            useful = comm["useful_bytes_per_device"]
+            if inference:
+                wire //= 2
             axes["pp"] = {
                 "kind": "collective_permute",
                 "ticks": comm["num_ticks"],
                 "payload_bytes": comm["relay_payload_bytes"],
-                "bytes_per_step_per_device": comm["wire_bytes_per_device"],
-                "useful_bytes_per_step_per_device": comm[
-                    "useful_bytes_per_device"
-                ],
+                "bytes_per_step_per_device": wire,
+                "useful_bytes_per_step_per_device": useful,
             }
-        from shallowspeed_tpu.parallel.gradsync import sync_comm_bytes
-
-        if zero1:
-            # the chunked update always lowers both collectives, dp=1 included
-            required += ["reduce_scatter", "all_gather"]
-        else:
+        if inference:
+            # inference/serving program: a forward-only relay plus ONE
+            # lawful reduction — the head stage's predictions are
+            # psum-replicated over pp (executor: `lax.psum(preds, "pp")`;
+            # non-head devices contribute zeros), required at pp > 1 and
+            # allowed-but-degenerate at pp == 1. The ZeRO collectives are
+            # training-only: a reduce-scatter or all-gather in a serving
+            # program means the training lowering leaked into the
+            # inference path.
             forbidden += ["reduce_scatter", "all_gather"]
-            if dp > 1:
-                # "the DP all-reduce really is one psum" (or one per
-                # bucket): the kind must be there (leaf-count fusion makes
-                # exact UNBUCKETED op counts compiler noise — see the
-                # module docstring; the bucketed contract pins counts)
+            if pp > 1:
                 required.append("all_reduce")
-        # the dp-axis byte model (anchor or per-bucket) has ONE definition,
-        # shared with the executor's emitters: gradsync.sync_comm_bytes
-        axes["dp"] = sync_comm_bytes(
-            spec, dp, pp, zero1=zero1, plan=grad_bucket_plan
-        )
+                from shallowspeed_tpu.parallel.executor import slot_shapes
+
+                preds_bytes = (
+                    4
+                    * prog.num_micro_batches
+                    * mubatch_size
+                    * slot_shapes(spec)[-1][0]
+                )
+                axes["preds"] = {
+                    "kind": "all_reduce",
+                    "bytes_per_step_per_device": int(
+                        2 * (pp - 1) / pp * preds_bytes
+                    ),
+                }
+        else:
+            from shallowspeed_tpu.parallel.gradsync import sync_comm_bytes
+
+            if zero1:
+                # the chunked update always lowers both collectives, dp=1
+                # included
+                required += ["reduce_scatter", "all_gather"]
+            else:
+                forbidden += ["reduce_scatter", "all_gather"]
+                if dp > 1:
+                    # "the DP all-reduce really is one psum" (or one per
+                    # bucket): the kind must be there (leaf-count fusion
+                    # makes exact UNBUCKETED op counts compiler noise — see
+                    # the module docstring; the bucketed contract pins
+                    # counts)
+                    required.append("all_reduce")
+            # the dp-axis byte model (anchor or per-bucket) has ONE
+            # definition, shared with the executor's emitters:
+            # gradsync.sync_comm_bytes
+            axes["dp"] = sync_comm_bytes(
+                spec, dp, pp, zero1=zero1, plan=grad_bucket_plan
+            )
         # per-device padded compute: the tick program's FLOPs are the whole
         # pp-group's; SPMD uniformity splits them evenly across devices
         flops_per_step = program_flops(prog, spec, mubatch_size) / pp
@@ -417,6 +464,7 @@ def expected_comms(
         "pp": int(pp),
         "zero1": bool(zero1),
         "sequential": sequential,
+        "inference": bool(prog is not None and not prog.is_training),
         "required": required,
         "forbidden": forbidden,
         "axes": axes,
@@ -463,10 +511,27 @@ def check_census(census, expected, ops=None):
             )
     if "collective_permute" in expected.get("required", ()):
         n = census.get("collective_permute", {}).get("count", 0)
-        if 0 < n < 2:
+        # inference programs relay ONE direction (the backward mailbox is
+        # dead code and XLA eliminates its permute), so the both-directions
+        # rule applies to training programs only
+        if 0 < n < 2 and not expected.get("inference"):
             mismatches.append(
                 "pipeline relay must permute in BOTH directions "
                 f"(>= 2 collective-permutes); compiled program has {n}"
+            )
+    if expected.get("inference"):
+        # a forward-only program has exactly one lawful all-reduce — the
+        # preds psum over pp (it survives compilation even at pp=1,
+        # measured on the CPU backend) — so a second one means a
+        # gradient-sync collective leaked into the serving path. Zero is
+        # tolerated: a backend MAY elide the degenerate psum, and the
+        # required-kinds leg above still demands it at pp > 1.
+        n = census.get("all_reduce", {}).get("count", 0)
+        if n > 1:
+            mismatches.append(
+                "forward-only inference program must lower at most ONE "
+                f"all-reduce (the preds psum); compiled program has {n} — "
+                "a gradient sync leaked into the serving path"
             )
     mismatches += _check_bucketed_sync(census, expected, ops)
     return mismatches

@@ -40,7 +40,13 @@ human or a bench gate actually asks of a run:
   what was restored, every corrupt snapshot skipped, and the steps lost
   to replay when the stream holds the killed run's step records (feed
   the killed run's JSONL and the resumed run's concatenated, as
-  ``make recovery-smoke`` does, and the loss is measured, not guessed).
+  ``make recovery-smoke`` does, and the loss is measured, not guessed);
+- a SERVING section (schema-v5 ``request``/``serving`` records, the
+  serving engine's evidence stream): completions + drops, p50/p99
+  latency next to the analytical latency floor (inference ticks x
+  per-tick cost), offered vs achieved vs goodput rates, queue depth,
+  padding waste, and the SLO verdict against ``--slo-ms`` (or the
+  summary record's own threshold).
 
 ``--baseline`` compares throughput against another run's JSONL or a
 bench-style JSON record (``{"value": ..., "unit": "samples/s"}``, or a
@@ -112,12 +118,14 @@ def sparkline(values, width=60):
 # ---------------------------------------------------------------------------
 
 
-def build_report(records, source="", trace=None):
+def build_report(records, source="", trace=None, slo_ms=None):
     """Fold a record stream into the JSON-able report dict every renderer
     (and the baseline comparison) consumes. ``trace``: an optional
     ``trace_stats.summarize`` dict — its measured comm/compute split
     upgrades the overlap-efficiency row from the model bound to a
-    measurement."""
+    measurement. ``slo_ms``: the CLI's latency objective — overrides the
+    serving summary's own threshold for the Serving section's SLO
+    verdict."""
     epochs = [
         r for r in records if r.get("kind") == "event" and r.get("name") == "epoch"
     ]
@@ -217,6 +225,7 @@ def build_report(records, source="", trace=None):
 
     overlap = _overlap_info(audit, trace)
     reliability = _reliability_info(records, spans)
+    serving = _serving_info(records, slo_ms)
 
     return {
         "source": source,
@@ -257,6 +266,7 @@ def build_report(records, source="", trace=None):
             "halted": bool(halted),
         },
         "reliability": reliability,
+        "serving": serving,
     }
 
 
@@ -330,6 +340,68 @@ def _reliability_info(records, spans):
         "last_checkpoint_bytes": ckpts[-1].get("bytes") if ckpts else None,
         "recovery": recovery,
     }
+
+
+def _percentile(sorted_vals, q):
+    """Linear-interpolated percentile over an already-sorted list —
+    np.percentile's default method, matching the serving engine's summary
+    so the killed-run fallback and the summary agree on identical data."""
+    n = len(sorted_vals)
+    rank = q / 100.0 * (n - 1)
+    lo = int(rank)
+    hi = min(lo + 1, n - 1)
+    frac = rank - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+def _serving_info(records, slo_ms=None):
+    """Fold the schema-v5 ``request``/``serving`` records into the Serving
+    story; None when the run recorded neither (the section is then omitted
+    — pre-v5 files render exactly as before).
+
+    The LAST ``serving`` summary wins (the engine emits one per load run);
+    percentiles are recomputed from the raw ``request`` records when no
+    summary exists (a killed run keeps its per-request evidence). The SLO
+    verdict scores p99 against ``slo_ms`` (the report CLI's ``--slo-ms``),
+    falling back to the summary's own threshold; with neither, the verdict
+    says "no SLO threshold" instead of guessing."""
+    requests = [r for r in records if r.get("kind") == "request"]
+    summary = None
+    for r in records:
+        if r.get("kind") == "serving":
+            summary = {
+                k: v for k, v in r.items() if k not in ("v", "ts", "kind", "name")
+            }
+    if summary is None and not requests:
+        return None
+    ok = [r for r in requests if r.get("name") == "ok"]
+    dropped = [r for r in requests if r.get("name") == "dropped"]
+    info = dict(summary) if summary else {}
+    info.setdefault("completed", len(ok))
+    info.setdefault("dropped", len(dropped))
+    lats = sorted(
+        r["latency_s"] for r in ok if _finite(r.get("latency_s"))
+    )
+    if lats and info.get("p50_latency_s") is None:
+        # linear-interpolated percentiles, the engine summary's own
+        # definition (np.percentile default) — a rank index like
+        # int(0.99*n) would pick the MAXIMUM for any n <= 100 and let one
+        # outlier flip the SLO verdict
+        info["p50_latency_s"] = _percentile(lats, 50)
+        info["p99_latency_s"] = _percentile(lats, 99)
+    eff_slo = slo_ms if slo_ms is not None else info.get("slo_ms")
+    p99 = info.get("p99_latency_s")
+    if eff_slo is None:
+        verdict = "no SLO threshold (pass --slo-ms)"
+    elif not _finite(p99):
+        verdict = f"SLO {eff_slo:g} ms: no completed-request latencies"
+    elif p99 <= eff_slo / 1000.0:
+        verdict = f"SLO MET: p99 {p99 * 1e3:.2f} ms <= {eff_slo:g} ms"
+    else:
+        verdict = f"SLO VIOLATED: p99 {p99 * 1e3:.2f} ms > {eff_slo:g} ms"
+    info["slo_effective_ms"] = eff_slo
+    info["slo_verdict"] = verdict
+    return info
 
 
 def _overlap_info(audit, trace):
@@ -683,6 +755,65 @@ def _reliability_lines(rel, md):
     return lines
 
 
+def _serving_lines(srv, md):
+    """The Serving section: completions, latency percentiles vs the model
+    floor, goodput vs offered load, queue depth, padding waste, and the
+    SLO verdict (docs/serving.md)."""
+    if not srv:
+        return []
+    lines = ["## Serving" if md else "serving:"]
+    line = f"requests: {srv.get('completed')} completed"
+    if srv.get("dropped"):
+        line += f", {srv['dropped']} DROPPED"
+    if srv.get("dispatches") is not None:
+        line += (
+            f" over {srv['dispatches']} dispatches "
+            f"({srv.get('slots_dispatched')} slots)"
+        )
+    lines.append(line)
+    lat = (
+        f"latency: p50 {_fmt_time_s(srv.get('p50_latency_s'))}, "
+        f"p99 {_fmt_time_s(srv.get('p99_latency_s'))}"
+    )
+    if srv.get("latency_bound_s") is not None:
+        lat += (
+            f" — model floor {_fmt_time_s(srv['latency_bound_s'])}"
+            + (
+                f" ({srv['latency_bound_ticks']} ticks, "
+                f"{srv.get('latency_bound_source')})"
+                if srv.get("latency_bound_ticks") is not None
+                else f" ({srv.get('latency_bound_source')})"
+            )
+        )
+    lines.append(lat)
+    tp = []
+    if _finite(srv.get("offered_rps")):
+        tp.append(f"offered {srv['offered_rps']:g} rps")
+    if _finite(srv.get("achieved_rps")):
+        tp.append(f"achieved {srv['achieved_rps']:.1f} rps")
+    if _finite(srv.get("goodput_rps")):
+        tp.append(f"goodput {srv['goodput_rps']:.1f} rps (within SLO)")
+    if tp:
+        lines.append("throughput: " + ", ".join(tp))
+    extras = []
+    if _finite(srv.get("padding_waste")):
+        extras.append(f"padding waste {srv['padding_waste'] * 100:.1f}%")
+    if srv.get("queue_depth_max") is not None:
+        extras.append(
+            f"queue depth max {srv['queue_depth_max']}"
+            + (
+                f" (mean {srv['queue_depth_mean']:.1f})"
+                if _finite(srv.get("queue_depth_mean"))
+                else ""
+            )
+        )
+    if extras:
+        lines.append(", ".join(extras))
+    lines.append(srv.get("slo_verdict", ""))
+    lines.append("")
+    return lines
+
+
 def render(report, fmt, comparison=None):
     if fmt == "json":
         out = dict(report)
@@ -707,6 +838,7 @@ def render(report, fmt, comparison=None):
     lines.extend(_memory_lines(report.get("xla_audit"), md))
     lines.extend(_comms_lines(report.get("xla_audit"), md))
     lines.extend(_reliability_lines(report.get("reliability"), md))
+    lines.extend(_serving_lines(report.get("serving"), md))
     header = "## Span breakdown" if md else "span breakdown:"
     lines.append(header)
     if report["spans"]:
@@ -774,6 +906,13 @@ def main(argv=None):
     )
     ap.add_argument("--format", choices=("md", "text", "json"), default="md")
     ap.add_argument(
+        "--slo-ms",
+        type=float,
+        default=None,
+        help="latency objective for the Serving section's SLO verdict "
+        "(overrides the serving summary record's own threshold)",
+    )
+    ap.add_argument(
         "--threshold",
         type=float,
         default=0.10,
@@ -798,7 +937,7 @@ def main(argv=None):
         # one capture = one trace; with several, the newest wins (the
         # capture helpers timestamp their subdirs)
         trace = trace_stats.summarize(traces[-1])
-    report = build_report(records, source=args.run, trace=trace)
+    report = build_report(records, source=args.run, trace=trace, slo_ms=args.slo_ms)
     comparison = None
     if args.baseline:
         try:

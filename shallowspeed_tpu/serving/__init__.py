@@ -1,0 +1,44 @@
+"""Inference serving: request queue, continuous batching, load bench.
+
+The subsystem behind the repo's second scoreboard — tail latency under load
+(ROADMAP item 4; docs/serving.md):
+
+- ``slots``          the shared dispatch geometry: fixed ``slot_rows``-row
+                     microbatch slots + the ladder of slot counts that
+                     bounds compilation AND makes per-slot compute
+                     bitwise-stable across rung programs;
+- ``engine``         ``ServingEngine``: deadline-tagged FIFO queue,
+                     continuous batching into the session's cached
+                     inference programs, per-request accounting, schema-v5
+                     ``request``/``serving`` records + queue-depth gauge;
+- ``loadgen``        seeded Poisson arrivals, open-loop (coordinated-
+                     omission-corrected) and closed-loop drivers;
+- ``bench_serving``  the offered-load sweep: p50/p99, goodput, queue depth,
+                     padding waste, saturation knee — one versioned JSON
+                     record beside ``bench_scaling``'s;
+- ``__main__``       the serve entry point
+                     (``python -m shallowspeed_tpu.serving``): checkpoint
+                     -> engine -> seeded load, with ``--verify`` bitwise
+                     parity and ``--audit`` census enforcement.
+"""
+
+from shallowspeed_tpu.serving.engine import Request, ServingEngine
+from shallowspeed_tpu.serving.slots import (
+    DEFAULT_SLOT_LADDER,
+    DEFAULT_SLOT_ROWS,
+    pack_slots,
+    rung_for,
+    slots_needed,
+    unpack_slots,
+)
+
+__all__ = [
+    "DEFAULT_SLOT_LADDER",
+    "DEFAULT_SLOT_ROWS",
+    "Request",
+    "ServingEngine",
+    "pack_slots",
+    "rung_for",
+    "slots_needed",
+    "unpack_slots",
+]

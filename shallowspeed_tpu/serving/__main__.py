@@ -1,0 +1,224 @@
+"""Serve entry point: wire a checkpoint to the engine and drive it.
+
+    python -m shallowspeed_tpu.serving [--dp N] [--pp M] [--schedule gpipe]
+        [--checkpoint ck.npz] [--requests 200] [--rate 100] [--seed 0]
+        [--slo-ms 50] [--verify] [--audit] [--metrics-out serve.jsonl]
+
+Builds a ``TrainingSession`` on the requested layout (restoring
+``--checkpoint`` through the PR6 loader when given — any saved layout serves
+on any serving layout), wraps it in a ``ServingEngine``, and drives seeded
+Poisson load through it in open- or closed-loop mode. ``--audit`` verifies
+every compiled inference program's collective census against the
+forward-only serving contract before it serves a request; ``--verify``
+re-computes every response with a direct ``session.predict()`` of the same
+rows and demands bitwise equality — the ``make serve-smoke`` contract.
+
+Exit codes: 0 clean; 1 dropped or non-bitwise responses under --verify
+(or an audit mismatch raising out of the first dispatch).
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m shallowspeed_tpu.serving",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument(
+        "--schedule",
+        choices=["naive", "gpipe", "pipedream", "interleaved"],
+        default="gpipe",
+    )
+    ap.add_argument("--virtual-stages", type=int, default=1)
+    ap.add_argument("--global-batch-size", type=int, default=128)
+    ap.add_argument("--mubatches", type=int, default=4)
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument(
+        "--checkpoint",
+        default=None,
+        help="weights to serve (any layout's checkpoint restores onto the "
+        "serving layout)",
+    )
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--rate", type=float, default=100.0, help="offered rps")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--rows", default="1,2,3,4,8", help="request row-count choices"
+    )
+    ap.add_argument("--slo-ms", type=float, default=None)
+    ap.add_argument(
+        "--deadline-ms",
+        type=float,
+        default=None,
+        help="per-request deadline tag (default: score against --slo-ms)",
+    )
+    ap.add_argument(
+        "--closed-loop",
+        type=int,
+        default=0,
+        metavar="C",
+        help="drive a fixed population of C in-flight requests instead of "
+        "open-loop Poisson arrivals",
+    )
+    ap.add_argument(
+        "--max-slots",
+        type=int,
+        default=None,
+        help="packing capacity per dispatch (default: the ladder's top rung)",
+    )
+    ap.add_argument(
+        "--slot-rows",
+        type=int,
+        default=None,
+        help="global rows per microbatch slot (default: 8, rounded up to a "
+        "dp multiple)",
+    )
+    ap.add_argument(
+        "--slot-ladder",
+        default=None,
+        help="comma-separated slot counts per dispatch (default 1,2,4,8,16) "
+        "— bounds compiled inference programs at one per rung",
+    )
+    ap.add_argument(
+        "--verify",
+        action="store_true",
+        help="re-compute every response with a direct predict() of the same "
+        "rows and demand bitwise equality (exit 1 on any mismatch)",
+    )
+    ap.add_argument(
+        "--audit",
+        action="store_true",
+        help="census every compiled inference program against the "
+        "forward-only serving contract before the first dispatch",
+    )
+    ap.add_argument("--metrics-out", default=None)
+    args = ap.parse_args(argv)
+
+    from shallowspeed_tpu.api import TrainingSession
+    from shallowspeed_tpu.observability import JsonlMetrics
+    from shallowspeed_tpu.serving.engine import ServingEngine
+    from shallowspeed_tpu.serving.loadgen import (
+        poisson_arrivals,
+        request_payloads,
+        run_closed_loop,
+        run_open_loop,
+    )
+
+    metrics = JsonlMetrics(args.metrics_out) if args.metrics_out else None
+    session = TrainingSession(
+        dp=args.dp,
+        pp=args.pp,
+        schedule=args.schedule,
+        virtual_stages=args.virtual_stages,
+        global_batch_size=args.global_batch_size,
+        mubatches=args.mubatches,
+        data_dir=args.data_dir,
+        resume=args.checkpoint,
+        metrics=metrics,
+        audit=args.audit,
+        predict_slot_rows=args.slot_rows,
+        predict_slot_ladder=(
+            tuple(int(r) for r in args.slot_ladder.split(","))
+            if args.slot_ladder
+            else None
+        ),
+    )
+    engine = ServingEngine(
+        session,
+        max_slots=args.max_slots,
+        slo_ms=args.slo_ms,
+        metrics=metrics if metrics is not None else None,
+    )
+    payloads = request_payloads(
+        args.requests,
+        session.spec.sizes[0],
+        seed=args.seed,
+        rows_choices=tuple(int(r) for r in args.rows.split(",") if r.strip()),
+    )
+    print(
+        f"serving: DP={args.dp} x PP={args.pp} ({args.schedule}), "
+        f"slot_rows={session.slot_rows}, ladder={session.slot_ladder}, "
+        f"{args.requests} requests"
+        + (
+            f" closed-loop C={args.closed_loop}"
+            if args.closed_loop
+            else f" @ {args.rate} rps Poisson (seed {args.seed})"
+        )
+        + (f", weights from {args.checkpoint}" if args.checkpoint else "")
+    )
+    # warm every ladder rung before traffic: the measured percentiles must
+    # be serving latency, not XLA compile time (and under --audit this is
+    # also where every inference program's census gets verified)
+    engine.warm_ladder()
+    if args.closed_loop:
+        done = run_closed_loop(
+            engine, payloads, concurrency=args.closed_loop,
+            deadline_ms=args.deadline_ms,
+        )
+    else:
+        arrivals = poisson_arrivals(args.rate, args.requests, seed=args.seed)
+        done = run_open_loop(
+            engine, payloads, arrivals, deadline_ms=args.deadline_ms
+        )
+    rec = engine.record_summary(
+        offered_rps=None if args.closed_loop else args.rate
+    )
+
+    def ms(v):
+        return f"{v * 1e3:.2f} ms" if v is not None else "n/a"
+
+    print(
+        f"completed {rec['completed']}/{args.requests}, dropped "
+        f"{rec['dropped']}, {rec['dispatches']} dispatches "
+        f"({rec['slots_dispatched']} slots"
+        + (
+            f", padding waste {rec['padding_waste'] * 100:.1f}%)"
+            if rec["padding_waste"] is not None
+            else ")"
+        )
+    )
+    print(
+        f"latency p50 {ms(rec['p50_latency_s'])}, p99 "
+        f"{ms(rec['p99_latency_s'])}, model floor "
+        f"{ms(rec['latency_bound_s'])} ({rec['latency_bound_source']})"
+    )
+    if rec["goodput_rps"] is not None:
+        print(
+            f"goodput {rec['goodput_rps']:.1f} rps ({rec['slo_met']}/"
+            f"{rec['completed']} within SLO), queue depth max "
+            f"{rec['queue_depth_max']}"
+        )
+    failures = rec["dropped"]
+    if args.verify:
+        mismatched = 0
+        for req in sorted(done, key=lambda r: r.id):
+            direct = session.predict(payloads[req.id])  # ids are submit order
+            if not np.array_equal(req.result, direct):
+                mismatched += 1
+        print(
+            f"verify: {len(done) - mismatched}/{len(done)} responses "
+            "bitwise-equal to direct predict()"
+            + ("" if mismatched == 0 else f" — {mismatched} MISMATCHED")
+        )
+        failures += mismatched
+    if metrics is not None:
+        metrics.close()
+        print(f"telemetry written: {metrics.path}")
+    if failures:
+        print(
+            f"serving: {failures} dropped/incorrect response(s)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,193 @@
+"""Latency-denominated load bench: p50/p99, goodput and the saturation knee.
+
+    python -m shallowspeed_tpu.serving.bench_serving [--dp N] [--pp M]
+        [--schedule gpipe] [--rates 50,100,200,400] [--requests 100]
+        [--slo-ms 50] [--seed 0] [--out BENCH_SERVING.json]
+
+``bench_scaling`` scores the framework in samples/s; this bench opens the
+second scoreboard the ROADMAP's "millions of users" north star asks for —
+tail latency under load. For each offered rate it drives ``--requests``
+seeded Poisson arrivals through a ``ServingEngine`` in open-loop mode
+(arrivals independent of completions, enqueue backdated to scheduled
+arrival — queueing delay lands in latency, never silently throttles the
+offered load) and records p50/p99 latency, goodput (SLO-met completions per
+second), achieved rate, queue depth and padding waste. The saturation knee
+is the first rate whose tail violates the SLO or whose achieved rate falls
+measurably below the offered one — the operating ceiling every future speed
+PR is measured against.
+
+Output is ONE versioned JSON document (``bench_version`` + per-row fields,
+beside ``bench_scaling``'s records): the analytical latency floor
+(``costmodel.serving_latency_bound`` — inference ticks x per-tick cost) is
+recorded next to the measured percentiles so the gap between model and tail
+is a number, not prose.
+
+NOTE on interpretation (the honest caveat every CPU bench row in this repo
+carries): on emulated CPU devices dispatch overhead dominates the tiny MLP,
+so absolute latencies validate the machinery; the SHAPE of the sweep (flat
+-> knee -> queue blow-up) is the transferable result.
+"""
+
+import argparse
+import json
+import sys
+
+from shallowspeed_tpu.serving.engine import ServingEngine
+from shallowspeed_tpu.serving.loadgen import (
+    poisson_arrivals,
+    request_payloads,
+    run_open_loop,
+)
+
+BENCH_VERSION = 1
+SWEEP_ROW_FIELDS = (
+    "offered_rps",
+    "completed",
+    "dropped",
+    "p50_latency_s",
+    "p99_latency_s",
+    "goodput_rps",
+    "achieved_rps",
+    "queue_depth_max",
+    "queue_depth_mean",
+    "padding_waste",
+    "dispatches",
+)
+
+
+def find_knee(rows, slo_ms, achieved_fraction=0.9):
+    """The saturation knee: the first offered rate (rows are swept in
+    ascending offered order) whose p99 exceeds the SLO or whose achieved
+    rate falls below ``achieved_fraction`` x offered. None = no knee
+    inside the swept range (the verdict then says so instead of guessing)."""
+    for row in rows:
+        p99 = row.get("p99_latency_s")
+        if slo_ms is not None and p99 is not None and p99 > slo_ms / 1000.0:
+            return row["offered_rps"]
+        ach, off = row.get("achieved_rps"), row.get("offered_rps")
+        if ach is not None and off and ach < achieved_fraction * off:
+            return row["offered_rps"]
+    return None
+
+
+def sweep(
+    session,
+    rates,
+    n_requests=100,
+    seed=0,
+    slo_ms=None,
+    rows_choices=(1, 2, 3, 4, 8),
+    metrics=None,
+):
+    """Run the offered-load sweep on an existing session; returns the
+    versioned JSON-able bench record. The SAME seeded request stream is
+    replayed at every rate (only the arrival clock changes), so rows
+    differ by load, not workload."""
+    engine = ServingEngine(session, slo_ms=slo_ms, metrics=metrics)
+    # compile every rung before the sweep: the percentiles must measure
+    # serving under load, not the first rate's XLA compiles
+    engine.warm_ladder()
+    payloads = request_payloads(
+        n_requests, session.spec.sizes[0], seed=seed, rows_choices=rows_choices
+    )
+    rows = []
+    for rate in sorted(rates):
+        engine.reset_stats()
+        arrivals = poisson_arrivals(rate, n_requests, seed=seed)
+        run_open_loop(engine, payloads, arrivals)
+        rec = engine.record_summary(offered_rps=rate)
+        rows.append({k: rec.get(k) for k in SWEEP_ROW_FIELDS})
+    bound = session.inference_latency_bound()
+    return {
+        "bench": "serving",
+        "bench_version": BENCH_VERSION,
+        "config": {
+            "dp": session.dp,
+            "pp": session.pp,
+            "schedule": session.schedule,
+            "slot_rows": session.slot_rows,
+            "slot_ladder": list(session.slot_ladder),
+            "requests_per_rate": n_requests,
+            "seed": seed,
+            "slo_ms": slo_ms,
+            "rows_choices": list(rows_choices),
+        },
+        "latency_bound_s": bound["seconds"],
+        "latency_bound_ticks": bound["ticks"],
+        "latency_bound_source": bound["peak_source"],
+        "sweep": rows,
+        "knee_rps": find_knee(rows, slo_ms),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m shallowspeed_tpu.serving.bench_serving",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument(
+        "--schedule",
+        choices=["naive", "gpipe", "pipedream", "interleaved"],
+        default="gpipe",
+    )
+    ap.add_argument("--global-batch-size", type=int, default=128)
+    ap.add_argument("--mubatches", type=int, default=4)
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument(
+        "--checkpoint", default=None, help="serve these weights (PR6 loader)"
+    )
+    ap.add_argument(
+        "--rates",
+        default="50,100,200,400",
+        help="comma-separated offered loads (requests/second)",
+    )
+    ap.add_argument("--requests", type=int, default=100, help="requests per rate")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slo-ms", type=float, default=None)
+    ap.add_argument(
+        "--rows",
+        default="1,2,3,4,8",
+        help="comma-separated request row-count choices",
+    )
+    ap.add_argument("--out", default=None, help="write the JSON record here")
+    args = ap.parse_args(argv)
+
+    from shallowspeed_tpu.api import TrainingSession
+
+    session = TrainingSession(
+        dp=args.dp,
+        pp=args.pp,
+        schedule=args.schedule,
+        global_batch_size=args.global_batch_size,
+        mubatches=args.mubatches,
+        data_dir=args.data_dir,
+        resume=args.checkpoint,
+    )
+    record = sweep(
+        session,
+        rates=[float(r) for r in args.rates.split(",") if r.strip()],
+        n_requests=args.requests,
+        seed=args.seed,
+        slo_ms=args.slo_ms,
+        rows_choices=tuple(int(r) for r in args.rows.split(",") if r.strip()),
+    )
+    text = json.dumps(record, indent=2)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+        print(f"bench_serving record written: {args.out}")
+        knee = record["knee_rps"]
+        print(
+            "saturation knee: "
+            + (f"{knee} rps" if knee is not None else "not reached in sweep")
+        )
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

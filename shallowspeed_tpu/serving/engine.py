@@ -1,0 +1,386 @@
+"""Inference serving engine: request queue + continuous batching into slots.
+
+The ROADMAP's "millions of users" north star is a latency problem — requests
+arrive one at a time and must be packed into the executor's fixed microbatch
+slots on the fly, the same on-the-fly packing torchgpipe applies to training
+microbatches (arXiv 2004.09910). ``ServingEngine`` owns that loop on top of
+``TrainingSession``'s cached inference programs:
+
+- **queue**: deadline-tagged requests of variable row counts, FIFO (packing
+  is order-preserving so responses complete in arrival order — the
+  determinism the bitwise-parity contract needs; deadlines tag accounting,
+  they do not reorder);
+- **continuous batching**: each ``step()`` packs the queue's head into the
+  next dispatch — whole ``slot_rows``-row microbatch slots per request
+  (requests never share a slot), up to ``max_slots`` slots, the slot count
+  then rounded up the session's fixed ladder so at most ``len(ladder)``
+  inference programs are ever compiled;
+- **bitwise parity**: a slot's compute has one fixed shape in every rung
+  program, so each response is bitwise-equal to a direct
+  ``session.predict()`` of the same rows (measured, and asserted by
+  ``make serve-smoke`` under seeded Poisson load);
+- **steady-state weights**: every dispatch reads the SAME device-resident
+  stacked params the session holds — weights are staged once at session
+  construction and never re-transferred per request. Donation is
+  deliberately NOT used here: the params are reused by the very next
+  dispatch (and by training), so donating their buffers would be a
+  use-after-free, not an optimization — steady-state residency comes from
+  holding the arrays, the executor aliases them read-only;
+- **accounting**: per-request enqueue -> dispatch -> complete timestamps,
+  queue wait, padding waste, and a bounded queue-depth ring (the flight-
+  recorder pattern) — emitted as schema-v5 ``request`` records plus a
+  ``serving`` summary and a ``serving.queue_depth`` gauge when a metrics
+  recorder is attached (docs/serving.md, docs/observability.md). The
+  engine itself retains only SCALAR samples (latencies, waits, deadline
+  tags) between ``reset_stats()`` calls — completed ``Request`` objects,
+  with their input payloads and result arrays, are handed back to the
+  caller by ``step()``/``drain()`` and never kept, so a long-lived engine
+  does not grow with the traffic it has served.
+"""
+
+import time
+from collections import deque
+
+import numpy as np
+
+from shallowspeed_tpu.observability import NullMetrics
+from shallowspeed_tpu.serving import slots as serving_slots
+
+
+class Request:
+    """One queued inference request and its full accounting."""
+
+    __slots__ = (
+        "id",
+        "x",
+        "rows",
+        "slots",
+        "deadline_ms",
+        "enqueue_t",
+        "dispatch_t",
+        "complete_t",
+        "result",
+        "verdict",
+    )
+
+    def __init__(self, req_id, x, slots, deadline_ms, enqueue_t):
+        self.id = req_id
+        self.x = x
+        self.rows = int(x.shape[0])
+        self.slots = int(slots)
+        self.deadline_ms = deadline_ms
+        self.enqueue_t = enqueue_t
+        self.dispatch_t = None
+        self.complete_t = None
+        self.result = None  # (rows, out_dim) softmax probabilities
+        self.verdict = "queued"  # -> "ok" | "dropped"
+
+    @property
+    def latency_s(self):
+        """enqueue -> complete wall seconds (None until completed)."""
+        if self.complete_t is None:
+            return None
+        return self.complete_t - self.enqueue_t
+
+    @property
+    def queue_s(self):
+        """enqueue -> dispatch wall seconds (None until dispatched)."""
+        if self.dispatch_t is None:
+            return None
+        return self.dispatch_t - self.enqueue_t
+
+    def slo_ok(self, slo_ms=None):
+        """Did this request meet its deadline (its own tag, else the
+        engine-level SLO)? None when neither threshold exists or the
+        request never completed."""
+        bound = self.deadline_ms if self.deadline_ms is not None else slo_ms
+        if bound is None or self.latency_s is None:
+            return None
+        return self.latency_s <= bound / 1000.0
+
+
+class ServingEngine:
+    """Continuous-batching serving loop over a session's inference programs.
+
+    ``session``: a ``TrainingSession`` on any layout (its ``slot_rows`` /
+    ``slot_ladder`` fix the dispatch geometry). ``max_slots``: packing
+    capacity per dispatch (default: the ladder's top rung). ``slo_ms``: the
+    engine-level latency objective requests are scored against when they
+    carry no deadline of their own. ``max_queue``: admission bound —
+    submissions beyond it are DROPPED (recorded, returned with verdict
+    "dropped", never silently discarded); None = unbounded. ``clock`` is
+    injectable for tests.
+    """
+
+    def __init__(
+        self,
+        session,
+        max_slots=None,
+        slo_ms=None,
+        max_queue=None,
+        metrics=None,
+        clock=time.perf_counter,
+        depth_ring=4096,
+    ):
+        self._session = session
+        self._slot_rows = session.slot_rows
+        self._ladder = session.slot_ladder
+        self._max_slots = (
+            int(max_slots) if max_slots is not None else self._ladder[-1]
+        )
+        if self._max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        if self._max_slots > self._ladder[-1]:
+            # a dispatch larger than the top rung has no program to run on:
+            # step() packs up to max_slots and then rounds up the ladder,
+            # so admitting this would crash mid-traffic, not at configure
+            # time
+            raise ValueError(
+                f"max_slots {self._max_slots} exceeds the slot ladder's top "
+                f"rung {self._ladder[-1]} — extend the ladder instead"
+            )
+        self._slo_ms = slo_ms
+        self._max_queue = max_queue
+        self._metrics = metrics if metrics is not None else NullMetrics()
+        self.clock = clock
+        # sequential sessions dispatch only the OCCUPIED slots (one fixed
+        # program per slot — no rung program to round up to), so the
+        # padding accounting must not charge them the rung tail
+        self._sequential = bool(getattr(session, "sequential", False))
+        self._queue = deque()
+        self._next_id = 0
+        # the flight-recorder pattern: a bounded ring of (t, queue_depth)
+        # samples, one per submit/dispatch — the engine's constant-size
+        # "what just happened" buffer behind the queue-depth stats
+        self._depths = deque(maxlen=int(depth_ring))
+        # scalar accounting only: one (latency_s, queue_s, deadline_ms)
+        # sample per completion — never the Request itself, whose payload
+        # and result arrays belong to the caller
+        self._samples = []
+        self._first_enqueue_t = None
+        self._last_complete_t = None
+        self._dropped = 0
+        self._dispatches = 0
+        self._slots_dispatched = 0  # dispatched slots (rung-rounded on mesh)
+        self._useful_rows = 0
+
+    def warm_ladder(self, rungs=None):
+        """Compile (and dispatch once, warming the jit call cache) every
+        ladder rung's inference program before traffic arrives — the
+        serving counterpart of ``TrainingSession.warm_run``: without it the
+        first requests to hit each rung pay its compile inside their
+        latency, and a load run's percentiles measure XLA, not serving."""
+        S_rows = self._slot_rows
+        in_dim = self._session.spec.sizes[0]
+        for rung in rungs if rungs is not None else self._ladder:
+            self._session.predict(np.zeros((rung * S_rows, in_dim), np.float32))
+
+    # -- queue --------------------------------------------------------------
+
+    @property
+    def queue_depth(self):
+        return len(self._queue)
+
+    def _record_depth(self, t):
+        self._depths.append((t, len(self._queue)))
+        self._metrics.gauge("serving.queue_depth", len(self._queue))
+
+    def submit(self, x, deadline_ms=None, arrival_t=None):
+        """Enqueue one request of ``(rows, in_dim)`` inputs; returns its
+        ``Request``. ``arrival_t`` backdates the enqueue timestamp to the
+        request's scheduled arrival (the open-loop driver uses it so
+        latency counts from ARRIVAL, not from when a busy host got around
+        to submitting — the coordinated-omission correction). A request
+        larger than one dispatch (``max_slots`` slots) is refused; beyond
+        ``max_queue`` it is dropped and returned with verdict "dropped"."""
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2 or x.shape[0] < 1:
+            raise ValueError(f"request must be (rows >= 1, in_dim), got {x.shape}")
+        n_slots = serving_slots.slots_needed(x.shape[0], self._slot_rows)
+        if n_slots > self._max_slots:
+            raise ValueError(
+                f"request of {x.shape[0]} rows needs {n_slots} slots — more "
+                f"than one dispatch ({self._max_slots} slots); split it"
+            )
+        # coerce to a plain float: a numpy scalar arrival (e.g. straight
+        # from poisson_arrivals) would otherwise poison every downstream
+        # timestamp and fail the strict-JSON metrics sink
+        t = self.clock() if arrival_t is None else float(arrival_t)
+        req = Request(self._next_id, x, n_slots, deadline_ms, t)
+        self._next_id += 1
+        if self._max_queue is not None and len(self._queue) >= self._max_queue:
+            req.verdict = "dropped"
+            self._dropped += 1
+            self._record_request(req)
+            return req
+        self._queue.append(req)
+        self._record_depth(t if arrival_t is None else self.clock())
+        return req
+
+    # -- continuous batching ------------------------------------------------
+
+    def step(self):
+        """Pack the queue's head into the next inference dispatch and run
+        it; returns the completed requests ([] when the queue is empty).
+
+        Packing is FIFO and slot-granular: requests join until the next
+        one would overflow ``max_slots``, the packed slot count is rounded
+        up the ladder, and every request's rows land in its OWN slots —
+        which is why each response is bitwise-equal to a direct
+        ``predict()`` of the same rows."""
+        if not self._queue:
+            return []
+        t_d = self.clock()
+        batch, used = [], 0
+        while self._queue:
+            head = self._queue[0]
+            if batch and used + head.slots > self._max_slots:
+                break
+            self._queue.popleft()
+            head.dispatch_t = t_d
+            batch.append(head)
+            used += head.slots
+        rung = serving_slots.rung_for(used, self._ladder)
+        S_rows = self._slot_rows
+        flat = np.concatenate(
+            [
+                np.pad(r.x, ((0, r.slots * S_rows - r.rows), (0, 0)))
+                for r in batch
+            ],
+            axis=0,
+        )
+        # the session pads the tail up to the rung and dispatches the
+        # cached rung program — the same call path a direct predict() takes
+        preds = self._session.predict(flat)
+        t_c = self.clock()
+        off = 0
+        for r in batch:
+            r.result = preds[off : off + r.rows]
+            off += r.slots * S_rows
+            r.complete_t = t_c
+            r.verdict = "ok"
+            self._record_request(r)
+            self._samples.append((r.latency_s, r.queue_s, r.deadline_ms))
+            if self._first_enqueue_t is None or r.enqueue_t < self._first_enqueue_t:
+                self._first_enqueue_t = r.enqueue_t
+            if self._last_complete_t is None or t_c > self._last_complete_t:
+                self._last_complete_t = t_c
+        self._dispatches += 1
+        # mesh dispatches pay the rung program's full slot count; a
+        # sequential dispatch runs exactly the occupied slots
+        self._slots_dispatched += used if self._sequential else rung
+        self._useful_rows += sum(r.rows for r in batch)
+        self._record_depth(t_c)
+        return batch
+
+    def drain(self):
+        """Serve until the queue is empty; returns everything completed."""
+        done = []
+        while self._queue:
+            done.extend(self.step())
+        return done
+
+    def _record_request(self, req):
+        self._metrics.request(
+            req.verdict,
+            id=req.id,
+            rows=req.rows,
+            slots=req.slots,
+            enqueue_ts=req.enqueue_t,
+            dispatch_ts=req.dispatch_t,
+            complete_ts=req.complete_t,
+            latency_s=req.latency_s,
+            queue_s=req.queue_s,
+            deadline_ms=req.deadline_ms,
+            slo_ok=req.slo_ok(self._slo_ms),
+        )
+
+    # -- accounting ---------------------------------------------------------
+
+    def stats(self):
+        """Aggregate accounting over everything served since the last
+        ``reset_stats()`` — the field set of the schema-v5 ``serving``
+        summary record (all plain scalars, folded from the per-completion
+        scalar samples; no served payload is retained)."""
+        lats = [lat for lat, _, _ in self._samples]
+        queues = [q for _, q, _ in self._samples]
+        # per-request deadline tag wins over the engine SLO; with neither,
+        # the verdict is None — Request.slo_ok's exact semantics
+        slo_flags = []
+        for lat, _, dl in self._samples:
+            bound = dl if dl is not None else self._slo_ms
+            slo_flags.append(
+                None if bound is None or lat is None else lat <= bound / 1000.0
+            )
+        window = None
+        if self._samples:
+            window = float(self._last_complete_t - self._first_enqueue_t)
+        padded_rows = self._slots_dispatched * self._slot_rows
+        depths = [d for _, d in self._depths]
+        met = sum(1 for ok in slo_flags if ok)
+        return {
+            "completed": len(self._samples),
+            "dropped": self._dropped,
+            "dispatches": self._dispatches,
+            "slots_dispatched": self._slots_dispatched,
+            "useful_rows": self._useful_rows,
+            "padding_waste": (
+                1.0 - self._useful_rows / padded_rows if padded_rows else None
+            ),
+            "p50_latency_s": _pct(lats, 50),
+            "p99_latency_s": _pct(lats, 99),
+            "max_latency_s": max(lats) if lats else None,
+            "mean_queue_s": (sum(queues) / len(queues)) if queues else None,
+            "window_s": window,
+            "achieved_rps": (
+                len(self._samples) / window if window else None
+            ),
+            # goodput: completions that met their deadline/SLO, per second
+            # of the serving window (None when no threshold exists — an
+            # unmeasured goodput must not read as a perfect one)
+            "goodput_rps": (
+                met / window
+                if window and any(ok is not None for ok in slo_flags)
+                else None
+            ),
+            "slo_ms": self._slo_ms,
+            "slo_met": met if any(ok is not None for ok in slo_flags) else None,
+            "queue_depth_max": max(depths) if depths else 0,
+            "queue_depth_mean": (
+                sum(depths) / len(depths) if depths else 0.0
+            ),
+        }
+
+    def record_summary(self, offered_rps=None, name="summary"):
+        """Emit (and return) the schema-v5 ``serving`` summary record:
+        ``stats()`` plus the offered load and the analytical latency floor
+        (``costmodel.serving_latency_bound`` — ticks x per-tick cost)."""
+        rec = self.stats()
+        rec["offered_rps"] = offered_rps
+        rec["slot_rows"] = self._slot_rows
+        rec["max_slots"] = self._max_slots
+        bound = self._session.inference_latency_bound()
+        rec["latency_bound_s"] = bound["seconds"]
+        rec["latency_bound_ticks"] = bound["ticks"]
+        rec["latency_bound_source"] = bound["peak_source"]
+        self._metrics.serving(name, **rec)
+        return rec
+
+    def reset_stats(self):
+        """Clear the accounting (the bench sweep's per-rate boundary);
+        queued requests are unaffected."""
+        self._samples = []
+        self._first_enqueue_t = None
+        self._last_complete_t = None
+        self._depths.clear()
+        self._dropped = 0
+        self._dispatches = 0
+        self._slots_dispatched = 0
+        self._useful_rows = 0
+
+
+def _pct(values, q):
+    values = [v for v in values if v is not None]
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values, np.float64), q))

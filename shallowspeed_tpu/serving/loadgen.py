@@ -1,0 +1,95 @@
+"""Load generation for the serving engine: seeded arrivals + drive modes.
+
+Everything is seeded and deterministic — two runs with the same seed offer
+the identical request stream (sizes, contents, arrival times), which is what
+lets ``make serve-smoke`` assert bitwise response parity under load and the
+bench sweep compare rates on the same workload.
+
+Two drive modes (the classic load-testing pair):
+
+- **open loop** (``run_open_loop``): requests arrive on a Poisson schedule
+  REGARDLESS of completions — the arrival process models independent users,
+  so queueing delay shows up as latency instead of silently throttling the
+  offered load. Enqueue timestamps are backdated to the scheduled arrival
+  (the coordinated-omission correction): a request that arrived while the
+  engine was busy is charged its full wait.
+- **closed loop** (``run_closed_loop``): a fixed population of
+  ``concurrency`` outstanding requests, each completion immediately
+  replaced — measures the engine's sustainable service rate with bounded
+  queue depth.
+"""
+
+import time
+
+import numpy as np
+
+
+def poisson_arrivals(rate_rps, n, seed=0):
+    """``n`` seeded Poisson arrival times (seconds from start): cumulative
+    exponential interarrivals at ``rate_rps`` requests/second."""
+    if rate_rps <= 0:
+        raise ValueError("rate_rps must be positive")
+    if n < 1:
+        raise ValueError("n must be >= 1")
+    rng = np.random.RandomState(seed)
+    return np.cumsum(rng.exponential(1.0 / rate_rps, size=n))
+
+
+def request_payloads(n, in_dim, seed=0, rows_choices=(1, 2, 3, 4, 8), data=None):
+    """``n`` seeded variable-size request payloads, each ``(rows, in_dim)``
+    float32 with ``rows`` drawn from ``rows_choices``. ``data``: an
+    optional ``(N, in_dim)`` pool (e.g. the validation split) to sample
+    real rows from; default is standard-normal synthetic inputs."""
+    rng = np.random.RandomState(seed)
+    sizes = rng.choice(list(rows_choices), size=n)
+    payloads = []
+    for rows in sizes:
+        if data is not None:
+            idx = rng.randint(0, data.shape[0], size=int(rows))
+            payloads.append(np.asarray(data[idx], np.float32))
+        else:
+            payloads.append(rng.randn(int(rows), in_dim).astype(np.float32))
+    return payloads
+
+
+def run_open_loop(
+    engine, payloads, arrivals, deadline_ms=None, sleep=time.sleep
+):
+    """Replay ``payloads`` against the engine on the ``arrivals`` schedule
+    (seconds from start, one per payload); returns the completed requests.
+
+    Single-threaded approximation of an open-loop client: all due arrivals
+    are submitted (backdated to their scheduled time), then one batching
+    step serves the queue's head; the host sleeps only when idle. The
+    engine drains fully before returning."""
+    if len(payloads) != len(arrivals):
+        raise ValueError("one arrival time per payload")
+    t0 = engine.clock()
+    done, i, n = [], 0, len(payloads)
+    while i < n or engine.queue_depth:
+        now = engine.clock() - t0
+        while i < n and arrivals[i] <= now:
+            engine.submit(
+                payloads[i], deadline_ms=deadline_ms, arrival_t=t0 + arrivals[i]
+            )
+            i += 1
+        if engine.queue_depth:
+            done.extend(engine.step())
+        elif i < n:
+            sleep(max(0.0, arrivals[i] - (engine.clock() - t0)))
+    return done
+
+
+def run_closed_loop(engine, payloads, concurrency=4, deadline_ms=None):
+    """Drive a fixed in-flight population: keep ``concurrency`` requests
+    queued, submitting the next as completions free slots; returns the
+    completed requests."""
+    if concurrency < 1:
+        raise ValueError("concurrency must be >= 1")
+    done, i, n = [], 0, len(payloads)
+    while i < n or engine.queue_depth:
+        while i < n and engine.queue_depth < concurrency:
+            engine.submit(payloads[i], deadline_ms=deadline_ms)
+            i += 1
+        done.extend(engine.step())
+    return done

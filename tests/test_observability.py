@@ -106,6 +106,8 @@ def test_null_metrics_hot_path_zero_net_allocation():
             m.audit("a")  # the v3 audit hook keeps the guarantee too
             m.checkpoint("c")  # ... and the v4 fault-tolerance hooks
             m.recovery("r")
+            m.request("q")  # ... and the v5 serving hooks
+            m.serving("s")
 
     burst(100)  # warm up caches (method cache, code objects)
     # background threads (XLA's pools) can allocate a handful of blocks at
@@ -587,7 +589,6 @@ def test_schema_v4_checkpoint_and_recovery_kinds(tmp_path):
     with the version stamp, the v4 reader accepts v1-v3 files unchanged
     (the refusal stays one-directional), and NullMetrics no-ops the new
     hooks."""
-    assert SCHEMA_VERSION == 4
     path = tmp_path / "v4.jsonl"
     with JsonlMetrics(path) as m:
         m.checkpoint(
@@ -602,7 +603,7 @@ def test_schema_v4_checkpoint_and_recovery_kinds(tmp_path):
         )
     recs = read_jsonl(path)
     assert [r["kind"] for r in recs] == ["meta", "checkpoint", "recovery"]
-    assert all(r["v"] == 4 for r in recs)
+    assert all(r["v"] == SCHEMA_VERSION for r in recs)
     assert recs[1]["name"] == "step" and recs[1]["global_step"] == 8
     assert recs[2]["name"] == "resumed"
     assert recs[2]["skipped"][0]["cause"] == "content checksum mismatch"
@@ -622,6 +623,61 @@ def test_schema_v4_checkpoint_and_recovery_kinds(tmp_path):
     n = NullMetrics()
     n.checkpoint("step", global_step=8)
     n.recovery("resumed", global_step=8)
+
+
+def test_schema_v5_request_and_serving_kinds(tmp_path):
+    """Schema v5 (additive): the request/serving record kinds round-trip
+    with the version stamp AND the non-finite sanitizer, the v5 reader
+    accepts v1-v4 files unchanged, a v6 file is refused (the strict check
+    stays one-directional), and NullMetrics no-ops the new hooks."""
+    assert SCHEMA_VERSION == 5
+    path = tmp_path / "v5.jsonl"
+    with JsonlMetrics(path) as m:
+        m.request(
+            "ok", id=3, rows=5, slots=1, enqueue_ts=1.0, dispatch_ts=1.5,
+            complete_ts=2.0, latency_s=1.0, queue_s=0.5, deadline_ms=None,
+            slo_ok=True,
+        )
+        m.request(
+            "dropped", id=4, rows=2, slots=1, enqueue_ts=2.0,
+            dispatch_ts=None, complete_ts=None,
+            latency_s=float("nan"),  # through the sanitizer
+            queue_s=None, deadline_ms=10.0, slo_ok=False,
+        )
+        m.serving(
+            "summary", completed=7, dropped=1, offered_rps=100.0,
+            p50_latency_s=0.01, p99_latency_s=float("inf"),
+            goodput_rps=88.0, padding_waste=0.25, queue_depth_max=3,
+        )
+    recs = read_jsonl(path)
+    assert [r["kind"] for r in recs] == ["meta", "request", "request", "serving"]
+    assert all(r["v"] == SCHEMA_VERSION for r in recs)
+    assert recs[1]["name"] == "ok" and recs[1]["slo_ok"] is True
+    assert recs[2]["name"] == "dropped" and recs[2]["latency_s"] == "NaN"
+    assert recs[3]["p99_latency_s"] == "Infinity"
+    assert recs[3]["goodput_rps"] == 88.0
+    # every line stays STRICT JSON (no bare NaN/Infinity tokens)
+    raw = [json.loads(l, parse_constant=lambda s: (_ for _ in ()).throw(
+        ValueError(s))) for l in path.read_text().splitlines()]
+    assert len(raw) == 4
+    # v1-v4 files load unchanged under the v5 reader
+    for v, rec in (
+        (1, {"kind": "event", "name": "epoch", "epoch": 0, "loss": 0.5}),
+        (2, {"kind": "step", "name": "train", "step": 0, "loss": 0.5}),
+        (3, {"kind": "xla_audit", "name": "epoch_program", "census_ok": True}),
+        (4, {"kind": "checkpoint", "name": "step", "global_step": 8}),
+    ):
+        p = tmp_path / f"old-v{v}.jsonl"
+        p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
+        assert read_jsonl(p)[0]["kind"] == rec["kind"]
+    # one-directional refusal: a v6 file fails loudly
+    v6 = tmp_path / "v6.jsonl"
+    v6.write_text(json.dumps({"v": SCHEMA_VERSION + 1, "kind": "event"}) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        read_jsonl(v6)
+    n = NullMetrics()
+    n.request("ok", id=0, rows=1)
+    n.serving("summary", completed=1)
 
 
 def test_jsonl_multihost_shard_suffix_and_glob_read(tmp_path, monkeypatch):
