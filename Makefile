@@ -7,8 +7,8 @@ CPU_MESH = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 SHELL := /bin/bash
 
 .PHONY: test verify metrics-smoke report-smoke audit-smoke overlap-smoke \
-        split-smoke recovery-smoke serve-smoke bench-serving data train \
-        train-mesh bench bench-scaling schedules clean
+        split-smoke recovery-smoke serve-smoke chaos-smoke bench-serving \
+        data train train-mesh bench bench-scaling schedules clean
 
 test:
 	python -m pytest tests/ -q
@@ -197,6 +197,50 @@ serve-smoke:
 	    --out /tmp/serve_bench.json
 	python -c "import json; rec=json.load(open('/tmp/serve_bench.json')); assert rec['bench']=='serving' and rec['bench_version']==1; rows=rec['sweep']; assert len(rows)==2 and all(r['p50_latency_s'] and r['p99_latency_s'] is not None and r['queue_depth_max'] is not None and r['goodput_rps'] is not None for r in rows), rows; print('bench_serving: %d-rate sweep, knee=%s' % (len(rows), rec['knee_rps']))"
 	@echo "serve-smoke OK: 200 bitwise-verified Poisson requests on dp2 and gpipe-pp4, Serving section + SLO verdict rendered, bench_serving sweep recorded"
+
+# serving-layer fault tolerance end-to-end (docs/robustness.md "Serving
+# faults"): on a CPU dp2 and a gpipe-pp4 layout, train a short run that
+# leaves step checkpoints behind, then serve its step-8 snapshot under a
+# seeded chaos soak — error (dispatch raises -> re-queue + retry), slow
+# (latency spike), die (dispatch-loop crash, operator re-enters), nan
+# (poisoned weights -> unhealthy verdicts -> breaker -> breaker-triggered
+# reload) — plus one mid-traffic WATCHER hot reload onto the newer step-16
+# weights. Asserts zero silently-lost requests (every submitted id reaches
+# a terminal verdict), bitwise parity of every "ok" response vs a direct
+# predict() under the weights active at its dispatch, >=1 breaker trip
+# with >=2 reloads and a measured recovery, ZERO recompiles across the hot
+# swaps, and the report CLI rendering the Degradation subsection. Exit 0.
+chaos-smoke:
+	rm -rf /tmp/chaos; mkdir -p /tmp/chaos
+	python -c "import numpy as np; from pathlib import Path; d=Path('/tmp/chaos/data'); d.mkdir(parents=True); rng=np.random.RandomState(0); [(np.save(d/('x_'+s+'.npy'), rng.rand(n,784).astype(np.float32)), np.save(d/('y_'+s+'.npy'), np.eye(10,dtype=np.float32)[rng.randint(0,10,n)])) for s,n in (('train',256),('val',96))]"
+	set -e; for lay in dp2 pp4; do \
+	  if [ $$lay = dp2 ]; then LFLAGS="--dp 2 --mubatches 2"; SFLAGS="--dp 2"; \
+	  else LFLAGS="--pp 4 --schedule gpipe --mubatches 4"; SFLAGS="--pp 4 --schedule gpipe"; fi; \
+	  $(CPU_MESH) python train.py --data-dir /tmp/chaos/data --epochs 2 \
+	      --global-batch-size 32 --no-eval $$LFLAGS \
+	      --checkpoint-dir /tmp/chaos/ck_$$lay --checkpoint-every-steps 8 \
+	      > /tmp/chaos/$$lay.train.out; \
+	  test -f /tmp/chaos/ck_$$lay/step-00000008.npz \
+	      || { echo "$$lay: no step-8 checkpoint to serve"; exit 1; }; \
+	  test -f /tmp/chaos/ck_$$lay/step-00000016.npz \
+	      || { echo "$$lay: no step-16 checkpoint to hot-reload"; exit 1; }; \
+	  $(CPU_MESH) python -m shallowspeed_tpu.serving.bench_serving $$SFLAGS \
+	      --data-dir /tmp/chaos/data --global-batch-size 32 \
+	      --checkpoint /tmp/chaos/ck_$$lay/step-00000008.npz \
+	      --chaos "error@dispatch=2,slow@dispatch=3:ms=20,die@dispatch=4,nan@dispatch=6" \
+	      --reload-dir /tmp/chaos/ck_$$lay --reload-at 5 --breaker 2 \
+	      --retry-budget 2 --max-slots 2 --requests 60 --rates 300 \
+	      --slo-ms 2000 --seed 0 \
+	      --chaos-out /tmp/chaos/$$lay.chaos.json \
+	      --metrics-out /tmp/chaos/$$lay.jsonl; \
+	  python -c "import json,sys; p=sys.argv[1]; rec=json.load(open(p)); assert rec['bench']=='serving_chaos'; assert rec['silently_lost']==[], p+': LOST '+str(rec['silently_lost']); assert rec['parity_mismatches']==0, p+': parity mismatches'; assert rec['crashes_recovered']==1, p+': die leg did not fire/recover'; assert rec['breaker_trips']>=1 and rec['reloads']>=2, p+': no breaker-then-reload (%s trips, %s reloads)' % (rec['breaker_trips'], rec['reloads']); assert rec['recovery_s'] is not None and not rec['degraded_at_exit'], p+': did not recover'; assert rec['recompiles']==0 and rec['predict_cache_stable'], p+': hot reload recompiled'; assert rec['faults_unfired']==0, p+': unfired chaos faults'; v=rec['verdicts']; assert v.get('ok',0)>0, p+': nothing served'; print(p+': %d submitted, verdicts %s, availability %.1f%%, recovery %.0f ms' % (rec['submitted'], v, 100*rec['availability'], 1e3*rec['recovery_s']))" /tmp/chaos/$$lay.chaos.json; \
+	  python -m shallowspeed_tpu.observability.report /tmp/chaos/$$lay.jsonl \
+	      --format md --slo-ms 2000 > /tmp/chaos/$$lay.report.md; \
+	  grep -q "### Degradation" /tmp/chaos/$$lay.report.md; \
+	  grep -q "breaker: 1 trip" /tmp/chaos/$$lay.report.md; \
+	  grep -q "availability" /tmp/chaos/$$lay.report.md; \
+	done
+	@echo "chaos-smoke OK: die/slow/nan/error + hot reload survived on dp2 and gpipe-pp4 — zero lost, bitwise parity, breaker recovered, zero recompiles, Degradation rendered"
 
 # the full offered-load sweep on the default layouts (see docs/serving.md)
 bench-serving:

@@ -876,10 +876,7 @@ class TrainingSession:
                     self._faults.fire_die(fault)  # SIGKILL never returns
                 elif fault.kind == "nan":
                     fault.fired = True
-                    if self._sequential:
-                        self._params = F.poison_nan(self._params)
-                    else:
-                        self._stacked = F.poison_nan(self._stacked)
+                    self.poison_weights()
                 fault = self._faults.first_in(g0, g0 + (k1 - k0))
             if fault is not None:
                 k1 = k0 + (fault.step - g0)  # fault lands on a boundary
@@ -1470,6 +1467,54 @@ class TrainingSession:
         if self._sequential:
             return jax.device_get(self._params)
         return E.unstack_params(self._stacked, self.spec, order=self._order)
+
+    def poison_weights(self):
+        """Fault-injection hook (faults.py): NaN one element of this
+        session's live weights — the deterministic blow-up behind the
+        training ``nan@step=N`` injection and the serving
+        ``nan@dispatch=N`` injection (both drive this one method, so the
+        poisoned state is identical either way)."""
+        if self._sequential:
+            self._params = F.poison_nan(self._params)
+        else:
+            self._stacked = F.poison_nan(self._stacked)
+
+    def load_weights(self, path):
+        """HOT-swap this session's weights from a checkpoint, between
+        dispatches, WITHOUT touching the compiled program caches: the new
+        arrays have the same shapes/shardings as the old (enforced — a
+        checkpoint of different sizes is refused), so every cached
+        epoch/run/inference program keeps dispatching with ZERO recompiles
+        — the serving engine's hot-reload contract (every response
+        dispatched after the swap is bitwise-equal to a direct
+        ``predict()`` under the new weights, and the rung program cache
+        survives; docs/robustness.md "Serving faults").
+
+        Deliberately weights-ONLY: the optimizer state, epoch/step cursor
+        and metrics numbering are untouched — this is a serving-side swap,
+        not a training resume (use ``resume=`` at construction for that).
+        Returns the checkpoint's metadata dict. Unreadable / corrupt files
+        raise ``CheckpointError`` before any state changes."""
+        host_params, loaded_spec, meta = load_checkpoint(
+            path, self.pp * self.V, self.B
+        )
+        if tuple(loaded_spec.sizes) != tuple(self.spec.sizes):
+            raise ValueError(
+                f"checkpoint sizes {loaded_spec.sizes} do not match this "
+                f"session's model sizes {self.spec.sizes} — a hot reload "
+                "must preserve every compiled program's shapes"
+            )
+        with self._metrics.span("device_put"):
+            if self._sequential:
+                self._params = jax.tree.map(jnp.asarray, host_params)
+            else:
+                # keep the session's existing flags array (identical
+                # content) — only the weight planes swap
+                self._stacked, _ = E.put_stacked(
+                    *E.stack_params(host_params, self.spec, order=self._order),
+                    self.mesh,
+                )
+        return meta
 
     def model_hash(self) -> str:
         return utils.model_hash(self.params())

@@ -422,6 +422,29 @@ def rotate_step_checkpoints(ckpt_dir, keep, trusted=()):
     return victims
 
 
+def find_newer_good(ckpt_dir, than_step=None, require_finite=True):
+    """Checkpoint-dir WATCHER discovery: the newest verifying step snapshot
+    STRICTLY newer than ``than_step`` (``None`` accepts any step). Returns
+    ``(step, path, meta, skipped)`` — ``skipped`` lists ``(path, cause)``
+    for every newer candidate that failed verification — or
+    ``(None, None, None, skipped)`` when nothing newer verifies. This is
+    ``find_latest_good`` with a freshness floor: the serving engine's hot
+    weight reload polls it between dispatches to pick up snapshots a
+    concurrent training run keeps writing, without ever re-loading the
+    snapshot it already serves."""
+    skipped = []
+    for step, p in reversed(list_step_checkpoints(ckpt_dir)):
+        if than_step is not None and step <= than_step:
+            break  # list is step-ascending: nothing older can be newer
+        try:
+            meta = verify_checkpoint(p, require_finite=require_finite)
+        except CheckpointError as e:
+            skipped.append((p, e.cause))
+            continue
+        return step, p, meta, skipped
+    return None, None, None, skipped
+
+
 def find_latest_good(ckpt_dir, require_finite=True):
     """Crash-recovery discovery: walk the step snapshots NEWEST FIRST,
     verify each (read + checksum + optional finiteness), and return

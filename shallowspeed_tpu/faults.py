@@ -8,26 +8,45 @@ the API (``TrainingSession(faults=...)``) or the environment
 (``SHALLOWSPEED_FAULTS``, so a *subprocess* train.py can be killed without
 patching it).
 
-Spec grammar — comma-separated injections, each ``kind@step=N[:mode=...]``::
+Spec grammar — comma-separated injections, each anchored to a TRAINING
+step (``kind@step=N[:mode=...]``) or a SERVING dispatch
+(``kind@dispatch=N[:mode=...][:ms=...]``)::
 
     SHALLOWSPEED_FAULTS="die@step=7:mode=sigkill"     # hard kill at step 7
     SHALLOWSPEED_FAULTS="die@step=7"                  # raise InjectedFault
     SHALLOWSPEED_FAULTS="nan@step=3"                  # NaN into the gradients
     SHALLOWSPEED_FAULTS="die@step=9,nan@step=3"       # compose
+    SHALLOWSPEED_FAULTS="error@dispatch=4"            # raise INSIDE dispatch 4
+    SHALLOWSPEED_FAULTS="slow@dispatch=6:ms=50"       # stall dispatch 6 50 ms
+    SHALLOWSPEED_FAULTS="nan@dispatch=8"              # poison served weights
 
 Steps are GLOBAL optimizer-step indices (epoch * batches_per_epoch +
-step_in_epoch — the same cursor the step checkpoints store).
+step_in_epoch — the same cursor the step checkpoints store). Dispatches
+are the serving engine's attempted-dispatch sequence numbers (every
+``step()`` that has work counts one, failures included, so a chaos spec
+replays deterministically).
 
-Injection points (all driven from the host-side step loop, never from
-inside a jitted program — an instrumented run executes the same XLA):
+Injection points (all driven from the host-side step/serving loop, never
+from inside a jitted program — an instrumented run executes the same XLA):
 
-- ``die``   fire when the run reaches step N, BEFORE step N's update:
-            ``mode=exc`` (default) raises ``InjectedFault``; ``mode=sigkill``
-            sends SIGKILL to the current process — the real preemption
-            shape, nothing flushes, no atexit runs.
-- ``nan``   poison the parameters right before step N dispatches, so step
-            N's gradients (and loss) come out NaN — the deterministic
-            blow-up the numerics health monitor exists to catch.
+- ``die``   fire when the run reaches step/dispatch N, BEFORE the update
+            or the batch pop: ``mode=exc`` (default) raises
+            ``InjectedFault``; ``mode=sigkill`` sends SIGKILL to the
+            current process — the real preemption shape, nothing flushes,
+            no atexit runs. In serving, ``mode=exc`` models the dispatch
+            loop dying: it fires before any request is popped, so the
+            queue is intact when the operator loop re-enters.
+- ``nan``   poison the parameters right before step/dispatch N, so step
+            N's gradients (training) or dispatch N's predictions
+            (serving) come out NaN — the deterministic blow-up the
+            numerics health monitor / the serving health gate exists to
+            catch.
+- ``slow``  (dispatch only) sleep ``ms`` inside dispatch N — the latency
+            spike that drives deadline shedding.
+- ``error`` (dispatch only) raise ``InjectedFault`` INSIDE the dispatch
+            wrapper, after the batch was popped — the failure shape the
+            engine's dispatch-recovery path (re-queue + bounded retry)
+            exists to survive.
 
 Checkpoint corruption is a function, not a step trigger (tests corrupt
 files directly): ``corrupt_checkpoint_bytes(path)`` flips bytes inside an
@@ -41,24 +60,44 @@ import signal
 import numpy as np
 
 ENV_VAR = "SHALLOWSPEED_FAULTS"
-KINDS = ("die", "nan")
+KINDS = ("die", "nan")  # step-triggered (training) kinds
+SERVING_KINDS = ("die", "nan", "slow", "error")  # dispatch-triggered kinds
 DIE_MODES = ("exc", "sigkill")
 
 
 class InjectedFault(RuntimeError):
-    """Raised by a ``die`` injection with ``mode=exc`` (the soft kill)."""
+    """Raised by a ``die`` injection with ``mode=exc`` (the soft kill) and
+    by a serving ``error`` injection inside the dispatch wrapper."""
 
 
 class Fault:
-    """One parsed injection: ``kind`` at global ``step`` (+ ``mode``)."""
+    """One parsed injection: ``kind`` at global ``step`` (+ ``mode``), or —
+    serving-side — at attempted-dispatch ``dispatch`` (+ ``ms`` for
+    ``slow``). Exactly one of ``step``/``dispatch`` is set; ``trigger``
+    names which ("step" / "dispatch")."""
 
-    __slots__ = ("kind", "step", "mode", "fired")
+    __slots__ = ("kind", "step", "dispatch", "mode", "ms", "fired")
 
-    def __init__(self, kind, step, mode=None):
-        if kind not in KINDS:
-            raise ValueError(f"unknown fault kind {kind!r} (have {KINDS})")
-        if step < 0:
-            raise ValueError(f"fault step must be >= 0, got {step}")
+    def __init__(self, kind, step=None, mode=None, dispatch=None, ms=None):
+        if (step is None) == (dispatch is None):
+            raise ValueError("a fault anchors to exactly one of step/dispatch")
+        if step is not None:
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown step-fault kind {kind!r} (have {KINDS})"
+                )
+            if step < 0:
+                raise ValueError(f"fault step must be >= 0, got {step}")
+        else:
+            if kind not in SERVING_KINDS:
+                raise ValueError(
+                    f"unknown dispatch-fault kind {kind!r} (have "
+                    f"{SERVING_KINDS})"
+                )
+            if dispatch < 0:
+                raise ValueError(
+                    f"fault dispatch must be >= 0, got {dispatch}"
+                )
         if kind == "die":
             mode = mode or "exc"
             if mode not in DIE_MODES:
@@ -67,14 +106,34 @@ class Fault:
                 )
         elif mode is not None:
             raise ValueError(f"fault kind {kind!r} takes no mode")
+        if kind == "slow":
+            if ms is None:
+                raise ValueError("slow faults need ms=<milliseconds>")
+            ms = float(ms)
+            if ms < 0:
+                raise ValueError(f"slow ms must be >= 0, got {ms}")
+        elif ms is not None:
+            raise ValueError(f"fault kind {kind!r} takes no ms")
         self.kind = kind
-        self.step = int(step)
+        self.step = None if step is None else int(step)
+        self.dispatch = None if dispatch is None else int(dispatch)
         self.mode = mode
+        self.ms = ms
         self.fired = False
 
+    @property
+    def trigger(self):
+        return "step" if self.step is not None else "dispatch"
+
     def __repr__(self):
+        at = (
+            f"step={self.step}"
+            if self.step is not None
+            else f"dispatch={self.dispatch}"
+        )
         mode = f":mode={self.mode}" if self.kind == "die" else ""
-        return f"{self.kind}@step={self.step}{mode}"
+        ms = f":ms={self.ms:g}" if self.kind == "slow" else ""
+        return f"{self.kind}@{at}{mode}{ms}"
 
 
 class FaultPlan:
@@ -97,11 +156,17 @@ class FaultPlan:
                 fields = dict(
                     kv.split("=", 1) for kv in rest.split(":") if kv
                 )
+                step = fields.pop("step", None)
+                dispatch = fields.pop("dispatch", None)
+                if (step is None) == (dispatch is None):
+                    raise ValueError("need exactly one of step=/dispatch=")
                 faults.append(
                     Fault(
                         kind.strip(),
-                        int(fields.pop("step")),
+                        step=None if step is None else int(step),
+                        dispatch=None if dispatch is None else int(dispatch),
                         mode=fields.pop("mode", None),
+                        ms=fields.pop("ms", None),
                     )
                 )
                 if fields:
@@ -115,16 +180,36 @@ class FaultPlan:
 
     @property
     def pending(self):
-        """Injections that have not fired yet — non-empty means the run
-        still needs step boundaries (``train_steps``) for them to land."""
-        return [f for f in self.faults if not f.fired]
+        """STEP-triggered injections that have not fired yet — non-empty
+        means the run still needs step boundaries (``train_steps``) for
+        them to land. Dispatch-triggered (serving) faults are excluded:
+        they land in the serving engine's dispatch loop, so a training
+        entry point must not refuse a run over them."""
+        return [f for f in self.faults if not f.fired and f.step is not None]
+
+    @property
+    def pending_dispatch(self):
+        """Dispatch-triggered injections that have not fired yet."""
+        return [
+            f for f in self.faults if not f.fired and f.dispatch is not None
+        ]
 
     def first_in(self, lo, hi):
-        """Earliest un-fired fault with ``lo <= step < hi``, or None — the
-        step loop truncates its dispatch chunks at this boundary so every
-        injection lands exactly on its step."""
-        pending = [f for f in self.faults if not f.fired and lo <= f.step < hi]
+        """Earliest un-fired STEP fault with ``lo <= step < hi``, or None —
+        the step loop truncates its dispatch chunks at this boundary so
+        every injection lands exactly on its step."""
+        pending = [f for f in self.pending if lo <= f.step < hi]
         return min(pending, key=lambda f: f.step) if pending else None
+
+    def due_at_dispatch(self, n):
+        """Un-fired dispatch faults scheduled AT OR BEFORE attempted
+        dispatch ``n``, in spec order — the serving engine fires each
+        exactly once. The <= (not ==) anchor is the serving mirror of the
+        step loop's fire-loop: a fault whose exact dispatch was consumed
+        by a same-dispatch ``die`` (or by a dispatch that only shed
+        expired requests) fires on the next attempt instead of silently
+        never."""
+        return [f for f in self.pending_dispatch if f.dispatch <= n]
 
     def fire_die(self, fault):
         """Execute a ``die`` fault: SIGKILL the process (nothing flushes —

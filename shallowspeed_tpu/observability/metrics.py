@@ -47,13 +47,23 @@ Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
      "resumed_from": path|null, "epoch": e, "step_in_epoch": s,
      "global_step": g, "skipped": [...], **fields}                   [v4+]
     {"v": 5, "ts": ..., "kind": "request",   "name": <verdict: "ok"|
-     "dropped">, "id": i, "rows": n, "slots": k, "enqueue_ts": ...,
-     "dispatch_ts": ..., "complete_ts": ..., "latency_s": ...,
-     "queue_s": ..., "deadline_ms": ..., "slo_ok": bool|null}        [v5+]
+     "dropped"; v6 adds "expired"|"error"|"unhealthy">, "id": i,
+     "rows": n, "slots": k, "enqueue_ts": ..., "dispatch_ts": ...,
+     "complete_ts": ..., "latency_s": ..., "queue_s": ...,
+     "deadline_ms": ..., "slo_ok": bool|null, "attempts": k [v6],
+     "reason": ... [v6]}                                             [v5+]
     {"v": 5, "ts": ..., "kind": "serving",   "name": "summary",
      "completed": n, "dropped": n, "offered_rps": ..., "p50_latency_s":
      ..., "p99_latency_s": ..., "goodput_rps": ..., "padding_waste":
      ..., "queue_depth_max": ..., **fields}                          [v5+]
+    {"v": 6, "ts": ..., "kind": "serving_health", "name": <event:
+     "breaker_open"|"breaker_closed"|"unhealthy_dispatch"|
+     "dispatch_error"|"fault_injected">, "dispatch": n,
+     "consecutive_failures": k, **fields}                            [v6+]
+    {"v": 6, "ts": ..., "kind": "reload",    "name": <verdict: "ok"|
+     "failed"|"none_newer">, "path": ..., "step": ..., "reason":
+     "breaker"|"watch"|"manual", "wall_s": ..., "programs_cached": n,
+     **fields}                                                       [v6+]
 
 Schema compatibility rules (SCHEMA_VERSION history):
 
@@ -85,6 +95,19 @@ Schema compatibility rules (SCHEMA_VERSION history):
   (shallowspeed_tpu/serving/, docs/serving.md). No existing kind or
   field changed meaning; the v5 reader accepts v1–v4 files unchanged
   and the strict refusal stays one-directional (a v6 file is refused).
+- v6  ADDITIVE: the ``serving_health`` (one serving degradation event —
+  a failed or non-finite dispatch, a breaker trip or recovery, an
+  injected chaos fault — named by the event) and ``reload`` (one hot
+  weight-reload decision, named by its verdict, carrying the snapshot
+  path/step, the trigger reason and the surviving compiled-program
+  count) kinds — the evidence stream behind the report CLI's
+  Degradation subsection (docs/robustness.md "Serving faults"). The
+  ``request`` kind additionally gains the terminal verdicts
+  ``expired``/``error``/``unhealthy`` as record NAMES plus the additive
+  ``attempts``/``reason`` fields (new names/fields on an existing kind
+  — lawful under the ignore-unknown-fields rule; no existing
+  name/field changed meaning). The v6 reader accepts v1–v5 files
+  unchanged; a v7 file is refused.
 
 The contract for future bumps: additive kinds/fields bump the version and
 must keep old records readable; any change to an EXISTING kind's meaning
@@ -109,7 +132,7 @@ import time
 
 from shallowspeed_tpu.observability.spans import Span
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 SCHEMA_NAME = "shallowspeed_tpu.metrics"
 
 
@@ -173,6 +196,12 @@ class NullMetrics:
         pass
 
     def serving(self, name, **fields):
+        pass
+
+    def serving_health(self, name, **fields):
+        pass
+
+    def reload(self, name, **fields):
         pass
 
     def flush(self):
@@ -262,6 +291,12 @@ class MetricsRecorder:
 
     def serving(self, name, **fields):
         self._emit({"kind": "serving", "name": name, **fields})
+
+    def serving_health(self, name, **fields):
+        self._emit({"kind": "serving_health", "name": name, **fields})
+
+    def reload(self, name, **fields):
+        self._emit({"kind": "reload", "name": name, **fields})
 
     # -- recorder-internal hooks --------------------------------------------
 

@@ -46,7 +46,11 @@ human or a bench gate actually asks of a run:
   latency next to the analytical latency floor (inference ticks x
   per-tick cost), offered vs achieved vs goodput rates, queue depth,
   padding waste, and the SLO verdict against ``--slo-ms`` (or the
-  summary record's own threshold).
+  summary record's own threshold) — plus a DEGRADATION subsection
+  (schema-v6 ``serving_health``/``reload`` records and the terminal
+  failure verdicts): shed/error/unhealthy counts, injected faults,
+  breaker trips + hot reloads, the measured recovery time, and the
+  availability verdict. Clean runs and pre-v6 files render unchanged.
 
 ``--baseline`` compares throughput against another run's JSONL or a
 bench-style JSON record (``{"value": ..., "unit": "samples/s"}``, or a
@@ -379,6 +383,14 @@ def _serving_info(records, slo_ms=None):
     info = dict(summary) if summary else {}
     info.setdefault("completed", len(ok))
     info.setdefault("dropped", len(dropped))
+    # the v6 terminal verdicts: prefer the summary's own counters, fall
+    # back to counting raw request records (a killed run's evidence)
+    for verdict in ("expired", "errors", "unhealthy"):
+        name = verdict.rstrip("s") if verdict == "errors" else verdict
+        if info.get(verdict) is None:
+            n = sum(1 for r in requests if r.get("name") == name)
+            info[verdict] = n
+    info["degradation"] = _degradation_info(records, info)
     lats = sorted(
         r["latency_s"] for r in ok if _finite(r.get("latency_s"))
     )
@@ -402,6 +414,91 @@ def _serving_info(records, slo_ms=None):
     info["slo_effective_ms"] = eff_slo
     info["slo_verdict"] = verdict
     return info
+
+
+def _degradation_info(records, srv):
+    """Fold the schema-v6 ``serving_health``/``reload`` records plus the
+    terminal failure verdicts into the Serving section's Degradation
+    story; None when the run shows no degradation evidence at all (clean
+    runs — and every pre-v6 file — render exactly as before).
+
+    ``availability`` is ok / every-terminal-verdict; the recovery time
+    prefers the engine's own measurement (breaker-open -> first served
+    response, in the summary) and falls back to the record timestamps
+    (first ``breaker_open`` -> first subsequent successful ``reload``)."""
+    health = [r for r in records if r.get("kind") == "serving_health"]
+    reloads = [r for r in records if r.get("kind") == "reload"]
+    shed = srv.get("expired") or 0
+    errors = srv.get("errors") or 0
+    unhealthy = srv.get("unhealthy") or 0
+    trips = srv.get("breaker_trips")
+    if trips is None:
+        trips = sum(1 for r in health if r.get("name") == "breaker_open")
+    n_reloads = srv.get("reloads")
+    if n_reloads is None:
+        n_reloads = sum(1 for r in reloads if r.get("name") == "ok")
+    if not (health or reloads or shed or errors or unhealthy):
+        return None
+    recovery_s = srv.get("recovery_s")
+    opens = [r.get("ts") for r in health if r.get("name") == "breaker_open"]
+    if recovery_s is None and opens and _finite(opens[0]):
+        after = [
+            r.get("ts")
+            for r in reloads
+            if r.get("name") == "ok"
+            and _finite(r.get("ts"))
+            and r["ts"] >= opens[0]
+        ]
+        if after:
+            recovery_s = after[0] - opens[0]
+    closed = [r for r in health if r.get("name") == "breaker_closed"]
+    degraded = srv.get("degraded")
+    if degraded is None:
+        # record-order fallback: an open with no close after it
+        last_open = max(
+            (i for i, r in enumerate(health) if r.get("name") == "breaker_open"),
+            default=None,
+        )
+        last_close = max(
+            (i for i, r in enumerate(health) if r.get("name") == "breaker_closed"),
+            default=None,
+        )
+        degraded = last_open is not None and (
+            last_close is None or last_close < last_open
+        )
+    injected = sum(1 for r in health if r.get("name") == "fault_injected")
+    avail = srv.get("availability")
+    if avail is None:
+        # killed-run fallback: fold availability from the raw verdict
+        # counts when no serving summary landed
+        ok_n = srv.get("completed") or 0
+        terminal = ok_n + (srv.get("dropped") or 0) + shed + errors + unhealthy
+        avail = ok_n / terminal if terminal else None
+    if degraded:
+        verdict = "DEGRADED at exit: breaker open, admission refused"
+    elif trips:
+        verdict = "recovered: breaker closed" + (
+            f" ({_fmt_time_s(recovery_s)} to first served response)"
+            if recovery_s is not None
+            else ""
+        )
+    else:
+        verdict = "no breaker trips"
+    return {
+        "shed_expired": shed,
+        "errors": errors,
+        "unhealthy": unhealthy,
+        "retries": srv.get("retries"),
+        "failed_dispatches": srv.get("failed_dispatches"),
+        "faults_injected": injected,
+        "breaker_trips": trips,
+        "breaker_closed_events": len(closed),
+        "reloads": n_reloads,
+        "recovery_s": recovery_s,
+        "availability": avail,
+        "degraded_at_exit": bool(degraded),
+        "verdict": verdict,
+    }
 
 
 def _overlap_info(audit, trace):
@@ -765,6 +862,12 @@ def _serving_lines(srv, md):
     line = f"requests: {srv.get('completed')} completed"
     if srv.get("dropped"):
         line += f", {srv['dropped']} DROPPED"
+    if srv.get("expired"):
+        line += f", {srv['expired']} expired"
+    if srv.get("errors"):
+        line += f", {srv['errors']} ERRORED"
+    if srv.get("unhealthy"):
+        line += f", {srv['unhealthy']} UNHEALTHY"
     if srv.get("dispatches") is not None:
         line += (
             f" over {srv['dispatches']} dispatches "
@@ -810,6 +913,34 @@ def _serving_lines(srv, md):
     if extras:
         lines.append(", ".join(extras))
     lines.append(srv.get("slo_verdict", ""))
+    deg = srv.get("degradation")
+    if deg:
+        lines.append("")
+        lines.append("### Degradation" if md else "degradation:")
+        counts = (
+            f"shed (expired) {deg['shed_expired']}, errors {deg['errors']}, "
+            f"unhealthy {deg['unhealthy']}"
+        )
+        if deg.get("retries"):
+            counts += f", {deg['retries']} retried dispatch slot(s)"
+        if deg.get("faults_injected"):
+            counts += f", {deg['faults_injected']} fault(s) injected"
+        lines.append(counts)
+        breaker = (
+            f"breaker: {deg['breaker_trips']} trip(s), "
+            f"{deg['reloads']} hot reload(s)"
+        )
+        if deg.get("recovery_s") is not None:
+            breaker += f", recovery {_fmt_time_s(deg['recovery_s'])}"
+        lines.append(breaker)
+        avail = deg.get("availability")
+        lines.append(
+            (
+                f"availability {avail * 100:.1f}% — {deg['verdict']}"
+                if _finite(avail)
+                else deg["verdict"]
+            )
+        )
     lines.append("")
     return lines
 

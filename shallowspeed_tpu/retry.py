@@ -13,7 +13,11 @@ Three consumers share this module so their retry behaviour can never drift:
   ``scripts/tpu_capture.py`` fronts — sleeps ``backoff_delay`` between
   probes). The motivating incident: the tunnel watcher hammered a dead
   tunnel on a fixed 10-minute cadence for 48 consecutive probes; bounded
-  growth + jitter probes often early and rarely late instead.
+  growth + jitter probes often early and rarely late instead;
+- the serving engine's dispatch recovery (``serving/engine.py`` re-queues
+  a failed batch and retries each request under a ``RetryPolicy`` budget —
+  the policy as a VALUE, for consumers that own their own retry loop —
+  and its hot weight reload reads checkpoints through ``retry_call``).
 
 Policy: delay for attempt ``i`` (0-based, i.e. before retry ``i+1``) is
 ``min(base * factor**i, max_delay)`` plus uniform jitter in
@@ -59,6 +63,58 @@ def backoff_delay(
 def backoff_delays(attempts, **kwargs):
     """The full schedule: ``[backoff_delay(0), ..., backoff_delay(n-1)]``."""
     return [backoff_delay(i, **kwargs) for i in range(attempts)]
+
+
+class RetryPolicy:
+    """The backoff policy as a value: a bounded total-attempts budget plus
+    the ``backoff_delay`` schedule, passable to consumers that own their
+    own retry loop (the serving engine's dispatch recovery re-queues a
+    failed batch and retries it on a LATER ``step()`` call, so it cannot
+    hand control to ``retry_call`` — but its budget and delays must follow
+    the same policy every other retry in this repo follows).
+
+    ``attempts`` is the TOTAL budget, ``retry_call``'s exact contract: a
+    unit of work may run at most ``attempts`` times, with ``delay(i)``
+    seconds before retry ``i + 1``. ``base=0`` (the serving default) makes
+    every delay 0 — bounded retries, no stall."""
+
+    __slots__ = ("attempts", "base", "factor", "max_delay", "jitter", "seed")
+
+    def __init__(
+        self, attempts=3, base=0.1, factor=2.0, max_delay=5.0, jitter=0.1,
+        seed=None,
+    ):
+        if attempts < 1:
+            raise ValueError("attempts must be >= 1")
+        self.attempts = int(attempts)
+        self.base = base
+        self.factor = factor
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self.seed = seed
+        # validate eagerly — a bad policy must fail at configure time,
+        # not on the first failure it was meant to absorb
+        backoff_delay(
+            0, base=base, factor=factor, max_delay=max_delay, jitter=jitter,
+            seed=seed,
+        )
+
+    def delay(self, attempt):
+        """Seconds to wait before retry ``attempt + 1`` (0-based)."""
+        return backoff_delay(
+            attempt, base=self.base, factor=self.factor,
+            max_delay=self.max_delay, jitter=self.jitter, seed=self.seed,
+        )
+
+    def exhausted(self, attempts_used):
+        """True once ``attempts_used`` has consumed the whole budget."""
+        return attempts_used >= self.attempts
+
+    def __repr__(self):
+        return (
+            f"RetryPolicy(attempts={self.attempts}, base={self.base}, "
+            f"factor={self.factor}, max_delay={self.max_delay})"
+        )
 
 
 def retry_call(

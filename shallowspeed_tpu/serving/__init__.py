@@ -10,16 +10,24 @@ The subsystem behind the repo's second scoreboard — tail latency under load
 - ``engine``         ``ServingEngine``: deadline-tagged FIFO queue,
                      continuous batching into the session's cached
                      inference programs, per-request accounting, schema-v5
-                     ``request``/``serving`` records + queue-depth gauge;
+                     ``request``/``serving`` records + queue-depth gauge —
+                     and the graceful-degradation layer (dispatch recovery
+                     with a bounded retry budget, deadline shedding,
+                     health-gated responses, a consecutive-failure breaker,
+                     hot weight reload; docs/robustness.md "Serving
+                     faults");
 - ``loadgen``        seeded Poisson arrivals, open-loop (coordinated-
-                     omission-corrected) and closed-loop drivers;
+                     omission-corrected) and closed-loop drivers, each
+                     with the graceful-drain ``should_stop`` hook;
 - ``bench_serving``  the offered-load sweep: p50/p99, goodput, queue depth,
                      padding waste, saturation knee — one versioned JSON
-                     record beside ``bench_scaling``'s;
+                     record beside ``bench_scaling``'s — plus the seeded
+                     ``chaos_soak`` behind ``make chaos-smoke``;
 - ``__main__``       the serve entry point
                      (``python -m shallowspeed_tpu.serving``): checkpoint
                      -> engine -> seeded load, with ``--verify`` bitwise
-                     parity and ``--audit`` census enforcement.
+                     parity, ``--audit`` census enforcement, ``--faults``
+                     chaos injection and SIGTERM/SIGINT graceful drain.
 """
 
 from shallowspeed_tpu.serving.engine import Request, ServingEngine

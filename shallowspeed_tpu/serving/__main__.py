@@ -3,6 +3,7 @@
     python -m shallowspeed_tpu.serving [--dp N] [--pp M] [--schedule gpipe]
         [--checkpoint ck.npz] [--requests 200] [--rate 100] [--seed 0]
         [--slo-ms 50] [--verify] [--audit] [--metrics-out serve.jsonl]
+        [--faults SPEC] [--retry-budget 2] [--breaker 3]
 
 Builds a ``TrainingSession`` on the requested layout (restoring
 ``--checkpoint`` through the PR6 loader when given — any saved layout serves
@@ -10,17 +11,63 @@ on any serving layout), wraps it in a ``ServingEngine``, and drives seeded
 Poisson load through it in open- or closed-loop mode. ``--audit`` verifies
 every compiled inference program's collective census against the
 forward-only serving contract before it serves a request; ``--verify``
-re-computes every response with a direct ``session.predict()`` of the same
-rows and demands bitwise equality — the ``make serve-smoke`` contract.
+re-computes every ``"ok"`` response with a direct ``session.predict()`` of
+the same rows and demands bitwise equality — the ``make serve-smoke``
+contract. ``--faults`` injects the chaos plan (``@dispatch=`` grammar,
+docs/robustness.md; also read from ``SHALLOWSPEED_FAULTS``, so a
+subprocess can be killed without patching it). The loadgen drivers are
+the operator loop: an injected ``die`` (mode=exc) is absorbed and the
+loop re-enters with the queue intact, while ``mode=sigkill`` kills the
+process honestly — the per-record-flushed JSONL keeps everything up to
+the kill.
 
-Exit codes: 0 clean; 1 dropped or non-bitwise responses under --verify
-(or an audit mismatch raising out of the first dispatch).
+Graceful drain: SIGTERM/SIGINT stop ADMISSION (no further requests are
+submitted), drain everything already queued to a terminal verdict, flush
+the metrics sink, and exit under the normal code contract — a preempted
+server loses nothing it accepted.
+
+Exit codes (aligned with train.py's documented contract):
+  0  clean — including a signal-drained run whose accepted requests all
+     served;
+  1  failed responses: dropped / expired / error / unhealthy verdicts, or
+     a bitwise mismatch under --verify (or an audit mismatch raising out
+     of warm-up);
+  2  usage errors (argparse);
+  3  DEGRADED at exit — the health breaker is still open (train.py's 3 is
+     the health-monitor halt; this is its serving mirror).
 """
 
 import argparse
+import signal
 import sys
 
 import numpy as np
+
+
+class GracefulStop:
+    """The SIGTERM/SIGINT latch: ``install()`` registers both handlers
+    (remembering the previous ones for ``restore()`` — the entry point is
+    also invoked in-process by tests), the drivers poll ``stop()``."""
+
+    def __init__(self):
+        self.signum = None
+        self._previous = {}
+
+    def _handle(self, signum, frame):
+        self.signum = signum
+
+    def stop(self):
+        return self.signum is not None
+
+    def install(self):
+        for s in (signal.SIGTERM, signal.SIGINT):
+            self._previous[s] = signal.signal(s, self._handle)
+        return self
+
+    def restore(self):
+        for s, h in self._previous.items():
+            signal.signal(s, h)
+        self._previous.clear()
 
 
 def main(argv=None):
@@ -57,7 +104,8 @@ def main(argv=None):
         "--deadline-ms",
         type=float,
         default=None,
-        help="per-request deadline tag (default: score against --slo-ms)",
+        help="per-request deadline tag (default: score against --slo-ms); "
+        "expired deadlines are SHED with verdict 'expired' at pack time",
     )
     ap.add_argument(
         "--closed-loop",
@@ -87,10 +135,30 @@ def main(argv=None):
         "— bounds compiled inference programs at one per rung",
     )
     ap.add_argument(
+        "--faults",
+        default=None,
+        help="chaos injection spec (e.g. 'error@dispatch=4,slow@dispatch=6"
+        ":ms=50'); default: the SHALLOWSPEED_FAULTS environment plan",
+    )
+    ap.add_argument(
+        "--retry-budget",
+        type=int,
+        default=2,
+        help="total dispatch attempts per request before verdict 'error' "
+        "(the shared retry.RetryPolicy budget)",
+    )
+    ap.add_argument(
+        "--breaker",
+        type=int,
+        default=3,
+        help="consecutive failed dispatches that open the health breaker "
+        "(degraded: admission refused; exit 3 if still open at exit)",
+    )
+    ap.add_argument(
         "--verify",
         action="store_true",
-        help="re-compute every response with a direct predict() of the same "
-        "rows and demand bitwise equality (exit 1 on any mismatch)",
+        help="re-compute every 'ok' response with a direct predict() of the "
+        "same rows and demand bitwise equality (exit 1 on any mismatch)",
     )
     ap.add_argument(
         "--audit",
@@ -134,7 +202,10 @@ def main(argv=None):
         session,
         max_slots=args.max_slots,
         slo_ms=args.slo_ms,
-        metrics=metrics if metrics is not None else None,
+        metrics=metrics,
+        retry=args.retry_budget,
+        breaker_threshold=args.breaker,
+        faults=args.faults,
     )
     payloads = request_payloads(
         args.requests,
@@ -157,26 +228,39 @@ def main(argv=None):
     # be serving latency, not XLA compile time (and under --audit this is
     # also where every inference program's census gets verified)
     engine.warm_ladder()
-    if args.closed_loop:
-        done = run_closed_loop(
-            engine, payloads, concurrency=args.closed_loop,
-            deadline_ms=args.deadline_ms,
-        )
-    else:
-        arrivals = poisson_arrivals(args.rate, args.requests, seed=args.seed)
-        done = run_open_loop(
-            engine, payloads, arrivals, deadline_ms=args.deadline_ms
-        )
+    stopper = GracefulStop().install()
+    try:
+        if args.closed_loop:
+            done = run_closed_loop(
+                engine, payloads, concurrency=args.closed_loop,
+                deadline_ms=args.deadline_ms, should_stop=stopper.stop,
+            )
+        else:
+            arrivals = poisson_arrivals(args.rate, args.requests, seed=args.seed)
+            done = run_open_loop(
+                engine, payloads, arrivals, deadline_ms=args.deadline_ms,
+                should_stop=stopper.stop,
+            )
+    finally:
+        stopper.restore()
     rec = engine.record_summary(
         offered_rps=None if args.closed_loop else args.rate
     )
+    if stopper.stop():
+        sig = signal.Signals(stopper.signum).name
+        print(
+            f"{sig} received: admission stopped, queue drained "
+            f"({rec['completed']} served of {len(done)} accepted)"
+        )
 
     def ms(v):
         return f"{v * 1e3:.2f} ms" if v is not None else "n/a"
 
     print(
         f"completed {rec['completed']}/{args.requests}, dropped "
-        f"{rec['dropped']}, {rec['dispatches']} dispatches "
+        f"{rec['dropped']}, expired {rec['expired']}, errors "
+        f"{rec['errors']}, unhealthy {rec['unhealthy']}, "
+        f"{rec['dispatches']} dispatches "
         f"({rec['slots_dispatched']} slots"
         + (
             f", padding waste {rec['padding_waste'] * 100:.1f}%)"
@@ -195,15 +279,28 @@ def main(argv=None):
             f"{rec['completed']} within SLO), queue depth max "
             f"{rec['queue_depth_max']}"
         )
-    failures = rec["dropped"]
+    if rec["breaker_trips"] or rec["reloads"]:
+        print(
+            f"degradation: {rec['breaker_trips']} breaker trip(s), "
+            f"{rec['reloads']} reload(s)"
+            + (
+                f", recovered in {rec['recovery_s'] * 1e3:.1f} ms"
+                if rec["recovery_s"] is not None
+                else ""
+            )
+        )
+    failures = (
+        rec["dropped"] + rec["expired"] + rec["errors"] + rec["unhealthy"]
+    )
     if args.verify:
+        served = [r for r in done if r.verdict == "ok"]
         mismatched = 0
-        for req in sorted(done, key=lambda r: r.id):
+        for req in sorted(served, key=lambda r: r.id):
             direct = session.predict(payloads[req.id])  # ids are submit order
             if not np.array_equal(req.result, direct):
                 mismatched += 1
         print(
-            f"verify: {len(done) - mismatched}/{len(done)} responses "
+            f"verify: {len(served) - mismatched}/{len(served)} responses "
             "bitwise-equal to direct predict()"
             + ("" if mismatched == 0 else f" — {mismatched} MISMATCHED")
         )
@@ -211,9 +308,13 @@ def main(argv=None):
     if metrics is not None:
         metrics.close()
         print(f"telemetry written: {metrics.path}")
+    if engine.degraded:
+        print("serving: engine DEGRADED at exit (breaker open)", file=sys.stderr)
+        return 3
     if failures:
         print(
-            f"serving: {failures} dropped/incorrect response(s)",
+            f"serving: {failures} dropped/expired/errored/unhealthy/"
+            "incorrect response(s)",
             file=sys.stderr,
         )
         return 1

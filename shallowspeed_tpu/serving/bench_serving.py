@@ -1,8 +1,16 @@
-"""Latency-denominated load bench: p50/p99, goodput and the saturation knee.
+"""Latency-denominated load bench: p50/p99, goodput and the saturation knee —
+plus the seeded CHAOS SOAK behind ``make chaos-smoke``.
 
     python -m shallowspeed_tpu.serving.bench_serving [--dp N] [--pp M]
         [--schedule gpipe] [--rates 50,100,200,400] [--requests 100]
         [--slo-ms 50] [--seed 0] [--out BENCH_SERVING.json]
+
+    # chaos soak: inject die/slow/nan/error faults + one mid-traffic hot
+    # reload into seeded open-loop traffic and measure what degrades
+    python -m shallowspeed_tpu.serving.bench_serving --dp 2 \
+        --chaos "error@dispatch=3,slow@dispatch=5:ms=30,die@dispatch=7,nan@dispatch=9" \
+        --reload-dir ck/ --reload-at 5 --requests 80 --rates 300 \
+        --slo-ms 2000 --chaos-out CHAOS.json --metrics-out chaos.jsonl
 
 ``bench_scaling`` scores the framework in samples/s; this bench opens the
 second scoreboard the ROADMAP's "millions of users" north star asks for —
@@ -22,6 +30,19 @@ beside ``bench_scaling``'s records): the analytical latency floor
 recorded next to the measured percentiles so the gap between model and tail
 is a number, not prose.
 
+The chaos soak (``chaos_soak``) replays the SAME seeded stream twice — a
+clean baseline pass, then a pass with a ``faults.py`` dispatch-fault plan
+active and one mid-traffic hot weight reload — and reports availability,
+goodput retention, the per-verdict terminal counts, breaker trips, the
+measured recovery time, and two hard invariants: ZERO silently-lost
+requests (every submitted id reaches a terminal verdict) and bitwise
+parity of every ``"ok"`` response against a direct ``predict()`` under
+the weights active at its dispatch (verified per dispatch, so a hot
+reload between dispatches cannot confuse the oracle). ``die`` faults
+raise ``InjectedFault`` out of ``step()``; the soak's operator loop
+catches and re-enters — the queue is intact by the engine's contract, so
+a "dispatch loop crash" costs wall time, never requests.
+
 NOTE on interpretation (the honest caveat every CPU bench row in this repo
 carries): on emulated CPU devices dispatch overhead dominates the tiny MLP,
 so absolute latencies validate the machinery; the SHAPE of the sweep (flat
@@ -30,8 +51,13 @@ so absolute latencies validate the machinery; the SHAPE of the sweep (flat
 
 import argparse
 import json
+import os
 import sys
+import time
 
+import numpy as np
+
+from shallowspeed_tpu import faults as F
 from shallowspeed_tpu.serving.engine import ServingEngine
 from shallowspeed_tpu.serving.loadgen import (
     poisson_arrivals,
@@ -40,6 +66,7 @@ from shallowspeed_tpu.serving.loadgen import (
 )
 
 BENCH_VERSION = 1
+CHAOS_VERSION = 1
 SWEEP_ROW_FIELDS = (
     "offered_rps",
     "completed",
@@ -120,6 +147,161 @@ def sweep(
     }
 
 
+def chaos_soak(
+    session,
+    faults,
+    n_requests=80,
+    rate=200.0,
+    seed=0,
+    slo_ms=None,
+    rows_choices=(1, 2, 3, 4, 8),
+    deadline_ms=None,
+    metrics=None,
+    reload_dir=None,
+    reload_at=None,
+    loaded_step=None,
+    retry_budget=2,
+    breaker_threshold=2,
+    max_slots=None,
+    verify=True,
+    baseline=True,
+):
+    """The seeded degradation experiment (module docstring): returns the
+    versioned JSON-able chaos record. ``faults`` is a ``@dispatch=``
+    fault spec/plan; ``reload_at`` triggers the checkpoint-dir WATCHER
+    reload once attempted dispatch N is reached (the breaker triggers its
+    own reloads independently when poisoned weights trip it);
+    ``baseline=True`` first replays the identical stream through a clean
+    engine so goodput/p99 retention are measured, not guessed."""
+    payloads = request_payloads(
+        n_requests, session.spec.sizes[0], seed=seed, rows_choices=rows_choices
+    )
+    arrivals = poisson_arrivals(rate, n_requests, seed=seed)
+    base_stats = None
+    if baseline:
+        # faults="" pins an EMPTY plan: the engine default falls back to
+        # the SHALLOWSPEED_FAULTS environment, which would make the
+        # "clean" baseline anything but
+        clean = ServingEngine(session, slo_ms=slo_ms, faults="")
+        clean.warm_ladder()
+        run_open_loop(clean, payloads, arrivals, deadline_ms=deadline_ms)
+        base_stats = clean.stats()
+    engine = ServingEngine(
+        session,
+        slo_ms=slo_ms,
+        metrics=metrics,
+        retry=retry_budget,
+        breaker_threshold=breaker_threshold,
+        reload_dir=reload_dir,
+        loaded_step=loaded_step,
+        faults=faults,
+        # a small packing capacity spreads the stream over MORE dispatches,
+        # so every @dispatch= anchor in the plan is actually reached
+        max_slots=max_slots,
+    )
+    engine.warm_ladder()
+    # the zero-recompile audit anchor: every rung is compiled (and censused
+    # under audit) by now — any jit_compiles growth past this point is a
+    # recompile the hot reload was contractually forbidden to cause
+    counters = getattr(session._metrics, "counters", None)
+    compiles_before = counters.get("jit_compiles") if counters else None
+    cache_before = set(getattr(session, "_predict_cache", {}))
+    submitted, done = [], []
+    crashes = 0
+    parity_mismatches = 0
+    reload_done = reload_at is None or reload_dir is None
+    t0 = engine.clock()
+    i, n = 0, n_requests
+    while i < n or engine.queue_depth:
+        now = engine.clock() - t0
+        while i < n and arrivals[i] <= now:
+            submitted.append(
+                engine.submit(
+                    payloads[i], deadline_ms=deadline_ms,
+                    arrival_t=t0 + arrivals[i],
+                )
+            )
+            i += 1
+        if not reload_done and engine.dispatch_seq >= reload_at:
+            engine.watch_reload()  # the mid-traffic hot swap (watcher leg)
+            reload_done = True
+        if engine.queue_depth:
+            try:
+                batch = engine.step()
+            except F.InjectedFault:
+                # the injected dispatch-loop death: queue intact (die fires
+                # before any pop), the operator loop simply re-enters
+                crashes += 1
+                continue
+            if verify:
+                # parity under the weights active AT THIS DISPATCH — the
+                # oracle runs before any later reload can swap them
+                for r in batch:
+                    if r.verdict == "ok" and not np.array_equal(
+                        r.result, session.predict(payloads[r.id])
+                    ):
+                        parity_mismatches += 1
+            done.extend(batch)
+        elif i < n:
+            time.sleep(max(0.0, arrivals[i] - (engine.clock() - t0)))
+    stats = engine.record_summary(offered_rps=rate, name="chaos")
+    compiles_after = counters.get("jit_compiles") if counters else None
+    lost = [r.id for r in submitted if r.verdict == "queued"]
+    verdicts = {}
+    for r in submitted:
+        verdicts[r.verdict] = verdicts.get(r.verdict, 0) + 1
+    retention = None
+    if base_stats and base_stats.get("goodput_rps") and stats.get("goodput_rps"):
+        retention = stats["goodput_rps"] / base_stats["goodput_rps"]
+    return {
+        "bench": "serving_chaos",
+        "bench_version": CHAOS_VERSION,
+        "config": {
+            "dp": session.dp,
+            "pp": session.pp,
+            "schedule": session.schedule,
+            "requests": n_requests,
+            "rate": rate,
+            "seed": seed,
+            "slo_ms": slo_ms,
+            "deadline_ms": deadline_ms,
+            "faults": str(faults),
+            "reload_at": reload_at,
+            "reload_dir": None if reload_dir is None else str(reload_dir),
+            "retry_budget": retry_budget,
+            "breaker_threshold": breaker_threshold,
+        },
+        "submitted": len(submitted),
+        "verdicts": verdicts,
+        "silently_lost": lost,  # MUST be [] — the no-silent-loss invariant
+        # a plan entry that never fired means the soak ended before its
+        # dispatch anchor — the chaos coverage claim would be hollow
+        "faults_unfired": len(engine._faults.pending_dispatch),
+        "parity_mismatches": parity_mismatches,
+        "crashes_recovered": crashes,
+        "availability": stats.get("availability"),
+        "goodput_rps": stats.get("goodput_rps"),
+        "baseline_goodput_rps": base_stats.get("goodput_rps") if base_stats else None,
+        "goodput_retention": retention,
+        "p99_latency_s": stats.get("p99_latency_s"),
+        "baseline_p99_latency_s": base_stats.get("p99_latency_s") if base_stats else None,
+        "breaker_trips": stats.get("breaker_trips"),
+        "reloads": stats.get("reloads"),
+        "recovery_s": stats.get("recovery_s"),
+        "degraded_at_exit": stats.get("degraded"),
+        # the zero-recompile contract across hot reloads (None without a
+        # metrics recorder on the session — the counter needs one)
+        "recompiles": (
+            None
+            if compiles_before is None
+            else int(compiles_after - compiles_before)
+        ),
+        "predict_cache_stable": set(
+            getattr(session, "_predict_cache", {})
+        ) == cache_before,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m shallowspeed_tpu.serving.bench_serving",
@@ -153,10 +335,53 @@ def main(argv=None):
         help="comma-separated request row-count choices",
     )
     ap.add_argument("--out", default=None, help="write the JSON record here")
+    ap.add_argument(
+        "--chaos",
+        default=None,
+        help="run the chaos soak instead of the sweep: a dispatch-fault "
+        "spec (e.g. 'error@dispatch=3,nan@dispatch=9') injected into the "
+        "seeded stream",
+    )
+    ap.add_argument(
+        "--reload-dir",
+        default=None,
+        help="step-checkpoint directory the engine hot-reloads verified "
+        "weights from (breaker-triggered, plus --reload-at's watcher leg)",
+    )
+    ap.add_argument(
+        "--reload-at",
+        type=int,
+        default=None,
+        help="trigger one mid-traffic watch_reload() once attempted "
+        "dispatch N is reached",
+    )
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--retry-budget", type=int, default=2)
+    ap.add_argument("--breaker", type=int, default=2)
+    ap.add_argument(
+        "--max-slots",
+        type=int,
+        default=None,
+        help="chaos soak: packing capacity per dispatch — small values "
+        "spread the stream over more dispatches so every @dispatch= "
+        "anchor is reached",
+    )
+    ap.add_argument(
+        "--chaos-out", default=None, help="write the chaos JSON record here"
+    )
+    ap.add_argument(
+        "--metrics-out",
+        default=None,
+        help="JSONL sink for the chaos pass's request/serving_health/"
+        "reload records (the report CLI's Degradation evidence)",
+    )
     args = ap.parse_args(argv)
 
     from shallowspeed_tpu.api import TrainingSession
+    from shallowspeed_tpu.checkpoint import STEP_CHECKPOINT_RE
+    from shallowspeed_tpu.observability import JsonlMetrics
 
+    metrics = JsonlMetrics(args.metrics_out) if args.metrics_out else None
     session = TrainingSession(
         dp=args.dp,
         pp=args.pp,
@@ -165,7 +390,74 @@ def main(argv=None):
         mubatches=args.mubatches,
         data_dir=args.data_dir,
         resume=args.checkpoint,
+        metrics=metrics,
     )
+    if args.chaos is not None or args.reload_dir is not None:
+        # a session restored from a step snapshot seeds the watcher's
+        # freshness floor, so --reload-at picks up strictly NEWER weights
+        loaded_step = None
+        if args.checkpoint:
+            m = STEP_CHECKPOINT_RE.match(os.path.basename(args.checkpoint))
+            if m:
+                loaded_step = int(m.group(1))
+        record = chaos_soak(
+            session,
+            faults=args.chaos,
+            n_requests=args.requests,
+            rate=float(args.rates.split(",")[0]),
+            seed=args.seed,
+            slo_ms=args.slo_ms,
+            rows_choices=tuple(
+                int(r) for r in args.rows.split(",") if r.strip()
+            ),
+            deadline_ms=args.deadline_ms,
+            metrics=metrics,
+            reload_dir=args.reload_dir,
+            reload_at=args.reload_at,
+            loaded_step=loaded_step,
+            retry_budget=args.retry_budget,
+            breaker_threshold=args.breaker,
+            max_slots=args.max_slots,
+        )
+        text = json.dumps(record, indent=2)
+        if args.chaos_out:
+            with open(args.chaos_out, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+            print(f"chaos record written: {args.chaos_out}")
+        else:
+            print(text)
+        print(
+            f"chaos: {record['submitted']} submitted, verdicts "
+            f"{record['verdicts']}, availability "
+            + (
+                f"{record['availability'] * 100:.1f}%"
+                if record["availability"] is not None
+                else "n/a"
+            )
+            + f", {record['breaker_trips']} breaker trip(s), "
+            f"{record['reloads']} reload(s), "
+            f"{record['crashes_recovered']} crash(es) recovered"
+        )
+        if metrics is not None:
+            metrics.close()
+            print(f"telemetry written: {metrics.path}")
+        failures = []
+        if record["silently_lost"]:
+            failures.append(f"{len(record['silently_lost'])} request(s) LOST")
+        if record["parity_mismatches"]:
+            failures.append(
+                f"{record['parity_mismatches']} parity MISMATCH(ES)"
+            )
+        if record["recompiles"]:
+            failures.append(
+                f"{record['recompiles']} recompile(s) after hot reload"
+            )
+        if not record["predict_cache_stable"]:
+            failures.append("predict cache changed across reload")
+        if failures:
+            print("chaos: " + "; ".join(failures), file=sys.stderr)
+            return 1
+        return 0
     record = sweep(
         session,
         rates=[float(r) for r in args.rates.split(",") if r.strip()],
@@ -173,6 +465,7 @@ def main(argv=None):
         seed=args.seed,
         slo_ms=args.slo_ms,
         rows_choices=tuple(int(r) for r in args.rows.split(",") if r.strip()),
+        metrics=metrics,
     )
     text = json.dumps(record, indent=2)
     if args.out:
@@ -186,6 +479,8 @@ def main(argv=None):
         )
     else:
         print(text)
+    if metrics is not None:
+        metrics.close()
     return 0
 
 

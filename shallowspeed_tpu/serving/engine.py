@@ -36,6 +36,41 @@ microbatches (arXiv 2004.09910). ``ServingEngine`` owns that loop on top of
   with their input payloads and result arrays, are handed back to the
   caller by ``step()``/``drain()`` and never kept, so a long-lived engine
   does not grow with the traffic it has served.
+
+Graceful degradation (docs/robustness.md "Serving faults") — every
+submitted request reaches exactly one TERMINAL verdict, never silence:
+
+- **dispatch recovery**: ``step()`` wraps the session dispatch; a raised
+  exception re-queues the popped batch at the queue HEAD in its original
+  order (packing stays order-preserving, so the bitwise-parity contract
+  holds across retries) under a bounded per-request ``retry.RetryPolicy``
+  budget — exhausted requests complete with verdict ``"error"``;
+- **deadline shedding**: at pack time, a head request whose deadline
+  already passed — or provably cannot be met even dispatching NOW (the
+  analytical latency floor exceeds the time remaining) — completes as
+  ``"expired"`` before costing a slot; ``shed_on_submit=True`` applies
+  the same estimate at admission (queue slots ahead x the costmodel
+  floor) as optional backpressure;
+- **health-gated responses**: every dispatch's predictions are
+  finiteness-checked per request BEFORE unpacking; a non-finite slice
+  completes as ``"unhealthy"`` with no result — poisoned weights never
+  serve a response with verdict ``"ok"``;
+- **breaker**: ``breaker_threshold`` CONSECUTIVE failed dispatches
+  (exceptions or unhealthy predictions) flip the engine into a degraded
+  state that refuses admission (verdict ``"dropped"``, reason
+  ``"degraded"``), emits a schema-v6 ``serving_health`` record, and —
+  when ``reload_dir`` is configured — triggers a hot weight reload;
+- **hot weight reload**: ``reload()`` swaps verified checkpoint weights
+  between dispatches without touching the queue
+  (``TrainingSession.load_weights`` — same shapes, so every cached rung
+  program survives with ZERO recompiles); ``watch_reload()`` polls the
+  directory for snapshots newer than the one served
+  (``checkpoint.find_newer_good``). A successful reload closes the
+  breaker;
+- **chaos**: a ``faults=`` plan (the PR6 grammar, ``@dispatch=N``
+  anchors) injects ``die``/``slow``/``nan``/``error`` faults into the
+  dispatch loop deterministically — ``bench_serving``'s chaos soak and
+  ``make chaos-smoke`` drive it.
 """
 
 import time
@@ -43,8 +78,19 @@ from collections import deque
 
 import numpy as np
 
+from shallowspeed_tpu import faults as F
+from shallowspeed_tpu import retry as R
+from shallowspeed_tpu.checkpoint import (
+    CheckpointError,
+    find_latest_good,
+    find_newer_good,
+)
 from shallowspeed_tpu.observability import NullMetrics
 from shallowspeed_tpu.serving import slots as serving_slots
+
+# terminal request verdicts — every submitted request ends on exactly one
+# (the state machine documented in docs/robustness.md "Serving faults")
+TERMINAL_VERDICTS = ("ok", "dropped", "expired", "error", "unhealthy")
 
 
 class Request:
@@ -61,6 +107,7 @@ class Request:
         "complete_t",
         "result",
         "verdict",
+        "attempts",
     )
 
     def __init__(self, req_id, x, slots, deadline_ms, enqueue_t):
@@ -72,8 +119,10 @@ class Request:
         self.enqueue_t = enqueue_t
         self.dispatch_t = None
         self.complete_t = None
-        self.result = None  # (rows, out_dim) softmax probabilities
-        self.verdict = "queued"  # -> "ok" | "dropped"
+        self.result = None  # (rows, out_dim) softmax probabilities; only "ok"
+        # queued -> ok | dropped | expired | error | unhealthy (terminal)
+        self.verdict = "queued"
+        self.attempts = 0  # failed dispatch attempts consumed so far
 
     @property
     def latency_s(self):
@@ -110,6 +159,18 @@ class ServingEngine:
     submissions beyond it are DROPPED (recorded, returned with verdict
     "dropped", never silently discarded); None = unbounded. ``clock`` is
     injectable for tests.
+
+    Fault tolerance (module docstring): ``retry`` is the per-request
+    dispatch budget — an int (total attempts, no backoff) or a
+    ``retry.RetryPolicy``; ``breaker_threshold`` consecutive failed
+    dispatches open the breaker; ``reload_dir`` names the step-checkpoint
+    directory ``reload()``/``watch_reload()`` restore verified weights
+    from (``loaded_step`` seeds the watcher's freshness floor when the
+    session was constructed from a step snapshot); ``shed_on_submit``
+    turns the analytical-wait deadline estimate into admission
+    backpressure; ``faults`` is a chaos plan (spec string / FaultPlan;
+    only ``@dispatch=`` anchors are consulted here — defaults to the
+    ``SHALLOWSPEED_FAULTS`` environment plan, like the session).
     """
 
     def __init__(
@@ -121,6 +182,12 @@ class ServingEngine:
         metrics=None,
         clock=time.perf_counter,
         depth_ring=4096,
+        retry=2,
+        breaker_threshold=3,
+        reload_dir=None,
+        loaded_step=None,
+        shed_on_submit=False,
+        faults=None,
     ):
         self._session = session
         self._slot_rows = session.slot_rows
@@ -143,12 +210,35 @@ class ServingEngine:
         self._max_queue = max_queue
         self._metrics = metrics if metrics is not None else NullMetrics()
         self.clock = clock
+        # the shared retry policy (retry.py): an int is the common case —
+        # a total-attempts budget with zero backoff (re-dispatch happens on
+        # a later step(), stalling the serving loop helps nobody)
+        if isinstance(retry, R.RetryPolicy):
+            self._retry = retry
+        else:
+            self._retry = R.RetryPolicy(attempts=int(retry), base=0.0, jitter=0)
+        if breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
+        self._breaker_threshold = int(breaker_threshold)
+        self._reload_dir = reload_dir
+        self._loaded_step = loaded_step  # watcher freshness floor
+        self._shed_on_submit = bool(shed_on_submit)
+        self._faults = F.make_plan(faults)
+        self._latency_floor = None  # lazy: inference_latency_bound seconds
         # sequential sessions dispatch only the OCCUPIED slots (one fixed
         # program per slot — no rung program to round up to), so the
         # padding accounting must not charge them the rung tail
         self._sequential = bool(getattr(session, "sequential", False))
         self._queue = deque()
         self._next_id = 0
+        # attempted-dispatch sequence (failures included): the one counter
+        # the chaos plan's @dispatch= anchors key off, so an injection
+        # lands deterministically whatever succeeded before it
+        self._dispatch_seq = 0
+        # breaker state (operational — survives reset_stats)
+        self._consecutive_failures = 0
+        self._degraded = False
+        self._breaker_opened_t = None
         # the flight-recorder pattern: a bounded ring of (t, queue_depth)
         # samples, one per submit/dispatch — the engine's constant-size
         # "what just happened" buffer behind the queue-depth stats
@@ -160,6 +250,14 @@ class ServingEngine:
         self._first_enqueue_t = None
         self._last_complete_t = None
         self._dropped = 0
+        self._expired = 0
+        self._errors = 0
+        self._unhealthy = 0
+        self._retries = 0
+        self._failed_dispatches = 0
+        self._breaker_trips = 0
+        self._reloads = 0
+        self._last_recovery_s = None
         self._dispatches = 0
         self._slots_dispatched = 0  # dispatched slots (rung-rounded on mesh)
         self._useful_rows = 0
@@ -181,9 +279,31 @@ class ServingEngine:
     def queue_depth(self):
         return len(self._queue)
 
+    @property
+    def degraded(self):
+        """True while the breaker is open: admission refused until a
+        successful reload (or explicit ``close_breaker()``)."""
+        return self._degraded
+
+    @property
+    def dispatch_seq(self):
+        """Attempted-dispatch count so far (failures included) — the
+        sequence chaos ``@dispatch=N`` anchors and drivers key off."""
+        return self._dispatch_seq
+
     def _record_depth(self, t):
         self._depths.append((t, len(self._queue)))
         self._metrics.gauge("serving.queue_depth", len(self._queue))
+
+    def _floor_s(self):
+        """The analytical per-dispatch latency floor (lazy — one
+        inference_latency_bound call per engine), the lower bound the
+        deadline estimates multiply: a dispatch can never return faster."""
+        if self._latency_floor is None:
+            self._latency_floor = float(
+                self._session.inference_latency_bound()["seconds"]
+            )
+        return self._latency_floor
 
     def submit(self, x, deadline_ms=None, arrival_t=None):
         """Enqueue one request of ``(rows, in_dim)`` inputs; returns its
@@ -192,7 +312,15 @@ class ServingEngine:
         latency counts from ARRIVAL, not from when a busy host got around
         to submitting — the coordinated-omission correction). A request
         larger than one dispatch (``max_slots`` slots) is refused; beyond
-        ``max_queue`` it is dropped and returned with verdict "dropped"."""
+        ``max_queue`` — or while the breaker is open — it is dropped and
+        returned with verdict "dropped"; under ``shed_on_submit`` a
+        deadline the analytical wait estimate provably cannot meet is
+        refused with verdict "expired" before costing queue space.
+
+        Timeline consistency: the queue-depth ring samples at the SAME
+        timestamp the request's own timeline uses (the backdated
+        ``arrival_t`` when given), so depth samples and request records
+        join on one clock."""
         x = np.asarray(x, np.float32)
         if x.ndim != 2 or x.shape[0] < 1:
             raise ValueError(f"request must be (rows >= 1, in_dim), got {x.shape}")
@@ -208,14 +336,50 @@ class ServingEngine:
         t = self.clock() if arrival_t is None else float(arrival_t)
         req = Request(self._next_id, x, n_slots, deadline_ms, t)
         self._next_id += 1
+        if self._degraded:
+            req.verdict = "dropped"
+            self._dropped += 1
+            self._record_request(req, reason="degraded")
+            return req
         if self._max_queue is not None and len(self._queue) >= self._max_queue:
             req.verdict = "dropped"
             self._dropped += 1
-            self._record_request(req)
+            self._record_request(req, reason="queue_full")
+            return req
+        if (
+            self._shed_on_submit
+            and deadline_ms is not None
+            and self._admission_hopeless(req, t)
+        ):
+            req.verdict = "expired"
+            req.complete_t = self.clock()
+            self._expired += 1
+            self._record_request(req, reason="admission_estimate")
             return req
         self._queue.append(req)
-        self._record_depth(t if arrival_t is None else self.clock())
+        self._record_depth(t)
         return req
+
+    def _admission_hopeless(self, req, t):
+        """Provable-at-admission deadline miss: queued slots ahead need at
+        least ``slots_ahead // max_slots`` whole dispatches before this
+        request's own, each no faster than the analytical latency floor —
+        a LOWER bound, so a True here is a certainty, not a heuristic."""
+        deadline = t + req.deadline_ms / 1000.0
+        slots_ahead = sum(r.slots for r in self._queue)
+        floor = self._floor_s()
+        min_complete = (
+            self.clock() + (slots_ahead // self._max_slots) * floor + floor
+        )
+        return min_complete > deadline
+
+    def _deadline_hopeless(self, req, now):
+        """Pack-time shed test: the deadline already passed, or even a
+        dispatch starting NOW cannot beat the analytical floor to it."""
+        if req.deadline_ms is None:
+            return False
+        deadline = req.enqueue_t + req.deadline_ms / 1000.0
+        return now >= deadline or now + self._floor_s() > deadline
 
     # -- continuous batching ------------------------------------------------
 
@@ -227,19 +391,51 @@ class ServingEngine:
         one would overflow ``max_slots``, the packed slot count is rounded
         up the ladder, and every request's rows land in its OWN slots —
         which is why each response is bitwise-equal to a direct
-        ``predict()`` of the same rows."""
+        ``predict()`` of the same rows.
+
+        Failure semantics: expired head requests are shed (verdict
+        "expired") before costing a slot; a dispatch exception re-queues
+        the popped batch at the HEAD in original order and retries under
+        the engine's retry budget (exhausted requests complete as
+        "error"); non-finite predictions complete as "unhealthy". A
+        chaos ``die`` fault (mode=exc) raises ``InjectedFault`` BEFORE
+        any request is popped — the queue is intact when the operator
+        loop catches it and re-enters."""
         if not self._queue:
             return []
         t_d = self.clock()
+        seq = self._dispatch_seq
+        self._dispatch_seq += 1
+        # chaos faults anchored at (or before — a same-dispatch die may
+        # have consumed an anchor) this attempted dispatch, in spec order
+        pending_faults = self._faults.due_at_dispatch(seq)
+        for f in pending_faults:
+            if f.kind == "die":
+                self._record_health(
+                    "fault_injected", dispatch=seq, fault=repr(f)
+                )
+                self._metrics.flush()
+                self._faults.fire_die(f)  # sigkill never returns; exc raises
+        done = []
         batch, used = [], 0
         while self._queue:
             head = self._queue[0]
+            # deadline shedding at pack time: a hopeless head completes as
+            # "expired" before costing a slot
+            if self._deadline_hopeless(head, t_d):
+                self._queue.popleft()
+                self._complete_terminal(head, "expired", t_d, reason="deadline")
+                done.append(head)
+                continue
             if batch and used + head.slots > self._max_slots:
                 break
             self._queue.popleft()
             head.dispatch_t = t_d
             batch.append(head)
             used += head.slots
+        if not batch:  # everything at the head was shed
+            self._record_depth(t_d)
+            return done
         rung = serving_slots.rung_for(used, self._ladder)
         S_rows = self._slot_rows
         flat = np.concatenate(
@@ -249,40 +445,281 @@ class ServingEngine:
             ],
             axis=0,
         )
-        # the session pads the tail up to the rung and dispatches the
-        # cached rung program — the same call path a direct predict() takes
-        preds = self._session.predict(flat)
+        try:
+            for f in pending_faults:
+                if f.fired:
+                    continue
+                if f.kind == "slow":
+                    f.fired = True
+                    self._record_health(
+                        "fault_injected", dispatch=seq, fault=repr(f)
+                    )
+                    time.sleep(f.ms / 1000.0)
+                elif f.kind == "nan":
+                    f.fired = True
+                    self._record_health(
+                        "fault_injected", dispatch=seq, fault=repr(f)
+                    )
+                    self._session.poison_weights()
+                elif f.kind == "error":
+                    f.fired = True
+                    self._record_health(
+                        "fault_injected", dispatch=seq, fault=repr(f)
+                    )
+                    raise F.InjectedFault(f"injected fault: {f!r}")
+            # the session pads the tail up to the rung and dispatches the
+            # cached rung program — the same call path predict() takes
+            preds = self._session.predict(flat)
+        except Exception as e:  # noqa: BLE001 — ANY dispatch failure recovers
+            done.extend(self._recover_failed_dispatch(batch, seq, e))
+            self._record_depth(self.clock())
+            return done
         t_c = self.clock()
         off = 0
+        any_unhealthy = False
         for r in batch:
-            r.result = preds[off : off + r.rows]
+            result = preds[off : off + r.rows]
             off += r.slots * S_rows
+            # health gate: a non-finite slice must never be served as "ok"
+            if not np.isfinite(result).all():
+                any_unhealthy = True
+                self._complete_terminal(r, "unhealthy", t_c)
+                done.append(r)
+                continue
+            r.result = result
             r.complete_t = t_c
             r.verdict = "ok"
             self._record_request(r)
+            done.append(r)
             self._samples.append((r.latency_s, r.queue_s, r.deadline_ms))
             if self._first_enqueue_t is None or r.enqueue_t < self._first_enqueue_t:
                 self._first_enqueue_t = r.enqueue_t
             if self._last_complete_t is None or t_c > self._last_complete_t:
                 self._last_complete_t = t_c
+            self._useful_rows += r.rows
+            # recovery time: breaker opened, then a response served again
+            if self._breaker_opened_t is not None and not self._degraded:
+                self._last_recovery_s = t_c - self._breaker_opened_t
+                self._breaker_opened_t = None
         self._dispatches += 1
         # mesh dispatches pay the rung program's full slot count; a
         # sequential dispatch runs exactly the occupied slots
         self._slots_dispatched += used if self._sequential else rung
-        self._useful_rows += sum(r.rows for r in batch)
+        if any_unhealthy:
+            self._record_health(
+                "unhealthy_dispatch",
+                dispatch=seq,
+                consecutive_failures=self._consecutive_failures + 1,
+            )
+            self._note_failure(seq)
+        else:
+            self._consecutive_failures = 0
         self._record_depth(t_c)
-        return batch
+        return done
+
+    def _recover_failed_dispatch(self, batch, seq, exc):
+        """Dispatch recovery (tentpole item 1): re-queue the popped batch
+        at the queue HEAD in its original order — packing determinism is
+        preserved, so the retried dispatch serves bitwise-identical
+        responses — under the bounded per-request retry budget. Requests
+        whose budget is exhausted complete with verdict "error"; nothing
+        ever vanishes with verdict "queued"."""
+        self._failed_dispatches += 1
+        t = self.clock()
+        terminal = []
+        keep = []
+        for r in batch:
+            r.dispatch_t = None
+            r.attempts += 1
+            if self._retry.exhausted(r.attempts):
+                self._complete_terminal(
+                    r, "error", t, reason=f"{type(exc).__name__}: {exc}"[:200]
+                )
+                terminal.append(r)
+            else:
+                keep.append(r)
+        for r in reversed(keep):  # head insertion preserves original order
+            self._queue.appendleft(r)
+        self._retries += len(keep)
+        self._record_health(
+            "dispatch_error",
+            dispatch=seq,
+            error=f"{type(exc).__name__}: {exc}"[:200],
+            requeued=len(keep),
+            exhausted=len(terminal),
+            consecutive_failures=self._consecutive_failures + 1,
+        )
+        self._note_failure(seq)
+        if keep and self._retry.base:
+            # the shared backoff schedule — opt-in (base > 0): serving
+            # retries default to immediate re-dispatch on the next step()
+            time.sleep(self._retry.delay(min(r.attempts for r in keep) - 1))
+        return terminal
+
+    def _note_failure(self, seq):
+        """One failed dispatch toward the breaker; at the threshold the
+        engine degrades (refuses admission) and — with a reload directory
+        configured — attempts the hot weight reload that recovery needs."""
+        self._consecutive_failures += 1
+        if (
+            not self._degraded
+            and self._consecutive_failures >= self._breaker_threshold
+        ):
+            self._degraded = True
+            self._breaker_trips += 1
+            self._breaker_opened_t = self.clock()
+            self._record_health(
+                "breaker_open",
+                dispatch=seq,
+                consecutive_failures=self._consecutive_failures,
+            )
+            self._metrics.flush()
+            if self._reload_dir is not None:
+                self._try_reload(reason="breaker")
+
+    # -- hot weight reload ---------------------------------------------------
+
+    def reload(self, path=None, reason="manual"):
+        """Hot-swap the served weights from ``path`` (default: the newest
+        VERIFYING snapshot in ``reload_dir`` via ``find_latest_good`` —
+        including the one already loaded, whose in-memory copy may be
+        poisoned). The queue is untouched; every response dispatched after
+        the swap is bitwise-equal to a direct ``predict()`` under the new
+        weights, and the cached rung programs survive (same shapes — zero
+        recompiles, auditable via the ``jit_compiles`` counter and the
+        per-rung ``xla_audit`` dedup). A successful reload closes the
+        breaker. Raises ``CheckpointError``/``ValueError`` when the swap
+        is impossible (no snapshot verifies, sizes differ); returns the
+        loaded checkpoint's metadata."""
+        t0 = self.clock()
+        step = None
+        if path is None:
+            if self._reload_dir is None:
+                raise ValueError(
+                    "reload() needs a path, or a reload_dir on the engine"
+                )
+            found, meta, skipped = find_latest_good(self._reload_dir)
+            if found is None:
+                raise CheckpointError(
+                    self._reload_dir,
+                    "no snapshot verifies for hot reload: "
+                    + ("; ".join(f"{p.name}: {c}" for p, c in skipped) or "empty"),
+                )
+            path = found
+            step = meta.get("global_step")
+        # transient read errors retry under the shared policy; a
+        # deterministic CheckpointError (corruption) surfaces immediately
+        meta = R.retry_call(
+            lambda: self._session.load_weights(path),
+            attempts=2,
+            retry_on=(OSError,),
+        )
+        wall = self.clock() - t0
+        if step is None:
+            step = meta.get("global_step")
+        if step is not None:
+            self._loaded_step = int(step)
+        self._reloads += 1
+        self._metrics.reload(
+            "ok",
+            path=str(path),
+            step=step,
+            reason=reason,
+            wall_s=wall,
+            programs_cached=len(getattr(self._session, "_predict_cache", ())),
+        )
+        self.close_breaker()
+        return meta
+
+    def _try_reload(self, reason):
+        """Best-effort internal reload (breaker trigger): a failure is
+        recorded — the engine stays degraded — never raised into the
+        serving loop."""
+        try:
+            self.reload(reason=reason)
+        except (CheckpointError, ValueError, OSError) as e:
+            self._metrics.reload(
+                "failed", path=str(self._reload_dir), reason=reason,
+                error=str(e)[:200],
+            )
+            self._metrics.flush()
+
+    def watch_reload(self):
+        """The checkpoint-dir watcher leg: pick up a snapshot STRICTLY
+        newer than the one currently served (``find_newer_good``) and
+        hot-swap it. Returns the new global step, or None when nothing
+        newer verifies (newer-but-corrupt candidates are recorded).
+
+        Contained like the breaker leg: the watcher polls a directory a
+        CONCURRENT training run keeps writing and rotating, so a snapshot
+        can vanish (or rot) between the verify and the load re-read — a
+        failed swap is recorded, the engine keeps serving the weights it
+        has, and the next poll tries again; it never kills the dispatch
+        loop."""
+        if self._reload_dir is None:
+            raise ValueError("watch_reload() needs a reload_dir on the engine")
+        step, path, meta, skipped = find_newer_good(
+            self._reload_dir, than_step=self._loaded_step
+        )
+        if path is None:
+            if skipped:
+                self._metrics.reload(
+                    "none_newer",
+                    path=str(self._reload_dir),
+                    reason="watch",
+                    skipped=[
+                        {"path": str(p), "cause": c} for p, c in skipped
+                    ],
+                )
+            return None
+        try:
+            self.reload(path=path, reason="watch")
+        except (CheckpointError, ValueError, OSError) as e:
+            self._metrics.reload(
+                "failed", path=str(path), reason="watch", error=str(e)[:200],
+            )
+            self._metrics.flush()
+            return None
+        self._loaded_step = int(step)
+        return int(step)
+
+    def close_breaker(self):
+        """Re-admit traffic after recovery (reload() calls this on
+        success; operators may also close it by hand after an external
+        fix). The open-timestamp survives until the next served response
+        so ``recovery_s`` measures breaker-open -> first "ok"."""
+        self._consecutive_failures = 0
+        if self._degraded:
+            self._degraded = False
+            self._record_health(
+                "breaker_closed", dispatch=self._dispatch_seq,
+                consecutive_failures=0,
+            )
 
     def drain(self):
-        """Serve until the queue is empty; returns everything completed."""
+        """Serve until the queue is empty; returns everything completed.
+        Bounded by construction: every queued request either completes
+        (ok/unhealthy/expired) or exhausts its finite retry budget
+        ("error") — a permanently-failing dispatch cannot loop forever."""
         done = []
         while self._queue:
             done.extend(self.step())
         return done
 
-    def _record_request(self, req):
-        self._metrics.request(
-            req.verdict,
+    def _complete_terminal(self, req, verdict, t, reason=None):
+        """Complete ``req`` with a non-"ok" terminal verdict + accounting."""
+        req.verdict = verdict
+        req.complete_t = t
+        if verdict == "expired":
+            self._expired += 1
+        elif verdict == "error":
+            self._errors += 1
+        elif verdict == "unhealthy":
+            self._unhealthy += 1
+        self._record_request(req, reason=reason)
+
+    def _record_request(self, req, reason=None):
+        fields = dict(
             id=req.id,
             rows=req.rows,
             slots=req.slots,
@@ -293,15 +730,25 @@ class ServingEngine:
             queue_s=req.queue_s,
             deadline_ms=req.deadline_ms,
             slo_ok=req.slo_ok(self._slo_ms),
+            attempts=req.attempts,
         )
+        if reason is not None:
+            fields["reason"] = reason
+        self._metrics.request(req.verdict, **fields)
+
+    def _record_health(self, name, **fields):
+        self._metrics.serving_health(name, **fields)
 
     # -- accounting ---------------------------------------------------------
 
     def stats(self):
         """Aggregate accounting over everything served since the last
-        ``reset_stats()`` — the field set of the schema-v5 ``serving``
-        summary record (all plain scalars, folded from the per-completion
-        scalar samples; no served payload is retained)."""
+        ``reset_stats()`` — the field set of the ``serving`` summary
+        record (all plain scalars, folded from the per-completion scalar
+        samples; no served payload is retained). Latency percentiles and
+        the window cover "ok" completions; the terminal-failure counts
+        (dropped/expired/error/unhealthy) carry the degradation story,
+        folded into ``availability`` = ok / all-terminal."""
         lats = [lat for lat, _, _ in self._samples]
         queues = [q for _, q, _ in self._samples]
         # per-request deadline tag wins over the engine SLO; with neither,
@@ -318,9 +765,24 @@ class ServingEngine:
         padded_rows = self._slots_dispatched * self._slot_rows
         depths = [d for _, d in self._depths]
         met = sum(1 for ok in slo_flags if ok)
+        ok_n = len(self._samples)
+        terminal = (
+            ok_n + self._dropped + self._expired + self._errors
+            + self._unhealthy
+        )
         return {
-            "completed": len(self._samples),
+            "completed": ok_n,
             "dropped": self._dropped,
+            "expired": self._expired,
+            "errors": self._errors,
+            "unhealthy": self._unhealthy,
+            "retries": self._retries,
+            "failed_dispatches": self._failed_dispatches,
+            "breaker_trips": self._breaker_trips,
+            "reloads": self._reloads,
+            "degraded": self._degraded,
+            "recovery_s": self._last_recovery_s,
+            "availability": (ok_n / terminal) if terminal else None,
             "dispatches": self._dispatches,
             "slots_dispatched": self._slots_dispatched,
             "useful_rows": self._useful_rows,
@@ -352,8 +814,8 @@ class ServingEngine:
         }
 
     def record_summary(self, offered_rps=None, name="summary"):
-        """Emit (and return) the schema-v5 ``serving`` summary record:
-        ``stats()`` plus the offered load and the analytical latency floor
+        """Emit (and return) the ``serving`` summary record: ``stats()``
+        plus the offered load and the analytical latency floor
         (``costmodel.serving_latency_bound`` — ticks x per-tick cost)."""
         rec = self.stats()
         rec["offered_rps"] = offered_rps
@@ -368,12 +830,22 @@ class ServingEngine:
 
     def reset_stats(self):
         """Clear the accounting (the bench sweep's per-rate boundary);
-        queued requests are unaffected."""
+        queued requests — and the OPERATIONAL breaker/watcher state
+        (degraded flag, consecutive-failure count, loaded step, dispatch
+        sequence) — are unaffected."""
         self._samples = []
         self._first_enqueue_t = None
         self._last_complete_t = None
         self._depths.clear()
         self._dropped = 0
+        self._expired = 0
+        self._errors = 0
+        self._unhealthy = 0
+        self._retries = 0
+        self._failed_dispatches = 0
+        self._breaker_trips = 0
+        self._reloads = 0
+        self._last_recovery_s = None
         self._dispatches = 0
         self._slots_dispatched = 0
         self._useful_rows = 0

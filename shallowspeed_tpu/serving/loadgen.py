@@ -23,6 +23,22 @@ import time
 
 import numpy as np
 
+from shallowspeed_tpu.faults import InjectedFault
+
+
+def _step_reentrant(engine):
+    """One engine.step() under the operator-loop contract: an injected
+    dispatch-loop death (``die@dispatch=N``, mode=exc) fires BEFORE any
+    request is popped, so the queue is intact — the drivers catch it and
+    simply re-enter on the next iteration, which is the re-entry the
+    fault models (``mode=sigkill`` still kills the process honestly).
+    Real dispatch exceptions are the ENGINE's to recover (re-queue +
+    retry budget) and never reach here."""
+    try:
+        return engine.step()
+    except InjectedFault:
+        return []
+
 
 def poisson_arrivals(rate_rps, n, seed=0):
     """``n`` seeded Poisson arrival times (seconds from start): cumulative
@@ -53,7 +69,8 @@ def request_payloads(n, in_dim, seed=0, rows_choices=(1, 2, 3, 4, 8), data=None)
 
 
 def run_open_loop(
-    engine, payloads, arrivals, deadline_ms=None, sleep=time.sleep
+    engine, payloads, arrivals, deadline_ms=None, sleep=time.sleep,
+    should_stop=None,
 ):
     """Replay ``payloads`` against the engine on the ``arrivals`` schedule
     (seconds from start, one per payload); returns the completed requests.
@@ -61,12 +78,28 @@ def run_open_loop(
     Single-threaded approximation of an open-loop client: all due arrivals
     are submitted (backdated to their scheduled time), then one batching
     step serves the queue's head; the host sleeps only when idle. The
-    engine drains fully before returning."""
+    engine drains fully before returning.
+
+    Deadline semantics: ``deadline_ms`` counts from the SCHEDULED arrival
+    (the backdated ``arrival_t``), so a request that sat unsubmitted while
+    the host was busy has already burned queue time against its deadline —
+    the coordinated-omission-corrected reading (contrast the closed-loop
+    driver below).
+
+    ``should_stop``: an optional zero-arg callable polled each iteration —
+    the graceful-drain hook (serving ``__main__``'s SIGTERM/SIGINT
+    handler): once it returns True, ADMISSION stops (remaining payloads
+    are never submitted) but everything already queued is drained to a
+    terminal verdict before returning."""
     if len(payloads) != len(arrivals):
         raise ValueError("one arrival time per payload")
     t0 = engine.clock()
     done, i, n = [], 0, len(payloads)
     while i < n or engine.queue_depth:
+        if should_stop is not None and should_stop():
+            while engine.queue_depth:
+                done.extend(_step_reentrant(engine))
+            break
         now = engine.clock() - t0
         while i < n and arrivals[i] <= now:
             engine.submit(
@@ -74,22 +107,38 @@ def run_open_loop(
             )
             i += 1
         if engine.queue_depth:
-            done.extend(engine.step())
+            done.extend(_step_reentrant(engine))
         elif i < n:
             sleep(max(0.0, arrivals[i] - (engine.clock() - t0)))
     return done
 
 
-def run_closed_loop(engine, payloads, concurrency=4, deadline_ms=None):
+def run_closed_loop(
+    engine, payloads, concurrency=4, deadline_ms=None, should_stop=None
+):
     """Drive a fixed in-flight population: keep ``concurrency`` requests
     queued, submitting the next as completions free slots; returns the
-    completed requests."""
+    completed requests. ``should_stop`` is the same graceful-drain hook as
+    ``run_open_loop``'s.
+
+    Deadline semantics — deliberately DIFFERENT from the open loop: a
+    closed-loop driver never backdates arrivals (there is no arrival
+    schedule — the population model admits a request the moment a slot
+    frees), so ``deadline_ms`` counts from the SUBMIT-time clock and
+    ``met_deadline``/``slo_ok`` score pure service latency with no queue
+    backlog charged. Pinned by ``test_closed_vs_open_loop_deadline_
+    accounting``; use the open loop when coordinated-omission-corrected
+    tails are the question."""
     if concurrency < 1:
         raise ValueError("concurrency must be >= 1")
     done, i, n = [], 0, len(payloads)
     while i < n or engine.queue_depth:
+        if should_stop is not None and should_stop():
+            while engine.queue_depth:
+                done.extend(_step_reentrant(engine))
+            break
         while i < n and engine.queue_depth < concurrency:
             engine.submit(payloads[i], deadline_ms=deadline_ms)
             i += 1
-        done.extend(engine.step())
+        done.extend(_step_reentrant(engine))
     return done

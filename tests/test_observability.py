@@ -108,6 +108,8 @@ def test_null_metrics_hot_path_zero_net_allocation():
             m.recovery("r")
             m.request("q")  # ... and the v5 serving hooks
             m.serving("s")
+            m.serving_health("b")  # ... and the v6 degradation hooks
+            m.reload("r")
 
     burst(100)  # warm up caches (method cache, code objects)
     # background threads (XLA's pools) can allocate a handful of blocks at
@@ -628,9 +630,8 @@ def test_schema_v4_checkpoint_and_recovery_kinds(tmp_path):
 def test_schema_v5_request_and_serving_kinds(tmp_path):
     """Schema v5 (additive): the request/serving record kinds round-trip
     with the version stamp AND the non-finite sanitizer, the v5 reader
-    accepts v1-v4 files unchanged, a v6 file is refused (the strict check
-    stays one-directional), and NullMetrics no-ops the new hooks."""
-    assert SCHEMA_VERSION == 5
+    accepts v1-v4 files unchanged, a newer file is refused (the strict
+    check stays one-directional), and NullMetrics no-ops the new hooks."""
     path = tmp_path / "v5.jsonl"
     with JsonlMetrics(path) as m:
         m.request(
@@ -670,14 +671,81 @@ def test_schema_v5_request_and_serving_kinds(tmp_path):
         p = tmp_path / f"old-v{v}.jsonl"
         p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
         assert read_jsonl(p)[0]["kind"] == rec["kind"]
-    # one-directional refusal: a v6 file fails loudly
-    v6 = tmp_path / "v6.jsonl"
+    # one-directional refusal: a newer file fails loudly
+    v6 = tmp_path / "newer.jsonl"
     v6.write_text(json.dumps({"v": SCHEMA_VERSION + 1, "kind": "event"}) + "\n")
     with pytest.raises(ValueError, match="newer"):
         read_jsonl(v6)
     n = NullMetrics()
     n.request("ok", id=0, rows=1)
     n.serving("summary", completed=1)
+
+
+def test_schema_v6_serving_health_and_reload_kinds(tmp_path):
+    """Schema v6 (additive): the serving_health/reload record kinds — the
+    serving degradation evidence stream — round-trip with the version
+    stamp AND the non-finite sanitizer, the v6 reader accepts v1-v5 files
+    unchanged, a v7 file is refused (the strict check stays
+    one-directional), and NullMetrics no-ops the new hooks."""
+    assert SCHEMA_VERSION == 6
+    path = tmp_path / "v6.jsonl"
+    with JsonlMetrics(path) as m:
+        m.serving_health(
+            "breaker_open", dispatch=7, consecutive_failures=3,
+        )
+        m.serving_health(
+            "unhealthy_dispatch", dispatch=6,
+            worst_value=float("nan"),  # through the sanitizer
+        )
+        m.reload(
+            "ok", path="/tmp/ck/step-00000008.npz", step=8, reason="breaker",
+            wall_s=0.01, programs_cached=3,
+        )
+        m.reload(
+            "failed", path="/tmp/ck", reason="watch",
+            error="checksum mismatch", wall_s=float("inf"),
+        )
+        # the v6-extended request verdicts ride the existing kind
+        m.request("expired", id=1, rows=2, slots=1, attempts=0,
+                  reason="deadline")
+        m.request("error", id=2, rows=1, slots=1, attempts=2,
+                  reason="InjectedFault: injected")
+        m.request("unhealthy", id=3, rows=1, slots=1, attempts=0)
+    recs = read_jsonl(path)
+    assert [r["kind"] for r in recs] == [
+        "meta", "serving_health", "serving_health", "reload", "reload",
+        "request", "request", "request",
+    ]
+    assert all(r["v"] == SCHEMA_VERSION for r in recs)
+    assert recs[1]["name"] == "breaker_open" and recs[1]["dispatch"] == 7
+    assert recs[2]["worst_value"] == "NaN"
+    assert recs[3]["name"] == "ok" and recs[3]["step"] == 8
+    assert recs[4]["wall_s"] == "Infinity"
+    assert [r["name"] for r in recs[5:]] == ["expired", "error", "unhealthy"]
+    assert recs[6]["attempts"] == 2
+    # every line stays STRICT JSON (no bare NaN/Infinity tokens)
+    raw = [json.loads(l, parse_constant=lambda s: (_ for _ in ()).throw(
+        ValueError(s))) for l in path.read_text().splitlines()]
+    assert len(raw) == 8
+    # v1-v5 files load unchanged under the v6 reader
+    for v, rec in (
+        (1, {"kind": "event", "name": "epoch", "epoch": 0, "loss": 0.5}),
+        (2, {"kind": "step", "name": "train", "step": 0, "loss": 0.5}),
+        (3, {"kind": "xla_audit", "name": "epoch_program", "census_ok": True}),
+        (4, {"kind": "checkpoint", "name": "step", "global_step": 8}),
+        (5, {"kind": "serving", "name": "summary", "completed": 7}),
+    ):
+        p = tmp_path / f"old-v{v}.jsonl"
+        p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
+        assert read_jsonl(p)[0]["kind"] == rec["kind"]
+    # one-directional refusal: a v7 file fails loudly
+    v7 = tmp_path / "v7.jsonl"
+    v7.write_text(json.dumps({"v": SCHEMA_VERSION + 1, "kind": "event"}) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        read_jsonl(v7)
+    n = NullMetrics()
+    n.serving_health("breaker_open", dispatch=1)
+    n.reload("ok", path="x")
 
 
 def test_jsonl_multihost_shard_suffix_and_glob_read(tmp_path, monkeypatch):

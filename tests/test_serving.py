@@ -395,6 +395,50 @@ def test_loadgen_seeded_and_deterministic():
         loadgen.poisson_arrivals(0, 5)
 
 
+def test_closed_vs_open_loop_deadline_accounting(data_dir, monkeypatch):
+    """Satellite pin (loadgen.py docstrings): the open loop backdates
+    enqueue to the SCHEDULED arrival, so deadlines burn against queue
+    backlog (coordinated-omission corrected — a backlogged stream sheds /
+    misses); the closed loop never backdates, so deadlines score pure
+    service latency and the same stream meets them all."""
+    run = _session(data_dir)
+    orig = run.predict
+
+    def slow_predict(x):
+        import time as _t
+
+        _t.sleep(0.02)  # one dispatch >= 20 ms, deterministic ordering
+        return orig(x)
+
+    monkeypatch.setattr(run, "predict", slow_predict)
+    rng = np.random.RandomState(21)
+    payloads = [rng.randn(2, SIZES[0]).astype(np.float32) for _ in range(6)]
+    # open loop: all six arrive at t=0 but serve one per dispatch — the
+    # tail's deadline (60 ms) is provably dead after three 20 ms dispatches
+    eng_open = ServingEngine(run, max_slots=1)
+    done_open = loadgen.run_open_loop(
+        eng_open, payloads, arrivals=[0.0] * 6, deadline_ms=60.0
+    )
+    assert len(done_open) == 6
+    open_missed = [
+        r for r in done_open if r.verdict == "expired" or r.slo_ok() is False
+    ]
+    assert open_missed, "backlogged open-loop stream must miss deadlines"
+    # every request's clock starts at the shared scheduled arrival
+    assert len({r.enqueue_t for r in done_open}) == 1
+    # closed loop, same stream and deadline: admission waits for a free
+    # slot, so each request's 60 ms covers only its own ~20 ms dispatch
+    eng_closed = ServingEngine(run, max_slots=1)
+    done_closed = loadgen.run_closed_loop(
+        eng_closed, payloads, concurrency=1, deadline_ms=60.0
+    )
+    assert len(done_closed) == 6
+    assert all(r.verdict == "ok" and r.slo_ok() is True for r in done_closed)
+    # submit-time clocks: strictly increasing, never backdated
+    ts = [r.enqueue_t for r in sorted(done_closed, key=lambda r: r.id)]
+    assert all(b > a for a, b in zip(ts, ts[1:]))
+
+
 def test_loadgen_drivers_complete_all(data_dir):
     run = _session(data_dir, dp=2)
     payloads = loadgen.request_payloads(15, SIZES[0], seed=6)
