@@ -7,8 +7,9 @@ CPU_MESH = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 SHELL := /bin/bash
 
 .PHONY: test verify metrics-smoke report-smoke audit-smoke overlap-smoke \
-        split-smoke recovery-smoke serve-smoke chaos-smoke bench-serving \
-        data train train-mesh bench bench-scaling schedules clean
+        split-smoke tp-smoke recovery-smoke serve-smoke chaos-smoke \
+        bench-serving data train train-mesh bench bench-scaling schedules \
+        clean
 
 test:
 	python -m pytest tests/ -q
@@ -119,6 +120,44 @@ split-smoke:
 	done
 	@echo "split-smoke OK: bitwise hash parity + clean census + weighted-bubble row on gpipe and pipedream"
 
+# tensor-parallelism end-to-end (docs/performance.md "--tp"): 1 CPU epoch
+# each for tp2 and dp2 x tp2 with --audit — train.py aborts nonzero if the
+# compiled census violates the per-axis contract (the tp axis demands the
+# Megatron all-reduce floor) — then assert the census landed clean with a
+# tp axis + a mesh_layout provenance event, the report renders the per-axis
+# Comms breakdown (tp next to dp/pp), the tp2 loss equals the sequential
+# reference's within the documented cross-layout float tolerance (the tp
+# psums reassociate split contractions — same tolerance class as a dp-width
+# change, so HASH equality is deliberately NOT claimed across tp), and the
+# tp=1 anchor holds EXACTLY: --dp 2 --tp 1 hashes byte-identically to the
+# historical --dp 2 program (needs data, like metrics-smoke)
+tp-smoke:
+	rm -f /tmp/tp_seq.jsonl /tmp/tp_tp2.jsonl /tmp/tp_dp2tp2.jsonl \
+	    /tmp/tp_seq.out /tmp/tp_tp2.out /tmp/tp_anchor1.out /tmp/tp_anchor2.out
+	set -o pipefail; $(CPU_MESH) python train.py --epochs 1 --no-eval \
+	    --metrics-out /tmp/tp_seq.jsonl | tee /tmp/tp_seq.out
+	set -o pipefail; $(CPU_MESH) python train.py --epochs 1 --no-eval \
+	    --audit --tp 2 --metrics-out /tmp/tp_tp2.jsonl | tee /tmp/tp_tp2.out
+	$(CPU_MESH) python train.py --epochs 1 --no-eval --audit --dp 2 --tp 2 \
+	    --metrics-out /tmp/tp_dp2tp2.jsonl
+	set -o pipefail; $(CPU_MESH) python train.py --epochs 1 --no-eval --dp 2 \
+	    | tee /tmp/tp_anchor1.out
+	set -o pipefail; $(CPU_MESH) python train.py --epochs 1 --no-eval --dp 2 \
+	    --tp 1 | tee /tmp/tp_anchor2.out
+	set -e; for f in /tmp/tp_tp2 /tmp/tp_dp2tp2; do \
+	  python -c "import json,sys; p=sys.argv[1]; recs=[json.loads(l) for l in open(p) if l.strip()]; a=[r for r in recs if r.get('kind')=='xla_audit']; assert a, p+': no xla_audit record'; assert all(r.get('census_ok') for r in a), p+': census mismatch'; tp=[r['expected']['axes'].get('tp') for r in a if r.get('name')=='epoch_program'][-1]; assert tp and tp['hlo_min_all_reduce_ops']==tp['sites_fwd']+tp['sites_bwd']>0, p+': no tp axis in the contract'; ml=[r for r in recs if r.get('kind')=='event' and r.get('name')=='mesh_layout']; assert ml and ml[-1]['layout'] in ('topology-aware','order-preserving'), p+': no mesh_layout provenance'; print(p+': tp census clean (%d Megatron sites, %s placement)' % (tp['hlo_min_all_reduce_ops'], ml[-1]['layout']))" $$f.jsonl; \
+	  python -m shallowspeed_tpu.observability.report $$f.jsonl --format md > $$f.report.md; \
+	  grep -q "Comms (XLA program audit)" $$f.report.md; \
+	  grep -q "tp all_reduce" $$f.report.md; \
+	done
+	python -c "import json,re,sys; loss=lambda p: [r for r in (json.loads(l) for l in open(p) if l.strip()) if r.get('kind')=='event' and r.get('name')=='epoch'][-1]['loss']; s, t = loss('/tmp/tp_seq.jsonl'), loss('/tmp/tp_tp2.jsonl'); rel=abs(s-t)/max(abs(s),1e-12); assert rel < 1e-3, 'tp2 loss %r vs sequential %r (rel %g)' % (t, s, rel); print('tp2 loss == sequential reference within float tolerance (rel %.2e)' % rel)"
+	set -e; h1=$$(grep -o 'final model hash: [0-9a-f]*' /tmp/tp_anchor1.out); \
+	  h2=$$(grep -o 'final model hash: [0-9a-f]*' /tmp/tp_anchor2.out); \
+	  test -n "$$h1" && test "$$h1" = "$$h2" \
+	    || { echo "tp=1 ANCHOR BROKEN: --dp 2 [$$h1] vs --dp 2 --tp 1 [$$h2]"; exit 1; }; \
+	  echo "tp=1 anchor holds: --tp 1 hash == historical 2-axis hash"
+	@echo "tp-smoke OK: census-clean tp2 + dp2xtp2 with per-axis Comms, sequential-reference loss parity, tp=1 byte-anchor"
+
 # fault-tolerant recovery end-to-end (docs/robustness.md): on a dp2 and a
 # gpipe-pp4 layout, run an uninterrupted twin, then KILL a checkpointing run
 # with a SIGKILL injected at step 11 via the fault harness
@@ -178,14 +217,18 @@ recovery-smoke:
 # offered-load sweep JSON (p50/p99 latency, goodput, queue depth,
 # saturation knee), exit 0 (needs data, like metrics-smoke)
 serve-smoke:
-	rm -f /tmp/serve_dp.jsonl /tmp/serve_pp.jsonl /tmp/serve_bench.json
+	rm -f /tmp/serve_dp.jsonl /tmp/serve_pp.jsonl /tmp/serve_tp.jsonl \
+	    /tmp/serve_bench.json
 	$(CPU_MESH) python -m shallowspeed_tpu.serving --dp 2 \
 	    --requests 200 --rate 300 --seed 0 --slo-ms 2000 --verify --audit \
 	    --metrics-out /tmp/serve_dp.jsonl
 	$(CPU_MESH) python -m shallowspeed_tpu.serving --pp 4 --schedule gpipe \
 	    --requests 200 --rate 300 --seed 0 --slo-ms 2000 --verify --audit \
 	    --metrics-out /tmp/serve_pp.jsonl
-	set -e; for f in /tmp/serve_dp /tmp/serve_pp; do \
+	$(CPU_MESH) python -m shallowspeed_tpu.serving --tp 2 \
+	    --requests 200 --rate 300 --seed 0 --slo-ms 2000 --verify --audit \
+	    --metrics-out /tmp/serve_tp.jsonl
+	set -e; for f in /tmp/serve_dp /tmp/serve_pp /tmp/serve_tp; do \
 	  python -c "import json,sys; p=sys.argv[1]; recs=[json.loads(l) for l in open(p) if l.strip()]; reqs=[r for r in recs if r.get('kind')=='request']; assert len(reqs)==200, p+': %d request records' % len(reqs); assert all(r['name']=='ok' for r in reqs), p+': dropped/failed requests'; srv=[r for r in recs if r.get('kind')=='serving']; assert srv, p+': no serving summary'; a=[r for r in recs if r.get('kind')=='xla_audit']; assert a and all(r.get('census_ok') for r in a), p+': serving census not clean'; print(p+': 200 ok requests, clean serving census')" $$f.jsonl; \
 	  python -m shallowspeed_tpu.observability.report $$f.jsonl --format md \
 	      --slo-ms 2000 > $$f.report.md; \
@@ -196,7 +239,7 @@ serve-smoke:
 	    --rates 100,300 --requests 40 --seed 0 --slo-ms 2000 \
 	    --out /tmp/serve_bench.json
 	python -c "import json; rec=json.load(open('/tmp/serve_bench.json')); assert rec['bench']=='serving' and rec['bench_version']==1; rows=rec['sweep']; assert len(rows)==2 and all(r['p50_latency_s'] and r['p99_latency_s'] is not None and r['queue_depth_max'] is not None and r['goodput_rps'] is not None for r in rows), rows; print('bench_serving: %d-rate sweep, knee=%s' % (len(rows), rec['knee_rps']))"
-	@echo "serve-smoke OK: 200 bitwise-verified Poisson requests on dp2 and gpipe-pp4, Serving section + SLO verdict rendered, bench_serving sweep recorded"
+	@echo "serve-smoke OK: 200 bitwise-verified Poisson requests on dp2, gpipe-pp4 and tp2, Serving section + SLO verdict rendered, bench_serving sweep recorded"
 
 # serving-layer fault tolerance end-to-end (docs/robustness.md "Serving
 # faults"): on a CPU dp2 and a gpipe-pp4 layout, train a short run that

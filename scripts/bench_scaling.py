@@ -80,7 +80,7 @@ def bench_sequential(nb, reps, sizes=SIZES):
 
 def _pipeline_epoch_setup(
     dp, pp, sched_name, nb, virtual=1, sizes=SIZES, zero1=False,
-    optimizer=None, grad_bucket_bytes=0, backward_split=False,
+    optimizer=None, grad_bucket_bytes=0, backward_split=False, tp=1,
 ):
     """Build one mesh config's epoch fn + initial state + data: the shared
     setup behind the plain timing rows and the same-window pairs. Returns
@@ -94,7 +94,7 @@ def _pipeline_epoch_setup(
     from shallowspeed_tpu.parallel import executor as E
     from shallowspeed_tpu.parallel import lower_schedule, make_mesh
 
-    mesh = make_mesh(dp, pp)
+    mesh = make_mesh(dp, pp, tp=tp)
     spec = Mo.make_model_spec(sizes, pp * virtual, B)
     order = E.interleave_order(pp * virtual, pp) if virtual > 1 else None
     prog = lower_schedule(
@@ -189,6 +189,81 @@ def bench_sync_pair(name, cfg, nb):
                 "zero1": zero1,
                 "same_window": True,
                 "vs_anchor": round(sps / anchor_sps, 4),
+            }
+        )
+    return records
+
+
+# tensor-parallel vs sequential pairs: same-window via the interleaved-trial
+# slope protocol. TP's win is weight-bandwidth/matmul-size denominated (per-
+# device weight memory and matmul FLOPs drop by tp at 2 all-reduces per layer
+# pair); on emulated CPU devices the extra dispatch + memcpy "collectives"
+# are pure overhead against an op-issue-bound MLP, so — exactly like the
+# grad-bucket and split-backward pairs — expect seq to win here and the
+# ratio to mean something only on a real multi-chip mesh. Records carry tp,
+# vs_seq and the mesh placement note so the pending on-chip tunnel window
+# re-measures self-describing rows.
+TP_PAIRS = [
+    ("tp2", dict(dp=1, pp=1, tp=2)),
+    ("dp2tp2", dict(dp=2, pp=1, tp=2)),
+]
+
+
+def bench_tp_pair(name, cfg, nb):
+    """One sequential-vs-tp pair, same-window: returns a list of record
+    dicts (one per mode) carrying tp + vs_seq + the mesh layout note."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import make_run_k, slope_epoch_seconds_many
+
+    from shallowspeed_tpu import model as Mo
+    from shallowspeed_tpu import trainer
+    from shallowspeed_tpu.optimizer import SGD
+    from shallowspeed_tpu.parallel.mesh import make_mesh_with_layout
+
+    dp, pp, tp = cfg["dp"], cfg["pp"], cfg["tp"]
+    run_ks = {}
+    # sequential leg
+    spec1 = Mo.make_model_spec(SIZES, 1, B)
+    params = jax.tree.map(jnp.asarray, Mo.init_model(spec1))
+    seq_epoch = trainer.make_train_epoch(spec1, SGD(LR))
+    X, Y = _data(nb, np.random.RandomState(0))
+    Xe = jnp.asarray(X.reshape(nb, M, B // M, -1))
+    Ye = jnp.asarray(Y.reshape(nb, M, B // M, -1))
+
+    def seq_fn(p, s, X_, Y_, _e=seq_epoch):
+        return _e(p, s, X_, Y_)
+
+    run_ks[f"{name}-seq"] = make_run_k(seq_fn, params, (), Xe, Ye)
+    # tp leg: the shared mesh setup, plus the placement note for the
+    # records (deterministic — same device order as the setup's mesh)
+    mesh_layout = make_mesh_with_layout(dp, pp, tp=tp)[1]
+    _, epoch, stacked, flags, st, Xj, Yj = _pipeline_epoch_setup(
+        dp, pp, "gpipe", nb, tp=tp
+    )
+
+    def tp_fn(p, s, X_, Y_, _e=epoch, _f=flags):
+        return _e(p, _f, s, X_, Y_)
+
+    run_ks[f"{name}-tp"] = make_run_k(tp_fn, stacked, st, Xj, Yj)
+    slopes = slope_epoch_seconds_many(run_ks, k1=1, k2=3, trials=2, min_delta_s=0)
+    seq_sps = nb * B / slopes[f"{name}-seq"]
+    records = []
+    for label, tp_val, devices in (
+        (f"{name}-seq", 1, 1),
+        (f"{name}-tp", tp, dp * pp * tp),
+    ):
+        sps = nb * B / slopes[label]
+        records.append(
+            {
+                "config": label,
+                "devices": devices,
+                "samples_per_sec": round(sps, 1),
+                "tp": tp_val,
+                "mesh_layout": mesh_layout if tp_val > 1 else None,
+                "same_window": True,
+                "vs_seq": round(sps / seq_sps, 4),
             }
         )
     return records
@@ -328,6 +403,15 @@ def main():
             print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
             continue
         for rec in bench_split_pair(name, cfg, args.batches):
+            print(json.dumps(rec))
+
+    # the sequential-vs-tensor-parallel pairs (same-window per pair)
+    for name, cfg in TP_PAIRS:
+        need = cfg["dp"] * cfg["pp"] * cfg["tp"]
+        if need > n_dev:
+            print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
+            continue
+        for rec in bench_tp_pair(name, cfg, args.batches):
             print(json.dumps(rec))
 
 

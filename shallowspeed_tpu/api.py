@@ -12,9 +12,11 @@ library object, so notebooks/tests/benchmarks get everything the CLI does:
         print(run.epoch, loss, run.accuracy())
     run.save("ck.npz")
 
-Layouts are uniform: dp=pp=1 uses the fast sequential jitted path, anything
-else the SPMD pipeline executor — same weights either way (tested layout
-equivalence).
+Layouts are uniform: dp=pp=tp=1 uses the fast sequential jitted path,
+anything else the SPMD pipeline executor — same weights either way (tested
+layout equivalence; ``tp`` adds the Megatron model axis, whose split
+contractions carry the same cross-layout float tolerance a dp-width change
+does, while tp=1 programs stay byte-identical to the pre-TP anchors).
 """
 
 import sys
@@ -50,7 +52,8 @@ from shallowspeed_tpu.optimizer import (
 )
 from shallowspeed_tpu.parallel import executor as E
 from shallowspeed_tpu.parallel import gradsync
-from shallowspeed_tpu.parallel import lower_schedule, make_mesh
+from shallowspeed_tpu.parallel import lower_schedule
+from shallowspeed_tpu.parallel.mesh import make_mesh_with_layout
 from shallowspeed_tpu.parallel.lowering import program_flops, program_stats
 from shallowspeed_tpu.serving import slots as serving_slots
 
@@ -77,6 +80,7 @@ class TrainingSession:
         sizes=FLAGSHIP_SIZES,
         dp=1,
         pp=1,
+        tp=1,
         schedule="gpipe",
         global_batch_size=128,
         mubatches=4,
@@ -137,7 +141,9 @@ class TrainingSession:
         local_batch = global_batch_size // dp
         if local_batch % mubatches != 0:
             raise ValueError("mubatches must divide the local batch")
-        self.dp, self.pp = dp, pp
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        self.dp, self.pp, self.tp = dp, pp, int(tp)
         self.B, self.M = global_batch_size, mubatches
         self.schedule = schedule
         if precision not in PRECISIONS:
@@ -150,7 +156,9 @@ class TrainingSession:
                 f"schedule must be one of {sorted(S.SCHEDULES)}, got {schedule!r}"
             )
         self.precision = PRECISIONS[precision]
-        if fuse_mubatches and not (dp == 1 and pp == 1 and virtual_stages == 1):
+        if fuse_mubatches and not (
+            dp == 1 and pp == 1 and virtual_stages == 1 and tp == 1
+        ):
             raise ValueError(
                 "fuse_mubatches applies to the sequential path only; in the "
                 "pipeline executor microbatches are semantic (they ARE the "
@@ -191,8 +199,14 @@ class TrainingSession:
         if scan_unroll < 1 or tick_unroll < 1:
             raise ValueError("scan_unroll/tick_unroll must be >= 1")
         self.V = virtual_stages
-        self._sequential = dp == 1 and pp == 1 and virtual_stages == 1
+        self._sequential = dp == 1 and pp == 1 and virtual_stages == 1 and tp == 1
         self._kernel_backend = kernel_backend
+        if kernel_backend == "pallas" and tp > 1:
+            raise ValueError(
+                "tensor parallelism (tp > 1) shards each slot's W across "
+                "the tp axis; the fused pallas flag kernels compute whole "
+                "slots — use kernel_backend='xla'"
+            )
         if kernel_backend == "pallas" and self._sequential:
             raise ValueError(
                 "kernel_backend='pallas' selects the pipeline executor's "
@@ -511,7 +525,18 @@ class TrainingSession:
             self._Ye = self._Y.reshape(nb, self.M, self.B // self.M, -1)
             self._X = self._Y = None  # the microbatched views are the only users
         else:
-            self.mesh = make_mesh(dp, pp, devices)
+            self.mesh, self._mesh_layout = make_mesh_with_layout(
+                dp, pp, devices, tp
+            )
+            if self._metrics.enabled:
+                # placement provenance (topology-aware vs order-preserving):
+                # a bench record measured on one placement must say so —
+                # the two differ materially on a real slice
+                self._metrics.event(
+                    "mesh_layout",
+                    dp=dp, pp=pp, tp=self.tp, layout=self._mesh_layout,
+                    n_devices=dp * pp * self.tp,
+                )
             with self._metrics.span("schedule_lower"):
                 prog = lower_schedule(
                     S.SCHEDULES[schedule], mubatches, pp, virtual=self.V,
@@ -524,14 +549,17 @@ class TrainingSession:
                 stats = program_stats(prog)
                 self._metrics.event(
                     "pipeline_program",
-                    schedule=schedule, dp=dp, pp=pp, virtual=self.V, **stats,
+                    schedule=schedule, dp=dp, pp=pp, tp=self.tp,
+                    virtual=self.V, **stats,
                 )
                 self._metrics.gauge(
                     "pipeline.bubble_fraction", stats["bubble_fraction"]
                 )
             with self._metrics.span("device_put"):
                 self._stacked, self._flags = E.put_stacked(
-                    *E.stack_params(host_params, self.spec, order=self._order),
+                    *E.stack_params(
+                        host_params, self.spec, order=self._order, tp=self.tp
+                    ),
                     self.mesh,
                 )
             if self._zero1:
@@ -546,8 +574,10 @@ class TrainingSession:
                 self._opt_state = join_state(
                     opt,
                     {
-                        k: E.put_pp(
-                            E.stack_params(v, self.spec, order=self._order)[0],
+                        k: E.put_stacked_tree(
+                            E.stack_params(
+                                v, self.spec, order=self._order, tp=self.tp
+                            )[0],
                             self.mesh,
                         )
                         for k, v in host_opt_state["parts"].items()
@@ -587,14 +617,20 @@ class TrainingSession:
         if self._sequential:
             platform = jax.devices()[0].platform
             padded = None
+            self._mesh_layout = None
         else:
             platform = self.mesh.devices.flat[0].platform
-            padded = program_flops(self._prog, self.spec, self._mubatch_local) * dp
+            padded = (
+                program_flops(
+                    self._prog, self.spec, self._mubatch_local, tp=self.tp
+                )
+                * dp
+            )
         self._cost_model = costmodel.CostModel(
             sizes=self.spec.sizes,
             global_batch=self.B,
             batches_per_epoch=self.batches_per_epoch,
-            n_devices=1 if self._sequential else dp * pp,
+            n_devices=1 if self._sequential else dp * pp * self.tp,
             platform=platform,
             precision=self._precision_name,
             padded_flops_per_batch=padded,
@@ -608,15 +644,16 @@ class TrainingSession:
         self._sync_plan = None
         if grad_bucket_bytes and not self._sequential:
             self._sync_plan = gradsync.plan_buckets(
-                self.spec, dp, pp, grad_bucket_bytes, zero1=self._zero1
+                self.spec, dp, pp, grad_bucket_bytes, zero1=self._zero1,
+                tp=self.tp,
             )
             if self._metrics.enabled:
                 # the plan is static telemetry, recorded once like the
                 # pipeline program stats: bucket count + sizes make every
                 # later throughput/audit record self-describing
                 self._metrics.event(
-                    "grad_sync_plan", dp=dp, pp=pp, zero1=self._zero1,
-                    **self._sync_plan.describe(),
+                    "grad_sync_plan", dp=dp, pp=pp, tp=self.tp,
+                    zero1=self._zero1, **self._sync_plan.describe(),
                 )
         self._expected_comms = program_audit.expected_comms(
             self.spec,
@@ -628,6 +665,7 @@ class TrainingSession:
             platform=platform,
             precision=self._precision_name,
             grad_bucket_plan=self._sync_plan,
+            tp=self.tp,
         )
         if self._recovery is not None and self._metrics.enabled:
             # one schema-v4 recovery record per resume decision: what was
@@ -1401,6 +1439,7 @@ class TrainingSession:
                     mubatch_size=self._slot_rows // self.dp,
                     platform=self._cost_model.platform,
                     precision=self._precision_name,
+                    tp=self.tp,
                 )
                 with self._metrics.span("jit_compile"):
                     compiled = step.lower(
@@ -1435,6 +1474,7 @@ class TrainingSession:
             dp=self.dp,
             platform=self._cost_model.platform,
             precision=self._precision_name,
+            tp=self.tp,
         )
 
     def accuracy(self) -> float:
@@ -1511,7 +1551,9 @@ class TrainingSession:
                 # keep the session's existing flags array (identical
                 # content) — only the weight planes swap
                 self._stacked, _ = E.put_stacked(
-                    *E.stack_params(host_params, self.spec, order=self._order),
+                    *E.stack_params(
+                        host_params, self.spec, order=self._order, tp=self.tp
+                    ),
                     self.mesh,
                 )
         return meta

@@ -81,7 +81,7 @@ def peak_flops_per_chip(platform, precision="highest"):
 
 
 def serving_latency_bound(
-    prog, spec, slot_rows, dp=1, platform="cpu", precision="highest"
+    prog, spec, slot_rows, dp=1, platform="cpu", precision="highest", tp=1
 ):
     """Analytical latency floor for ONE request slot through the layout's
     inference program — the model-side number the serving bench and report
@@ -113,7 +113,9 @@ def serving_latency_bound(
         from shallowspeed_tpu.parallel.executor import slot_shapes
         from shallowspeed_tpu.parallel.lowering import weighted_makespan
 
-        padded_p = sum(o * i for o, i in slot_shapes(spec))
+        # per-DEVICE floor: the Megatron shards split every slot matmul,
+        # so a tp rank executes 1/tp of the (tp-rounded) padded stack
+        padded_p = sum(o * i for o, i in slot_shapes(spec, tp)) // max(tp, 1)
         weighted = weighted_makespan(prog)  # forward-units (fwd weight 1.0)
         ticks = int(prog.num_ticks)
         flops = weighted * 2 * (slot_rows // dp) * padded_p

@@ -294,6 +294,7 @@ def expected_comms(
     platform="cpu",
     precision="highest",
     grad_bucket_plan=None,
+    tp=1,
 ):
     """The layout's analytical comms contract, derived from the model spec
     and (on mesh layouts) the LOWERED tick tables — the numbers the
@@ -337,6 +338,22 @@ def expected_comms(
       grad/census bytes; total bytes unchanged) that ``check_census``
       verifies against the compiled ops;
 
+      * ``tp`` (tp > 1 only): the Megatron all-reduces — one psum over
+        'tp' per row-parallel slot forward (plus the closing gather when
+        the last slot is column-parallel) and one per column-parallel
+        slot backward, i.e. 2 per layer pair per fwd+bwd pass. Site
+        widths come from ``executor.tp_allreduce_sites`` (the REAL
+        tp-rounded activation shapes), the per-step dynamic bytes from
+        the tick program's cell counts (every (device, chunk) stage runs
+        M microbatch passes per step), and ``hlo_min_all_reduce_ops`` is
+        the STRUCTURAL floor ``check_census`` enforces: the compiled
+        program must hold at least that many all-reduce ops (each psum
+        site is a distinct op inside its tick branch; the dp sync, loss
+        and norm reductions only add more). The tp gradient sync is
+        deliberately absent — TP shards the weights, so the dp axis
+        already moves 1/tp per device and no extra gradient collective
+        exists over tp;
+
     - ``bytes_per_step_per_device``: the axes' total;
     - ``comms_time_per_step_s``: bandwidth-bound lower bound at the
       platform's interconnect peak (with provenance);
@@ -369,6 +386,29 @@ def expected_comms(
 
         forbidden.append("all_to_all")
         inference = not prog.is_training
+        if tp > 1:
+            # the Megatron axis: its all-reduces exist in BOTH training and
+            # inference programs (forward row-slot psums survive either
+            # way), so the kind is required and a structural op-count floor
+            # rides the axis entry for check_census
+            from shallowspeed_tpu.parallel.executor import tp_allreduce_sites
+
+            fwd_w, bwd_w = tp_allreduce_sites(spec, tp, training=not inference)
+            cells = prog.num_chunks * prog.num_micro_batches
+            payload = 4 * mubatch_size * cells * (sum(fwd_w) + sum(bwd_w))
+            axes["tp"] = {
+                "kind": "all_reduce",
+                "algorithm": "ring",
+                "sites_fwd": len(fwd_w),
+                "sites_bwd": len(bwd_w),
+                "site_payload_bytes": [
+                    4 * mubatch_size * w for w in list(fwd_w) + list(bwd_w)
+                ],
+                "allreduce_bytes_per_device": int(payload),
+                "bytes_per_step_per_device": int(2 * (tp - 1) / tp * payload),
+                "hlo_min_all_reduce_ops": len(fwd_w) + len(bwd_w),
+            }
+            required.append("all_reduce")
         if pp > 1:
             # only a real pipeline axis demands the relay permutes; at
             # pp == 1 the executor still emits them, but as SELF-LOOPS —
@@ -406,11 +446,14 @@ def expected_comms(
                 required.append("all_reduce")
                 from shallowspeed_tpu.parallel.executor import slot_shapes
 
+                # the executor psums the PADDED head width — tp-rounded
+                # when a tp axis is active (slot dims round to tp
+                # multiples), so the contract sizes what really moves
                 preds_bytes = (
                     4
                     * prog.num_micro_batches
                     * mubatch_size
-                    * slot_shapes(spec)[-1][0]
+                    * slot_shapes(spec, tp)[-1][0]
                 )
                 axes["preds"] = {
                     "kind": "all_reduce",
@@ -438,12 +481,16 @@ def expected_comms(
             # definition, shared with the executor's emitters:
             # gradsync.sync_comm_bytes
             axes["dp"] = sync_comm_bytes(
-                spec, dp, pp, zero1=zero1, plan=grad_bucket_plan
+                spec, dp, pp, zero1=zero1, plan=grad_bucket_plan, tp=tp
             )
         # per-device padded compute: the tick program's FLOPs are the whole
-        # pp-group's; SPMD uniformity splits them evenly across devices
-        flops_per_step = program_flops(prog, spec, mubatch_size) / pp
+        # pp x tp group's; SPMD uniformity (and the Megatron shards) split
+        # them evenly across devices
+        flops_per_step = program_flops(prog, spec, mubatch_size, tp=tp) / (pp * tp)
 
+    # a kind may be demanded by several axes (dp sync + tp psums are both
+    # all-reduce); the contract lists it once
+    required = list(dict.fromkeys(required))
     total = sum(a["bytes_per_step_per_device"] for a in axes.values())
     bw, bw_source = interconnect_bytes_per_sec(platform)
     peak, peak_source = peak_flops_per_chip(platform, precision)
@@ -462,6 +509,7 @@ def expected_comms(
     return {
         "dp": int(dp),
         "pp": int(pp),
+        "tp": int(tp),
         "zero1": bool(zero1),
         "sequential": sequential,
         "inference": bool(prog is not None and not prog.is_training),
@@ -519,19 +567,51 @@ def check_census(census, expected, ops=None):
                 "pipeline relay must permute in BOTH directions "
                 f"(>= 2 collective-permutes); compiled program has {n}"
             )
-    if expected.get("inference"):
+    tp_axis = (expected.get("axes") or {}).get("tp") or {}
+    if expected.get("inference") and not tp_axis:
         # a forward-only program has exactly one lawful all-reduce — the
         # preds psum over pp (it survives compilation even at pp=1,
         # measured on the CPU backend) — so a second one means a
         # gradient-sync collective leaked into the serving path. Zero is
         # tolerated: a backend MAY elide the degenerate psum, and the
-        # required-kinds leg above still demands it at pp > 1.
+        # required-kinds leg above still demands it at pp > 1. At tp > 1
+        # this exact pin is replaced by the tp-axis floor below (the
+        # Megatron row-slot psums are lawful forward all-reduces); the
+        # reduce-scatter/all-gather prohibition still catches a leaked
+        # ZeRO gradient sync there.
         n = census.get("all_reduce", {}).get("count", 0)
         if n > 1:
             mismatches.append(
                 "forward-only inference program must lower at most ONE "
                 f"all-reduce (the preds psum); compiled program has {n} — "
                 "a gradient sync leaked into the serving path"
+            )
+    if tp_axis:
+        # the Megatron structural floor: each tp psum site is a distinct
+        # all-reduce op inside its tick branch (HLO holds branch bodies
+        # once); dp sync / loss / norm reductions only ADD ops, so a
+        # census below the floor means the tp lowering dropped collectives
+        need = int(tp_axis.get("hlo_min_all_reduce_ops", 0))
+        n = census.get("all_reduce", {}).get("count", 0)
+        if n < need:
+            mismatches.append(
+                f"tensor-parallel program must hold >= {need} all-reduce "
+                f"ops ({tp_axis.get('sites_fwd')} forward + "
+                f"{tp_axis.get('sites_bwd')} backward Megatron psum sites); "
+                f"compiled program has {n}"
+            )
+        if expected.get("inference") and n > need + 1:
+            # the forward-only UPPER pin survives tp: the lawful ops are
+            # exactly the Megatron sites plus the one preds psum (the tp
+            # psums form a dependency chain over distinct replica groups,
+            # so no combiner can merge them) — anything beyond reads as a
+            # leaked gradient all-reduce, same class the tp=1 at-most-one
+            # pin catches
+            mismatches.append(
+                f"forward-only tensor-parallel program must lower at most "
+                f"{need + 1} all-reduce ops ({need} Megatron sites + the "
+                f"preds psum); compiled program has {n} — a gradient sync "
+                "leaked into the serving path"
             )
     mismatches += _check_bucketed_sync(census, expected, ops)
     return mismatches

@@ -9,11 +9,13 @@ program for all devices. The bridge is this package:
                 into a static *clock-tick program* (numpy tables) where every
                 tick every stage runs the same jitted tick function and
                 payloads move between neighbor stages via jax.lax.ppermute;
-- ``mesh``      builds the 2-D (dp, pp) jax.sharding.Mesh that replaces the
-                reference's two MPI communicators (train.py:87-94);
+- ``mesh``      builds the (dp, pp[, tp]) jax.sharding.Mesh that replaces
+                the reference's two MPI communicators (train.py:87-94) —
+                the optional third axis is Megatron tensor parallelism;
 - ``executor``  the shard_map + lax.scan runtime executing tick programs over
                 padded stacked stage parameters, with jax.lax.psum as the DP
-                gradient all-reduce.
+                gradient all-reduce and per-slot column/row tp shards when
+                the mesh carries a tp axis.
 """
 
 from shallowspeed_tpu.parallel.lowering import TickProgram, lower_schedule

@@ -38,7 +38,17 @@ optimizer step — is ONE jitted XLA computation:
   bucketing its docstring wishes for; both modes are bitwise identical
   (psum reduces elementwise per leaf);
 - the optimizer step happens on-device on the padded params (padded regions
-  receive exactly-zero gradients, so they stay zero — see tests).
+  receive exactly-zero gradients, so they stay zero — see tests);
+- on a mesh with a ``tp`` axis (parallel/mesh.py, ``--tp``), every slot's
+  W is additionally Megatron-sharded across the tp ranks — even slots
+  column-parallel, odd slots row-parallel, one ``psum`` over ``tp`` per
+  row slot forward and per column slot backward (2 all-reduces per layer
+  pair per pass; see the tp stage functions below). Slot dims round up to
+  tp multiples (``slot_shapes(spec, tp)``), per-device weight memory /
+  optimizer state / matmul FLOPs divide by tp, and tp composes with DP,
+  ZeRO-1, grad bucketing, the split backward and every schedule. At
+  ``tp == 1`` none of this code is traced: the historical 2-axis programs
+  are byte-identical.
 
 Zero-padding invariant: weights are zero outside each layer's logical
 (out_dim, in_dim) block, activations are zero beyond each boundary's true
@@ -62,6 +72,7 @@ from shallowspeed_tpu import ops
 from shallowspeed_tpu.model import ModelSpec, init_model
 from shallowspeed_tpu.parallel.compat import shard_map
 from shallowspeed_tpu.parallel.lowering import OP_BWD, OP_BWD_W, OP_FWD, TickProgram
+from shallowspeed_tpu.parallel.mesh import mesh_tp
 
 
 # ---------------------------------------------------------------------------
@@ -69,12 +80,22 @@ from shallowspeed_tpu.parallel.lowering import OP_BWD, OP_BWD_W, OP_FWD, TickPro
 # ---------------------------------------------------------------------------
 
 
-def slot_shapes(spec: ModelSpec):
+def slot_shapes(spec: ModelSpec, tp: int = 1):
     """Static per-slot stacked dims: [(out_l, in_l)] with maxima over stages.
 
     Also validates the passthrough-width invariant: any stage that is shorter
     than the deepest stage must have an out_dim that fits through every
     later slot's widths (true for the reference's monotone size lists).
+
+    ``tp > 1`` (tensor parallelism): each dim is rounded up to a multiple
+    of ``tp`` so every slot splits evenly across the tp ranks — the same
+    zero-padding invariant that already makes unequal stages exact makes
+    the extra columns exact. TP additionally requires the CHAINED width
+    equality ``in_{l} == out_{l-1}`` at every row-parallel (odd) slot: a
+    column slot hands its successor a rank-SHARD, and a shard of a
+    narrower fit is not the fit of a shard, so unequal chained widths
+    cannot be repaired locally. Monotone-decreasing size lists (the
+    reference family, and everything the fuzz generates) satisfy it.
     """
     L = max((s.n_linears for s in spec.stages), default=0) or 1
     dims = []
@@ -90,7 +111,71 @@ def slot_shapes(spec: ModelSpec):
                     f"stage with out_dim={s.out_dim} cannot pass through slot {l} "
                     f"of width {min(o, i)}; use equal-depth stages for this size list"
                 )
+    if tp > 1:
+        for l in range(1, L, 2):  # row-parallel slots consume a rank shard
+            if dims[l][1] != dims[l - 1][0]:
+                raise ValueError(
+                    f"tp={tp} needs chained slot widths (in_{l} == out_{l - 1}) "
+                    f"but slot {l} consumes {dims[l][1]} from a slot producing "
+                    f"{dims[l - 1][0]}; use a monotone-decreasing size list"
+                )
+        dims = [(-(-o // tp) * tp, -(-i // tp) * tp) for o, i in dims]
     return dims
+
+
+def tp_local_dims(dims, tp: int):
+    """Per-device slot geometry under ``tp``-way Megatron sharding, derived
+    from the (already tp-rounded) global stacked dims. Returns
+    ``(w_dims, b_widths, xs_widths, mask_widths)``:
+
+    - ``w_dims[l]``: this rank's W block — even (COLUMN-parallel) slots
+      hold an ``(out/tp, in)`` row band, odd (ROW-parallel) slots an
+      ``(out, in/tp)`` column band;
+    - ``b_widths[l]``: every bias is sharded ``out/tp`` (row-parallel
+      biases are rank-scattered and summed by the slot's psum, so no
+      parameter is ever tp-replicated — the grad-norm reduction over
+      ('pp','tp') counts each element exactly once);
+    - ``xs_widths[l]`` / ``mask_widths[l]``: the stashed residuals in the
+      representation the backward consumes — a column slot stashes its
+      FULL input and its SHARDED pre-activation mask, a row slot the
+      sharded input and the full post-psum mask.
+
+    At ``tp == 1`` every formula collapses to the unsharded dims, so the
+    tp=1 trace is byte-identical to the historical one.
+    """
+    w_dims = [
+        (o // tp, i) if l % 2 == 0 else (o, i // tp)
+        for l, (o, i) in enumerate(dims)
+    ]
+    b_widths = [o // tp for o, _ in dims]
+    xs_widths = [i if l % 2 == 0 else i // tp for l, (_, i) in enumerate(dims)]
+    mask_widths = [o // tp if l % 2 == 0 else o for l, (o, _) in enumerate(dims)]
+    return w_dims, b_widths, xs_widths, mask_widths
+
+
+def tp_allreduce_sites(spec: ModelSpec, tp: int, training: bool = True):
+    """The Megatron all-reduce sites of ONE stage pass at this tp degree:
+    ``(fwd_widths, bwd_widths)`` — payload widths (f32 columns of one
+    ``(mubatch, width)`` psum over 'tp') in execution order. Forward: one
+    psum per row-parallel (odd) slot, plus the closing reassembly when the
+    last slot is column-parallel (the stage boundary must relay the FULL
+    activation); backward (training only): one psum per column-parallel
+    (even) slot — the Megatron f-operator. For an even slot count this is
+    exactly 2 all-reduces per column/row layer pair per fwd+bwd pass.
+
+    This is the ONE site list: the executor's tp stage functions place
+    their psums by the same slot parity, and ``expected_comms`` sizes the
+    tp axis of the census contract from these widths — so the audited
+    contract and the traced program can never disagree about where the
+    tp collectives sit or how big they are.
+    """
+    dims = slot_shapes(spec, tp)
+    L = len(dims)
+    fwd = [dims[l][0] for l in range(1, L, 2)]
+    if (L - 1) % 2 == 0:
+        fwd.append(dims[-1][0])
+    bwd = [dims[l][1] for l in range(0, L, 2)] if training else []
+    return fwd, bwd
 
 
 def relay_width(spec: ModelSpec) -> int:
@@ -118,17 +203,20 @@ def interleave_order(n_stages: int, n_devices: int):
     return [(r % V) * n_devices + (r // V) for r in range(n_stages)]
 
 
-def stack_params(params_list, spec: ModelSpec, order=None):
+def stack_params(params_list, spec: ModelSpec, order=None, tp: int = 1):
     """Per-stage ragged params -> per-slot zero-padded stacks + flags.
 
     Returns (stacked, flags):
       stacked = {"W": tuple_l of (S, out_l, in_l), "b": tuple_l of (S, out_l)}
       flags   = {"active": (S,L), "relu": (S,L), "head_mask": (S, out_last)}
-    All numpy; device-put with ``put_stacked`` (P('pp') on the stage axis).
-    ``order[r]`` names the model stage stored at stacked row r (identity by
-    default; ``interleave_order`` for virtual-stage layouts).
+    All numpy; device-put with ``put_stacked`` (P('pp') on the stage axis;
+    per-slot column/row tp shards on a tp mesh). ``order[r]`` names the
+    model stage stored at stacked row r (identity by default;
+    ``interleave_order`` for virtual-stage layouts). ``tp`` pads the slot
+    dims to tp multiples (slot_shapes) — the HOST layout stays the full
+    global stack either way, so checkpoints are tp-independent.
     """
-    dims = slot_shapes(spec)
+    dims = slot_shapes(spec, tp)
     S = spec.n_stages
     L = len(dims)
     order = list(range(S)) if order is None else list(order)
@@ -180,20 +268,60 @@ def unstack_params(stacked, spec: ModelSpec, order=None):
 
 def put_pp(tree, mesh: Mesh):
     """device_put a stage-stacked pytree with P('pp') sharding on the stage
-    axis — the ONE place the stacked placement is defined (params, flags and
-    stacked optimizer-state parts all go through here)."""
+    axis — the ONE place the stacked placement is defined for tp-replicated
+    data (flags; params and state parts go through ``put_stacked_tree``,
+    which adds the per-slot tp shards on a tp mesh)."""
     pp = NamedSharding(mesh, P("pp"))
     return jax.tree.map(lambda x: jax.device_put(x, pp), tree)
 
 
+def stacked_param_specs(tp: int, L: int):
+    """The per-slot PartitionSpecs of a stacked {"W", "b"} tree: P('pp')
+    everywhere at tp == 1 (the historical placement, byte for byte); at
+    tp > 1, Megatron shards — even slots split W on the OUT dim
+    (column-parallel), odd slots on the IN dim (row-parallel), and every
+    bias on its out dim. One definition shared by ``put_stacked_tree``
+    and the executor's shard_map specs, so placement and program can
+    never disagree."""
+    if tp == 1:
+        pp = P("pp")
+        return {"W": (pp,) * L, "b": (pp,) * L}
+    return {
+        "W": tuple(
+            P("pp", "tp", None) if l % 2 == 0 else P("pp", None, "tp")
+            for l in range(L)
+        ),
+        "b": (P("pp", "tp"),) * L,
+    }
+
+
+def put_stacked_tree(stacked, mesh: Mesh):
+    """device_put one stacked {"W": tuple, "b": tuple} tree with the mesh's
+    per-slot shardings (``stacked_param_specs``). Params and every
+    params-mirroring optimizer-state part go through here."""
+    tp = mesh_tp(mesh)
+    if tp == 1:
+        return put_pp(stacked, mesh)
+    specs = stacked_param_specs(tp, len(stacked["W"]))
+    return {
+        k: tuple(
+            jax.device_put(x, NamedSharding(mesh, s))
+            for x, s in zip(stacked[k], specs[k])
+        )
+        for k in ("W", "b")
+    }
+
+
 def put_stacked(stacked, flags, mesh: Mesh):
-    """device_put stacked params + flags (see ``put_pp``)."""
-    return put_pp(stacked, mesh), put_pp(flags, mesh)
+    """device_put stacked params + flags (see ``put_stacked_tree``/``put_pp``)."""
+    return put_stacked_tree(stacked, mesh), put_pp(flags, mesh)
 
 
 def init_stacked(spec: ModelSpec, mesh: Mesh, order=None):
-    """Deterministic init, stacked + device_put with pp sharding."""
-    stacked, flags = stack_params(init_model(spec), spec, order=order)
+    """Deterministic init, stacked + device_put with the mesh's sharding."""
+    stacked, flags = stack_params(
+        init_model(spec), spec, order=order, tp=mesh_tp(mesh)
+    )
     return put_stacked(stacked, flags, mesh)
 
 
@@ -219,53 +347,101 @@ def init_stacked(spec: ModelSpec, mesh: Mesh, order=None):
 # unpack host-side state for layout-independent checkpoints.
 
 
-def stacked_flat_len(spec: ModelSpec, pp: int) -> int:
-    """Per-pp-device flattened param count of the stacked layout (every W
-    slot then every b slot, V virtual rows each) — the ONE definition of
-    the flat layout's size. ``zero1_flat_len``, the gradsync bucket
-    planners and the audit's comms model all read it, so a layout change
-    here propagates to every consumer at once."""
-    dims = slot_shapes(spec)
+def stacked_flat_len(spec: ModelSpec, pp: int, tp: int = 1) -> int:
+    """Per-DEVICE flattened param count of the stacked layout (every W slot
+    then every b slot, V virtual rows each; this rank's tp shard of each) —
+    the ONE definition of the flat layout's size. ``zero1_flat_len``, the
+    gradsync bucket planners and the audit's comms model all read it, so a
+    layout change here propagates to every consumer at once. Under tp the
+    per-device count shrinks by exactly tp (slot dims are tp-rounded, and
+    both the column and row shard of a slot hold ``o*i/tp`` elements)."""
+    dims = slot_shapes(spec, tp)
     V = spec.n_stages // pp
-    return sum(V * o * i for o, i in dims) + sum(V * o for o, _ in dims)
+    return sum(V * o * i // tp for o, i in dims) + sum(
+        V * (o // tp) for o, _ in dims
+    )
 
 
 def zero1_flat_len(spec: ModelSpec, mesh: Mesh):
-    """(flat_len, chunk_size): per-pp-device flattened param count and the
+    """(flat_len, chunk_size): per-device flattened param count and the
     padded per-dp-replica chunk size."""
-    flat = stacked_flat_len(spec, mesh.shape["pp"])
+    flat = stacked_flat_len(spec, mesh.shape["pp"], mesh_tp(mesh))
     return flat, -(-flat // mesh.shape["dp"])
 
 
-def _zero1_flatten_rows(stacked_np, spec, mesh):
-    """Host-side: stacked {W,b} (numpy, stage axis S) -> (pp, flat_len)."""
+def _zero1_device_rows(spec, mesh):
+    """The zero1 flat layout's device-row iteration: yields ``(row_index,
+    stage_slice, tp_rank)`` in (pp-major, tp-minor) order — exactly how
+    ``P(('pp','tp'), 'dp')`` assigns the state matrix's rows to devices."""
     P_ = mesh.shape["pp"]
+    tp = mesh_tp(mesh)
     V = spec.n_stages // P_
-    rows = []
     for d in range(P_):
-        sl = slice(d * V, (d + 1) * V)
-        parts = [np.asarray(w[sl]).reshape(-1) for w in stacked_np["W"]]
-        parts += [np.asarray(b[sl]).reshape(-1) for b in stacked_np["b"]]
-        rows.append(np.concatenate(parts))
+        for t in range(tp):
+            yield d * tp + t, slice(d * V, (d + 1) * V), t
+
+
+def _zero1_flatten_rows(stacked_np, spec, mesh):
+    """Host-side: stacked {W,b} (numpy, stage axis S) -> (pp*tp, flat_len).
+    Each row is one device's flat view — its V stage rows, and at tp > 1
+    its column/row shard of each W slot and its out-shard of each b slot,
+    in the exact order the in-program ``gvec``/``pvec`` concats produce."""
+    tp = mesh_tp(mesh)
+    dims = slot_shapes(spec, tp)
+    rows = [None] * (mesh.shape["pp"] * tp)
+    for r, sl, t in _zero1_device_rows(spec, mesh):
+        parts = []
+        for l, (o, i) in enumerate(dims):
+            w = np.asarray(stacked_np["W"][l][sl])
+            if tp > 1:
+                o_s, i_s = o // tp, i // tp
+                if l % 2 == 0:
+                    w = w[:, t * o_s : (t + 1) * o_s, :]
+                else:
+                    w = w[:, :, t * i_s : (t + 1) * i_s]
+            parts.append(np.ascontiguousarray(w).reshape(-1))
+        for l, (o, _) in enumerate(dims):
+            b = np.asarray(stacked_np["b"][l][sl])
+            if tp > 1:
+                o_s = o // tp
+                b = b[:, t * o_s : (t + 1) * o_s]
+            parts.append(np.ascontiguousarray(b).reshape(-1))
+        rows[r] = np.concatenate(parts)
     return np.stack(rows)
 
 
 def _zero1_unflatten_rows(arr, spec, mesh):
-    """Host-side inverse of _zero1_flatten_rows: (pp, >=flat_len) -> stacked."""
-    dims = slot_shapes(spec)
-    P_ = mesh.shape["pp"]
-    V = spec.n_stages // P_
+    """Host-side inverse of _zero1_flatten_rows: (pp*tp, >=flat_len) ->
+    stacked (full global arrays — every device row writes its shard back)."""
+    tp = mesh_tp(mesh)
+    dims = slot_shapes(spec, tp)
+    V = spec.n_stages // mesh.shape["pp"]
     Ws = [np.zeros((spec.n_stages, o, i), np.float32) for o, i in dims]
     bs = [np.zeros((spec.n_stages, o), np.float32) for o, _ in dims]
-    for d in range(P_):
+    for r, sl, t in _zero1_device_rows(spec, mesh):
         off = 0
         for l, (o, i) in enumerate(dims):
-            n = V * o * i
-            Ws[l][d * V : (d + 1) * V] = arr[d, off : off + n].reshape(V, o, i)
+            o_s, i_s = o // tp, i // tp
+            if tp == 1:
+                n = V * o * i
+                Ws[l][sl] = arr[r, off : off + n].reshape(V, o, i)
+            elif l % 2 == 0:
+                n = V * o_s * i
+                Ws[l][sl, t * o_s : (t + 1) * o_s, :] = arr[
+                    r, off : off + n
+                ].reshape(V, o_s, i)
+            else:
+                n = V * o * i_s
+                Ws[l][sl, :, t * i_s : (t + 1) * i_s] = arr[
+                    r, off : off + n
+                ].reshape(V, o, i_s)
             off += n
         for l, (o, _) in enumerate(dims):
-            n = V * o
-            bs[l][d * V : (d + 1) * V] = arr[d, off : off + n].reshape(V, o)
+            o_s = o // tp
+            n = V * o_s
+            bs[l][sl, t * o_s : (t + 1) * o_s] = arr[r, off : off + n].reshape(
+                V, o_s
+            )
             off += n
     return {"W": tuple(Ws), "b": tuple(bs)}
 
@@ -298,10 +474,29 @@ def _zero1_check_state(opt, csz):
     return parts, scalars
 
 
+def zero1_part_spec(tp: int):
+    """The PartitionSpec of one zero1 'params' state part: rows are devices
+    of the (pp[, tp]) grid, columns chunk over dp. At tp == 1 this is the
+    historical P('pp', 'dp') (byte-identical programs); at tp > 1 the row
+    axis splits over BOTH non-dp axes — row ``p*tp + t`` is device (p, t),
+    matching ``_zero1_device_rows``'s flat layout. The ONE definition:
+    ``zero1_part_sharding`` (placement) and ``make_pipeline_step``'s
+    shard_map state specs both read it, so device placement and program
+    specs can never disagree."""
+    if tp == 1:
+        return P("pp", "dp")
+    return P(("pp", "tp"), "dp")
+
+
+def zero1_part_sharding(mesh: Mesh):
+    """``zero1_part_spec`` bound to a mesh (see its docstring)."""
+    return NamedSharding(mesh, zero1_part_spec(mesh_tp(mesh)))
+
+
 def zero1_init_state(opt, spec: ModelSpec, mesh: Mesh):
     """Device-put initial ZeRO-1 optimizer state: a dict with one
-    (pp, dp*chunk) array per 'params' state part — sharded P('pp','dp'), so
-    each device holds its own (1, chunk) shard — plus replicated 0-d arrays
+    (pp[*tp], dp*chunk) array per 'params' state part — sharded so each
+    device holds its own (1, chunk) shard — plus replicated 0-d arrays
     for 'scalar' parts; () for stateless optimizers."""
     from shallowspeed_tpu.optimizer import is_stateless
 
@@ -310,10 +505,11 @@ def zero1_init_state(opt, spec: ModelSpec, mesh: Mesh):
         return ()
     parts, scalars = _zero1_check_state(opt, csz)
     dp = mesh.shape["dp"]
-    part_sh = NamedSharding(mesh, P("pp", "dp"))
+    n_rows = mesh.shape["pp"] * mesh_tp(mesh)
+    part_sh = zero1_part_sharding(mesh)
     rep_sh = NamedSharding(mesh, P())
     state = {
-        key: jax.device_put(np.zeros((mesh.shape["pp"], dp * csz), np.float32), part_sh)
+        key: jax.device_put(np.zeros((n_rows, dp * csz), np.float32), part_sh)
         for key in parts
     }
     state.update(
@@ -344,6 +540,13 @@ def zero1_state_to_logical(state, opt, spec: ModelSpec, mesh: Mesh, order=None):
     return {"parts": parts, "scalars": scalars}
 
 
+def _zero1_state_rows(logical_part, spec, mesh, order):
+    """Stack one logical state part and flatten it into the zero1 device
+    rows (tp-aware)."""
+    stacked, _ = stack_params(logical_part, spec, order=order, tp=mesh_tp(mesh))
+    return _zero1_flatten_rows(stacked, spec, mesh)
+
+
 def zero1_state_from_logical(logical, opt, spec: ModelSpec, mesh: Mesh, order=None):
     """Inverse: logical {"parts", "scalars"} dict -> device-put state."""
     if logical is None:
@@ -351,14 +554,14 @@ def zero1_state_from_logical(logical, opt, spec: ModelSpec, mesh: Mesh, order=No
     flat, csz = zero1_flat_len(spec, mesh)
     dp = mesh.shape["dp"]
     layout = opt.state_layout()
-    part_sh = NamedSharding(mesh, P("pp", "dp"))
+    part_sh = zero1_part_sharding(mesh)
     rep_sh = NamedSharding(mesh, P())
+    n_rows = mesh.shape["pp"] * mesh_tp(mesh)
     state = {}
     for key, kind in layout.items():
         if kind == "params":
-            stacked, _ = stack_params(logical["parts"][key], spec, order=order)
-            rows = _zero1_flatten_rows(stacked, spec, mesh)
-            padded = np.zeros((mesh.shape["pp"], dp * csz), np.float32)
+            rows = _zero1_state_rows(logical["parts"][key], spec, mesh, order)
+            padded = np.zeros((n_rows, dp * csz), np.float32)
             padded[:, :flat] = rows
             state[key] = jax.device_put(padded, part_sh)
         else:
@@ -475,6 +678,165 @@ def _stage_bwd_weight(active, dims, xs, g_effs, precision):
     return tuple(gWs), tuple(gbs)
 
 
+# ---------------------------------------------------------------------------
+# Megatron-sharded (tp > 1) stage functions
+#
+# Slot parity is the sharding: EVEN slots are column-parallel (W split on the
+# out dim — the forward contracts the full input locally, no collective),
+# ODD slots are row-parallel (W split on the in dim over the column slot's
+# output shard — partial products summed by ONE psum over 'tp', the Megatron
+# g-operator). The backward mirrors: row slots are local, column slots psum
+# their dx partials (the f-operator) — exactly 2 all-reduces per layer pair
+# per fwd+bwd pass (``tp_allreduce_sites`` is the audited site list).
+#
+# Exactness notes:
+# - every psum that reassembles a sharded value (inactive-slot passthrough,
+#   the closing stage-boundary gather, the scattered row-parallel bias) sums
+#   contributions where each element is written by exactly ONE rank and the
+#   others add exact zeros — exact data movement, like _fit;
+# - the psums that sum PARTIAL PRODUCTS (row forward, column dx) split a
+#   contraction across ranks, which reassociates the fp sum: tp > 1 layouts
+#   therefore match the sequential oracle under the repo's standard
+#   cross-layout tolerance (exactly like a different dp width reassociating
+#   the gradient all-reduce — docs/numerics.md), while tp=1 stays byte-
+#   identical (these functions are never traced at tp == 1) and same-layout
+#   A/B knobs at fixed tp (bucketed vs anchor sync, split vs combined
+#   backward, fused-run vs step loop) remain bitwise;
+# - these psums sit inside ``lax.switch`` branches; the branch predicate is
+#   the stage's op code, identical for every member of a tp group (same
+#   (dp, pp) coordinates), so each all-reduce group executes uniformly.
+# ---------------------------------------------------------------------------
+
+
+def _tp_shard(a, t, w):
+    """Rank t's width-``w`` slice of a full-width last dim (exact: column
+    selection). The inverse of ``_tp_scatter``."""
+    return lax.dynamic_slice_in_dim(a, t * w, w, axis=-1)
+
+
+def _tp_scatter(a_loc, t, full_w):
+    """Place rank t's shard at its column offset in a zero full-width
+    array — a psum over 'tp' of every rank's scatter IS the all-gather
+    (each column written by exactly one rank; the rest add exact 0.0)."""
+    z = jnp.zeros(a_loc.shape[:-1] + (full_w,), a_loc.dtype)
+    return lax.dynamic_update_slice_in_dim(z, a_loc, t * a_loc.shape[-1], axis=-1)
+
+
+def _stage_fwd_tp(Ws, bs, active, relu, dims, x, precision, tp_idx, tp):
+    """Megatron-sharded forward through the per-slot stacks (tp > 1).
+
+    Returns ``(out_full, xs, masks)``: the stage output completed to full
+    width (the boundary — relay payload or softmax head — never sees a
+    shard), plus the residuals in the representation the backward
+    consumes — ``xs[l]`` is slot l's input as its wgrad contracts it (full
+    for column slots, this rank's shard for row slots), ``masks[l]`` the
+    pre-activation bitmask as its dgrad masks it (rank-sharded for column
+    slots, full post-psum for row slots).
+
+    Inactive slots keep the representation state machine running: an even
+    passthrough takes the rank's shard of the fitted activation, an odd
+    passthrough scatters the shard back to full width THROUGH the slot's
+    own psum (the inactive branch rides the same collective — uniform
+    collectives, masked payloads, the executor's house idiom)."""
+    L = len(dims)
+    xs, masks = [], []
+    for l, (o, i) in enumerate(dims):
+        if l % 2 == 0:  # column-parallel: full input, sharded output
+            x_l = _fit(x, i)
+            z_loc = ops.linear(x_l, Ws[l], bs[l], precision=precision)
+            xs.append(x_l)
+            masks.append(z_loc > 0)
+            y_loc = jnp.where(relu[l], ops.relu(z_loc), z_loc)
+            x = jnp.where(
+                active[l], y_loc, _tp_shard(_fit(x_l, o), tp_idx, o // tp)
+            )
+        else:  # row-parallel: sharded input, one psum, full output
+            z_part = jnp.matmul(x, Ws[l].T, precision=precision)
+            b_full = _tp_scatter(jnp.reshape(bs[l], (-1,)), tp_idx, o)
+            pre = jnp.where(
+                active[l],
+                z_part + b_full[None, :],
+                _fit(_tp_scatter(x, tp_idx, i), o),
+            )
+            z_full = lax.psum(pre, "tp")
+            xs.append(x)
+            masks.append(z_full > 0)
+            y = jnp.where(relu[l], ops.relu(z_full), z_full)
+            x = jnp.where(active[l], y, z_full)
+    if (L - 1) % 2 == 0:
+        # trailing column slot left the stage output sharded: complete it
+        # (the closing gather of tp_allreduce_sites' forward list)
+        x = lax.psum(_tp_scatter(x, tp_idx, dims[-1][0]), "tp")
+    return x, tuple(xs), tuple(masks)
+
+
+def _stage_bwd_input_tp(Ws, active, relu, dims, masks, g, precision, tp_idx, tp):
+    """The dgrad chain of the Megatron backward (tp > 1): the split
+    B-input, and — composed with ``_stage_bwd_weight_tp`` below — the
+    combined backward's first half. Returns ``(dx_full, g_effs)``; the
+    per-slot effective output-grads are stashed in the SAME representation
+    the masks use (sharded for column slots, full for row slots)."""
+    L = len(dims)
+    g_effs = [None] * L
+    if (L - 1) % 2 == 0:
+        # the stage output was completed to full width; the trailing
+        # column slot's dgrad consumes this rank's shard of its grad
+        o = dims[-1][0]
+        g = _tp_shard(_fit(g, o), tp_idx, o // tp)
+    for l in reversed(range(L)):
+        o, i = dims[l]
+        if l % 2 == 0:  # column-parallel: sharded g, psum'd full dx
+            g_eff = jnp.where(relu[l], g * masks[l], g)
+            g_effs[l] = g_eff
+            part = jnp.matmul(g_eff, Ws[l], precision=precision)
+            pre = jnp.where(
+                active[l], part, _fit(_tp_scatter(g, tp_idx, o), i)
+            )
+            g = lax.psum(pre, "tp")
+        else:  # row-parallel: full g, local sharded dx
+            g_l = _fit(g, o)
+            g_eff = jnp.where(relu[l], g_l * masks[l], g_l)
+            g_effs[l] = g_eff
+            dx = jnp.matmul(g_eff, Ws[l], precision=precision)
+            g = jnp.where(
+                active[l], dx, _tp_shard(_fit(g_l, i), tp_idx, i // tp)
+            )
+    return g, tuple(g_effs)
+
+
+def _stage_bwd_weight_tp(active, dims, xs, g_effs, precision, tp_idx, tp):
+    """The wgrad half of the Megatron backward (tp > 1): every product is
+    LOCAL (dW contracts over the microbatch rows, never over a sharded
+    dim), so the deferred B-weight stays collective-free under tp too.
+    Row-slot biases are stored sharded; their db is the rank's slice of
+    the full row-sum (exact column selection)."""
+    L = len(dims)
+    gWs, gbs = [None] * L, [None] * L
+    for l in range(L):
+        o, _ = dims[l]
+        dw = jnp.matmul(g_effs[l].T, xs[l], precision=precision)
+        if l % 2 == 0:
+            db = g_effs[l].sum(axis=0)
+        else:
+            db = _tp_shard(g_effs[l].sum(axis=0), tp_idx, o // tp)
+        gWs[l] = jnp.where(active[l], dw, 0.0)
+        gbs[l] = jnp.where(active[l], db, 0.0)
+    return tuple(gWs), tuple(gbs)
+
+
+def _stage_bwd_tp(Ws, active, relu, dims, xs, masks, g, precision, tp_idx, tp):
+    """Combined Megatron backward: the literal composition of the two
+    halves (same composition contract as ops.linear_grad — split and
+    combined schedules can never disagree, at any tp)."""
+    dx, g_effs = _stage_bwd_input_tp(
+        Ws, active, relu, dims, masks, g, precision, tp_idx, tp
+    )
+    gWs, gbs = _stage_bwd_weight_tp(
+        active, dims, xs, g_effs, precision, tp_idx, tp
+    )
+    return dx, gWs, gbs
+
+
 def make_pipeline_step(
     mesh: Mesh,
     spec: ModelSpec,
@@ -552,9 +914,24 @@ def make_pipeline_step(
     single-block VMEM budget run as one block; larger slots auto-dispatch
     to the grid-tiled flag kernels (pallas_ops.flag_kernels_fit reports
     the regime per slot).
+
+    Tensor parallelism is a MESH property, not a parameter: when ``mesh``
+    carries a ``tp`` axis the per-slot stacks arrive Megatron-sharded
+    (``stacked_param_specs``) and the tick branches dispatch the tp stage
+    functions instead of the flat ones (xla backend only). Everything
+    else — tick tables, relays, gradient sync modes, the optimizer tail —
+    is unchanged in structure; the cross-device norm reductions simply
+    span ('pp','tp').
     """
     if kernel_backend not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel_backend {kernel_backend!r}")
+    tp_n = mesh_tp(mesh)
+    if tp_n > 1 and kernel_backend == "pallas":
+        raise ValueError(
+            "tensor parallelism shards each slot's W across the tp axis; "
+            "the fused pallas flag kernels compute whole slots — use "
+            "kernel_backend='xla' with --tp"
+        )
     split = bool(getattr(prog, "backward_split", False))
     if split and kernel_backend == "pallas":
         raise ValueError(
@@ -562,9 +939,16 @@ def make_pipeline_step(
             "pallas flag kernel computes dgrad+wgrad in one unit and has "
             "no split halves); use kernel_backend='xla'"
         )
-    dims = slot_shapes(spec)
+    dims = slot_shapes(spec, tp_n)
+    # this device's slot geometry: at tp == 1 these ARE the global dims
+    # (identical trace, byte for byte); at tp > 1 the Megatron shards
+    w_dims, b_widths, xs_widths, mask_widths = tp_local_dims(dims, tp_n)
     S_, L = spec.n_stages, len(dims)
     D_in, D_out = dims[0][1], dims[-1][0]
+    # the cross-device axes params/grads are sharded over: the reductions
+    # behind the clip/grad-norm/param-norm scalars must span them all
+    pp_axes = "pp" if tp_n == 1 else ("pp", "tp")
+    z1_axes = ("dp", "pp") if tp_n == 1 else ("dp", "pp", "tp")
     W_rel = relay_width(spec)  # ppermute payload / mailbox width (<= D_in)
     M = prog.num_micro_batches
     Kf, Kb = prog.n_fwd_slots, prog.n_bwd_slots
@@ -593,7 +977,7 @@ def make_pipeline_step(
         from shallowspeed_tpu.parallel import gradsync
 
         sync_plan = gradsync.plan_buckets(
-            spec, dp_n, P_, grad_bucket_bytes, zero1=zero1
+            spec, dp_n, P_, grad_bucket_bytes, zero1=zero1, tp=tp_n
         )
     else:
         sync_plan = None
@@ -647,6 +1031,7 @@ def make_pipeline_step(
         reluV = flags["relu"]
         head_maskV = flags["head_mask"]  # (V, D_out)
         stage = lax.axis_index("pp")
+        tp_idx = lax.axis_index("tp") if tp_n > 1 else 0
 
         def pick(a, v):
             """Select the active virtual chunk's row (static for V == 1)."""
@@ -667,24 +1052,30 @@ def make_pipeline_step(
             # training programs — inference never runs a backward, so it
             # carries only its predictions
             carry.update(
-                xs=tuple(jnp.zeros((Ks + 1, mb_sz, i), jnp.float32) for _, i in dims),
+                xs=tuple(
+                    jnp.zeros((Ks + 1, mb_sz, w), jnp.float32)
+                    for w in xs_widths
+                ),
                 masks=tuple(
-                    jnp.zeros((Ks + 1, mb_sz, o), jnp.bool_) for o, _ in dims
+                    jnp.zeros((Ks + 1, mb_sz, w), jnp.bool_)
+                    for w in mask_widths
                 ),
                 z=jnp.zeros((Ks + 1, mb_sz, D_out), jnp.float32),
-                gW=tuple(jnp.zeros((V, o, i), jnp.float32) for o, i in dims),
-                gb=tuple(jnp.zeros((V, o), jnp.float32) for o, _ in dims),
+                gW=tuple(jnp.zeros((V, o, i), jnp.float32) for o, i in w_dims),
+                gb=tuple(jnp.zeros((V, w), jnp.float32) for w in b_widths),
                 loss=jnp.zeros((), jnp.float32),
             )
             if split:
                 # grad stash: per-slot effective output-grads, held from
                 # each B-input tick to its deferred B-weight tick (slots
                 # assigned by the lowering, +1 trash — sized exactly like
-                # the activation stash, because it IS the same discipline)
+                # the activation stash, because it IS the same discipline;
+                # widths match the masks': the g_eff of a slot lives in
+                # the same representation as its relu mask)
                 carry.update(
                     gstash=tuple(
-                        jnp.zeros((Kg + 1, mb_sz, o), jnp.float32)
-                        for o, _ in dims
+                        jnp.zeros((Kg + 1, mb_sz, w), jnp.float32)
+                        for w in mask_widths
                     )
                 )
         else:
@@ -716,9 +1107,16 @@ def make_pipeline_step(
                 x_in = jnp.where(
                     load_in, x[mb_r], _fit(c["fwd_mail"][row["rf"][stage]], D_in)
                 )
-                out, xs_l, masks_l = _stage_fwd(
-                    Ws, bs, active, relu, dims, x_in, precision, kernel_backend
-                )
+                if tp_n > 1:
+                    out, xs_l, masks_l = _stage_fwd_tp(
+                        Ws, bs, active, relu, dims, x_in, precision,
+                        tp_idx, tp_n,
+                    )
+                else:
+                    out, xs_l, masks_l = _stage_fwd(
+                        Ws, bs, active, relu, dims, x_in, precision,
+                        kernel_backend,
+                    )
                 c = dict(c)
                 p = ops.softmax(out, valid_mask=head_mask[None, :])
                 if training:
@@ -753,10 +1151,16 @@ def make_pipeline_step(
                 )
                 xs_r = tuple(buf[sr] for buf in c["xs"])
                 masks_r = tuple(buf[sr] for buf in c["masks"])
-                dx, gW_d, gb_d = _stage_bwd(
-                    Ws, active, relu, dims, xs_r, masks_r, g_in, precision,
-                    kernel_backend,
-                )
+                if tp_n > 1:
+                    dx, gW_d, gb_d = _stage_bwd_tp(
+                        Ws, active, relu, dims, xs_r, masks_r, g_in,
+                        precision, tp_idx, tp_n,
+                    )
+                else:
+                    dx, gW_d, gb_d = _stage_bwd(
+                        Ws, active, relu, dims, xs_r, masks_r, g_in,
+                        precision, kernel_backend,
+                    )
                 c = dict(c)
                 if V == 1:
                     c["gW"] = tuple(a.at[0].add(d) for a, d in zip(c["gW"], gW_d))
@@ -782,9 +1186,15 @@ def make_pipeline_step(
                     is_head, _fit(g0, Wb), _fit(c["bwd_mail"][row["rb"][stage]], Wb)
                 )
                 masks_r = tuple(buf[sp] for buf in c["masks"])
-                dx, g_effs = _stage_bwd_input(
-                    Ws, active, relu, dims, masks_r, g_in, precision
-                )
+                if tp_n > 1:
+                    dx, g_effs = _stage_bwd_input_tp(
+                        Ws, active, relu, dims, masks_r, g_in, precision,
+                        tp_idx, tp_n,
+                    )
+                else:
+                    dx, g_effs = _stage_bwd_input(
+                        Ws, active, relu, dims, masks_r, g_in, precision
+                    )
                 c = dict(c)
                 gw = row["gw"][stage]
                 c["gstash"] = tuple(
@@ -803,7 +1213,14 @@ def make_pipeline_step(
                 gr = row["gr"][stage]
                 xs_r = tuple(buf[sr] for buf in c["xs"])
                 geff_r = tuple(buf[gr] for buf in c["gstash"])
-                gW_d, gb_d = _stage_bwd_weight(active, dims, xs_r, geff_r, precision)
+                if tp_n > 1:
+                    gW_d, gb_d = _stage_bwd_weight_tp(
+                        active, dims, xs_r, geff_r, precision, tp_idx, tp_n
+                    )
+                else:
+                    gW_d, gb_d = _stage_bwd_weight(
+                        active, dims, xs_r, geff_r, precision
+                    )
                 c = dict(c)
                 if V == 1:
                     c["gW"] = tuple(a.at[0].add(d) for a, d in zip(c["gW"], gW_d))
@@ -865,15 +1282,17 @@ def make_pipeline_step(
 
                 gsh = gradsync.psum_scatter_bucketed(gpad, sync_plan)
             if with_grad_norm:
-                # chunks partition the dp-summed gradient across (dp, pp),
-                # so the pre-clip global norm is one cross-axis reduction
-                gnorm = jnp.sqrt(lax.psum(jnp.sum(gsh * gsh), ("dp", "pp")))
+                # chunks partition the dp-summed gradient across every
+                # sharded axis, so the pre-clip global norm is one
+                # cross-axis reduction
+                gnorm = jnp.sqrt(lax.psum(jnp.sum(gsh * gsh), z1_axes))
             if clip_norm is not None:
                 from shallowspeed_tpu.optimizer import clip_tree
 
-                # chunks partition the full summed gradient across (dp, pp)
+                # chunks partition the full summed gradient across the
+                # sharded axes (dp, pp[, tp])
                 gsh = clip_tree(
-                    gsh, clip_norm, lambda sq: lax.psum(sq, ("dp", "pp"))
+                    gsh, clip_norm, lambda sq: lax.psum(sq, z1_axes)
                 )
             pvec = jnp.concatenate(
                 [w.reshape(-1) for w in stacked["W"]]
@@ -900,13 +1319,13 @@ def make_pipeline_step(
                 new_ch, _ = opt.apply(pch, gsh, ())
             new_vec = lax.all_gather(new_ch, "dp", axis=0, tiled=True)[:flat]
             outW, outb, off = [], [], 0
-            for o, i in dims:
+            for o, i in w_dims:  # this device's LOCAL slot shapes
                 n = V * o * i
                 outW.append(new_vec[off : off + n].reshape(V, o, i))
                 off += n
-            for o, _ in dims:
-                n = V * o
-                outb.append(new_vec[off : off + n].reshape(V, o))
+            for w in b_widths:
+                n = V * w
+                outb.append(new_vec[off : off + n].reshape(V, w))
                 off += n
             new_stacked = {"W": tuple(outW), "b": tuple(outb)}
             outs = (new_stacked, opt_state, loss)
@@ -917,7 +1336,7 @@ def make_pipeline_step(
 
                 # post-update param norm: padded entries are exactly zero,
                 # so the pp-psum'd stacked norm IS the logical norm
-                outs += (gnorm_of(new_stacked, lambda sq: lax.psum(sq, "pp")),)
+                outs += (gnorm_of(new_stacked, lambda sq: lax.psum(sq, pp_axes)),)
             return outs
 
         # the BackwardGradAllReduce anchor, in one of two bitwise-identical
@@ -941,13 +1360,13 @@ def make_pipeline_step(
 
             # each pp device holds its stages' full (dp-summed) gradient;
             # padded entries are exactly zero so this IS the logical norm
-            gnorm = global_norm(grads, lambda sq: lax.psum(sq, "pp"))
+            gnorm = global_norm(grads, lambda sq: lax.psum(sq, pp_axes))
         if clip_norm is not None:
             from shallowspeed_tpu.optimizer import clip_tree
 
             # each pp device holds its stages' full (dp-summed) gradient;
             # the global norm needs the cross-stage total
-            grads = clip_tree(grads, clip_norm, lambda sq: lax.psum(sq, "pp"))
+            grads = clip_tree(grads, clip_norm, lambda sq: lax.psum(sq, pp_axes))
         local = {"W": stacked["W"], "b": stacked["b"]}
         new_local, opt_state = opt.apply(local, grads, opt_state)
         outs = (new_local, opt_state, loss)
@@ -956,28 +1375,28 @@ def make_pipeline_step(
         if with_step_stats:
             from shallowspeed_tpu.optimizer import global_norm as gnorm_of
 
-            outs += (gnorm_of(new_local, lambda sq: lax.psum(sq, "pp")),)
+            outs += (gnorm_of(new_local, lambda sq: lax.psum(sq, pp_axes)),)
         return outs
 
     pp = P("pp")
     dp_spec = P("dp")
     flags_specs = {"active": pp, "relu": pp, "head_mask": pp}
-    stacked_specs = {"W": (pp,) * L, "b": (pp,) * L}
+    stacked_specs = stacked_param_specs(tp_n, L)
 
     if training:
         if zero1:
-            # ZeRO-1 state: one (pp, dp*chunk) array per 'params' part (row
-            # per pp device, column-chunk per dp replica) + replicated
-            # scalars; () for stateless optimizers
+            # ZeRO-1 state: one (pp[*tp], dp*chunk) array per 'params'
+            # part (row per (pp, tp) device, column-chunk per dp replica)
+            # + replicated scalars; () for stateless optimizers
             state_specs = (
                 {
-                    k: (P("pp", "dp") if kd == "params" else P())
+                    k: (zero1_part_spec(tp_n) if kd == "params" else P())
                     for k, kd in z1_layout.items()
                 }
                 if z1_stateful
                 else ()
             )
-        else:
+        elif tp_n == 1:
             # optimizer-state specs mirror the state's pytree: stage-axis
             # sharded like the params it tracks (SGD's state is the empty
             # tuple)
@@ -996,6 +1415,28 @@ def make_pipeline_step(
             state_specs = jax.tree.map(
                 lambda leaf: pp if leaf.ndim > 0 and leaf.shape[0] == S_ else P(),
                 state_struct,
+            )
+        else:
+            # tp > 1: state parts must mirror the params EXACTLY (the
+            # state_layout protocol — same requirement zero1 enforces), so
+            # each part takes the params' per-slot column/row shards and
+            # scalars replicate
+            from shallowspeed_tpu.optimizer import join_state, split_state
+
+            stacked_struct = {
+                "W": tuple(
+                    jax.ShapeDtypeStruct((S_, o, i), jnp.float32) for o, i in dims
+                ),
+                "b": tuple(
+                    jax.ShapeDtypeStruct((S_, o), jnp.float32) for o, _ in dims
+                ),
+            }
+            state_struct = jax.eval_shape(opt.init, stacked_struct)
+            parts, scalars = split_state(opt, state_struct)
+            state_specs = join_state(
+                opt,
+                {k: stacked_specs for k in parts},
+                {k: P() for k in scalars},
             )
 
         out_specs = (stacked_specs, state_specs, P())

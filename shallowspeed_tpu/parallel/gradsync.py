@@ -121,24 +121,28 @@ class BucketPlan:
         }
 
 
-def _stacked_leaves(spec, pp):
+def _stacked_leaves(spec, pp, tp=1):
     """The executor's per-device gradient leaves in BACKWARD order: the
     tick loop's ``_stage_bwd`` finalizes slot L-1 (the output layer) first
     and computes each slot's dW and db together, so the bucket order is
-    [W_{L-1}, b_{L-1}, ..., W_0, b_0]."""
-    from shallowspeed_tpu.parallel.executor import slot_shapes
+    [W_{L-1}, b_{L-1}, ..., W_0, b_0]. Under tp the leaves are this rank's
+    Megatron shards (``executor.tp_local_dims``) — the dp sync moves 1/tp
+    of the gradient per device, which is the TP memory/bandwidth story the
+    comms model quotes."""
+    from shallowspeed_tpu.parallel.executor import slot_shapes, tp_local_dims
 
-    dims = slot_shapes(spec)
+    dims = slot_shapes(spec, tp)
+    w_dims, b_widths, _, _ = tp_local_dims(dims, tp)
     V = spec.n_stages // pp
     leaves = []
     for l in reversed(range(len(dims))):
-        o, i = dims[l]
+        o, i = w_dims[l]
         leaves.append(BucketLeaf("W", l, (V, o, i)))
-        leaves.append(BucketLeaf("b", l, (V, o)))
+        leaves.append(BucketLeaf("b", l, (V, b_widths[l])))
     return leaves
 
 
-def plan_dp_buckets(spec, pp, bucket_bytes):
+def plan_dp_buckets(spec, pp, bucket_bytes, tp=1):
     """Greedy byte-bounded bucketing of the stacked gradient tree for the
     plain-DP all-reduce. Returns None when ``bucket_bytes`` is falsy (the
     legacy whole-tree anchor psum). Every leaf lands in exactly one
@@ -149,7 +153,7 @@ def plan_dp_buckets(spec, pp, bucket_bytes):
         return None
     bucket_bytes = int(bucket_bytes)
     buckets, current, current_bytes = [], [], 0
-    for leaf in _stacked_leaves(spec, pp):
+    for leaf in _stacked_leaves(spec, pp, tp):
         if current and current_bytes + leaf.nbytes > bucket_bytes:
             buckets.append(tuple(current))
             current, current_bytes = [], 0
@@ -160,7 +164,7 @@ def plan_dp_buckets(spec, pp, bucket_bytes):
     return BucketPlan(mode="dp", bucket_bytes=bucket_bytes, buckets=tuple(buckets))
 
 
-def plan_zero1_buckets(spec, dp, pp, bucket_bytes):
+def plan_zero1_buckets(spec, dp, pp, bucket_bytes, tp=1):
     """Byte-bounded bucketing of the ZeRO-1 reduce-scatter: column ranges
     over the per-replica chunk of the padded flat gradient. Each bucket
     covers ``dp x width`` gradient elements (one width-slice of EVERY
@@ -171,7 +175,7 @@ def plan_zero1_buckets(spec, dp, pp, bucket_bytes):
     bucket_bytes = int(bucket_bytes)
     from shallowspeed_tpu.parallel.executor import stacked_flat_len
 
-    csz = -(-stacked_flat_len(spec, pp) // dp)
+    csz = -(-stacked_flat_len(spec, pp, tp) // dp)
     width = max(1, bucket_bytes // (4 * dp))
     ranges = tuple(
         (a, min(a + width, csz)) for a in range(0, csz, width)
@@ -181,14 +185,14 @@ def plan_zero1_buckets(spec, dp, pp, bucket_bytes):
     )
 
 
-def plan_buckets(spec, dp, pp, bucket_bytes, zero1=False):
+def plan_buckets(spec, dp, pp, bucket_bytes, zero1=False, tp=1):
     """The one layout->plan dispatch: the executor's emitters, the
     session's audit contract and the bench rows all plan through here, so
     they can never pick different planners for the same layout. Returns
     None when ``bucket_bytes`` is falsy (the legacy anchor sync)."""
     if zero1:
-        return plan_zero1_buckets(spec, dp, pp, bucket_bytes)
-    return plan_dp_buckets(spec, pp, bucket_bytes)
+        return plan_zero1_buckets(spec, dp, pp, bucket_bytes, tp=tp)
+    return plan_dp_buckets(spec, pp, bucket_bytes, tp=tp)
 
 
 def psum_bucketed(grads, plan, axis_name="dp"):
@@ -234,18 +238,21 @@ def psum_scatter_bucketed(gvec_padded, plan, axis_name="dp"):
     return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
 
 
-def sync_comm_bytes(spec, dp, pp, zero1=False, plan=None):
+def sync_comm_bytes(spec, dp, pp, zero1=False, plan=None, tp=1):
     """The dp-axis leg of the analytical comms contract
     (observability/program_audit.expected_comms): ring-algorithm wire
     bytes PER DEVICE PER STEP for the gradient sync, with the bucketing
     plan's per-collective breakdown when one is active. Bucketing never
     changes the TOTAL bytes — ``2 (dp-1)/dp x payload`` whether the
     payload moves as one collective or N — only how many ops carry them,
-    which is exactly what the census contract verifies.
+    which is exactly what the census contract verifies. Under tp each
+    device syncs only its Megatron shard, so the dp payload shrinks by
+    exactly tp (tensor parallelism composes with — never multiplies —
+    the gradient-sync traffic).
     """
     from shallowspeed_tpu.parallel.executor import stacked_flat_len
 
-    flat = stacked_flat_len(spec, pp)
+    flat = stacked_flat_len(spec, pp, tp)
     if zero1:
         csz = -(-flat // dp)
         payload = 4 * csz * dp  # the padded flat vector

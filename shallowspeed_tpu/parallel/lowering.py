@@ -218,9 +218,9 @@ def program_stats(prog):
     }
 
 
-def program_flops(prog, spec, mubatch_size):
+def program_flops(prog, spec, mubatch_size, tp=1):
     """Analytical PADDED FLOPs for ONE execution of this tick program on one
-    pp-group: the hardware-work leg of the observability cost model
+    pp(x tp)-group: the hardware-work leg of the observability cost model
     (observability/costmodel.py; the logical model-FLOP leg is
     ``mlp_train_flops_per_sample``).
 
@@ -233,10 +233,15 @@ def program_flops(prog, spec, mubatch_size):
     OP_FWD/OP_BWD cells), so the padding-tax number is an artifact of the
     real lowered program, not a formula that can drift from it. Multiply by
     ``dp`` for the whole mesh (each replica runs the program on its shard).
+
+    ``tp``: the tensor-parallel degree — slot dims are tp-rounded, the
+    GROUP total is returned (the Megatron shards partition every matmul,
+    so each of the pp x tp devices executes exactly 1/(pp*tp) of it;
+    divide accordingly for a per-device bound, as ``expected_comms`` does).
     """
     from shallowspeed_tpu.parallel.executor import slot_shapes
 
-    padded_p = sum(o * i for o, i in slot_shapes(spec))
+    padded_p = sum(o * i for o, i in slot_shapes(spec, tp))
     n_fwd = int(np.sum(prog.op == OP_FWD))
     n_bwd = int(np.sum(prog.op == OP_BWD))
     n_bwd_w = int(np.sum(prog.op == OP_BWD_W))

@@ -8,6 +8,16 @@ ranks across replicas (the dp_comm groups). Collectives over axis 'dp' =
 Iallreduce over dp_comm; ppermute over axis 'pp' = the stage-relay Send/Recv
 pairs. On a real slice the mesh rides ICI; on CPU tests it rides the
 host-emulated devices from --xla_force_host_platform_device_count.
+
+With ``tp > 1`` a THIRD axis is appended — ``('dp', 'pp', 'tp')`` — the
+model (tensor-parallel) axis the Megatron-sharded layers all-reduce over
+(parallel/executor.py). ``tp`` is the INNERMOST dimension of the topology
+placement: a layer-pair costs two all-reduces over tp every microbatch
+(the chattiest axis by far), so its group members must sit on adjacent
+ICI links; dp (one gradient sync per batch) stays outermost. At ``tp == 1``
+the mesh is the historical 2-axis grid, byte for byte — no degenerate
+third axis ever reaches the compiled program, which is what keeps tp=1
+programs anchored to the pre-TP hashes.
 """
 
 import jax
@@ -15,25 +25,57 @@ import numpy as np
 from jax.sharding import Mesh
 
 
-def make_mesh(dp: int, pp: int, devices=None) -> Mesh:
-    """2-D (dp, pp) mesh. When devices aren't pinned explicitly, use JAX's
-    topology-aware placement (jax.experimental.mesh_utils) so that on a real
-    slice the ``pp`` neighbors — which exchange a ppermute payload every
-    pipeline tick — sit on adjacent ICI links, and ``dp`` (one psum per
-    batch) takes the outer dimension."""
+def make_mesh(dp: int, pp: int, devices=None, tp: int = 1) -> Mesh:
+    """(dp, pp[, tp]) mesh. See ``make_mesh_with_layout`` for the placement
+    rules; this wrapper drops the provenance note."""
+    return make_mesh_with_layout(dp, pp, devices, tp)[0]
+
+
+def make_mesh_with_layout(dp: int, pp: int, devices=None, tp: int = 1):
+    """Build the mesh AND say how its devices were placed.
+
+    Returns ``(mesh, layout)`` where ``layout`` is ``"topology-aware"``
+    (jax.experimental.mesh_utils placement — on a real slice, ``tp``/``pp``
+    neighbors sit on adjacent ICI links) or ``"order-preserving"`` (the
+    plain ``jax.devices()`` order, reshaped). Bench records and the metrics
+    stream carry this note so a measured number always says which placement
+    it measured — the two can differ materially on a real slice.
+
+    When devices aren't pinned explicitly, topology-aware placement is
+    attempted first; only the errors ``mesh_utils`` actually raises for
+    unplaceable shapes (ValueError / NotImplementedError) fall through to
+    the order-preserving layout. Anything else — an ImportError from a
+    broken install, a backend crash — propagates: a silent catch-all here
+    once hid real failures behind an unlabeled placement change.
+    """
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
     explicit = devices is not None
     if devices is None:
         devices = jax.devices()
-    if dp * pp > len(devices):
+    need = dp * pp * tp
+    if need > len(devices):
         raise ValueError(
-            f"need {dp * pp} devices for DP={dp} x PP={pp}, have {len(devices)}"
+            f"need {need} devices for DP={dp} x PP={pp} x TP={tp}, "
+            f"have {len(devices)}"
         )
-    if not explicit and dp * pp == len(devices):
+    shape = (dp, pp, tp) if tp > 1 else (dp, pp)
+    axes = ("dp", "pp", "tp") if tp > 1 else ("dp", "pp")
+    if not explicit and need == len(devices):
         try:
             from jax.experimental import mesh_utils
 
-            return Mesh(mesh_utils.create_device_mesh((dp, pp)), ("dp", "pp"))
-        except Exception:
+            grid = mesh_utils.create_device_mesh(shape)
+            return Mesh(grid, axes), "topology-aware"
+        except (ValueError, NotImplementedError):
             pass  # fall through to the order-preserving layout
-    grid = np.asarray(devices[: dp * pp]).reshape(dp, pp)
-    return Mesh(grid, ("dp", "pp"))
+    grid = np.asarray(devices[:need]).reshape(shape)
+    return Mesh(grid, axes), "order-preserving"
+
+
+def mesh_tp(mesh: Mesh) -> int:
+    """The mesh's tensor-parallel degree: the size of its ``tp`` axis, 1
+    when the axis is absent (every pre-TP 2-axis mesh). The ONE accessor
+    executor/gradsync/audit code uses, so "no tp axis" and "tp axis of
+    size 1" can never be treated differently by different consumers."""
+    return int(dict(mesh.shape).get("tp", 1))

@@ -79,6 +79,15 @@ def main(argv=None):
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--pp", type=int, default=1)
     ap.add_argument(
+        "--tp",
+        type=int,
+        default=1,
+        help="tensor (model-axis) parallelism: serve through Megatron-"
+        "sharded layers (forward-only — one all-reduce per row-parallel "
+        "layer; --audit verifies the per-layer-pair tp all-reduces and "
+        "still forbids every gradient collective)",
+    )
+    ap.add_argument(
         "--schedule",
         choices=["naive", "gpipe", "pipedream", "interleaved"],
         default="gpipe",
@@ -183,6 +192,7 @@ def main(argv=None):
     session = TrainingSession(
         dp=args.dp,
         pp=args.pp,
+        tp=args.tp,
         schedule=args.schedule,
         virtual_stages=args.virtual_stages,
         global_batch_size=args.global_batch_size,

@@ -131,6 +131,7 @@ def sweep(
         "config": {
             "dp": session.dp,
             "pp": session.pp,
+            "tp": session.tp,
             "schedule": session.schedule,
             "slot_rows": session.slot_rows,
             "slot_ladder": list(session.slot_ladder),
@@ -259,6 +260,7 @@ def chaos_soak(
         "config": {
             "dp": session.dp,
             "pp": session.pp,
+            "tp": session.tp,
             "schedule": session.schedule,
             "requests": n_requests,
             "rate": rate,
@@ -310,6 +312,10 @@ def main(argv=None):
     )
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor (model-axis) parallelism for the served layout",
+    )
     ap.add_argument(
         "--schedule",
         choices=["naive", "gpipe", "pipedream", "interleaved"],
@@ -385,6 +391,7 @@ def main(argv=None):
     session = TrainingSession(
         dp=args.dp,
         pp=args.pp,
+        tp=args.tp,
         schedule=args.schedule,
         global_batch_size=args.global_batch_size,
         mubatches=args.mubatches,

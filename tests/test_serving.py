@@ -156,6 +156,26 @@ def test_engine_bitwise_equals_direct_predict(data_dir):
         assert req.slo_ok(10_000) is True
 
 
+def test_engine_serves_tensor_parallel_layout(data_dir):
+    """Serving under TP (satellite of the tp lattice): the rung programs
+    route through the Megatron-sharded layers — strict audit enforces the
+    forward-only contract (per-layer-pair tp all-reduces required,
+    gradient collectives forbidden) before the first response, and every
+    response stays bitwise-equal to a direct predict() of the same rows."""
+    run = _session(data_dir, dp=2, tp=2, audit=True)
+    eng = ServingEngine(run, slo_ms=10_000)
+    rng = np.random.RandomState(6)
+    payloads = [
+        rng.randn(rows, SIZES[0]).astype(np.float32) for rows in (1, 9, 4, 17)
+    ]
+    for p in payloads:
+        eng.submit(p)
+    done = eng.drain()
+    assert [r.verdict for r in done] == ["ok"] * len(payloads)
+    for req in done:
+        np.testing.assert_array_equal(req.result, run.predict(payloads[req.id]))
+
+
 def test_engine_packing_capacity_and_accounting(data_dir):
     run = _session(data_dir, dp=2)  # pp=1: cheap programs
     S = run.slot_rows

@@ -1,0 +1,422 @@
+"""Tensor (model-axis) parallelism: the Megatron-sharded layer path.
+
+Contract under test (docs/performance.md "Tensor parallelism",
+docs/lowering.md "Per-axis comms"):
+
+- tp=1 never builds a tp axis and never traces the tp stage functions —
+  the historical 2-axis programs are untouched (anchor leg);
+- tp>1 layouts train to the sequential oracle's weights under the repo's
+  standard CROSS-LAYOUT float tolerance: the row-parallel forward and
+  column-parallel backward psums split a contraction across ranks, which
+  reassociates the fp sum exactly like a different dp width reassociates
+  the gradient all-reduce (docs/numerics.md). Same-layout A/B knobs at a
+  FIXED tp — bucketed vs anchor gradient sync, split vs combined
+  backward — stay BITWISE, and those legs are asserted with array_equal;
+- the compiled program's collective census carries the per-axis contract:
+  the tp axis demands >= (fwd sites + bwd sites) all-reduce ops
+  (executor.tp_allreduce_sites), the dp payload shrinks by tp, and the
+  forward-only serving contract still forbids every gradient collective.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shallowspeed_tpu import model as Mo
+from shallowspeed_tpu import schedules as S
+from shallowspeed_tpu import trainer
+from shallowspeed_tpu.observability import program_audit
+from shallowspeed_tpu.optimizer import SGD, MomentumSGD
+from shallowspeed_tpu.parallel import executor as E
+from shallowspeed_tpu.parallel import gradsync, lower_schedule, make_mesh
+from shallowspeed_tpu.parallel.mesh import make_mesh_with_layout, mesh_tp
+
+SIZES = (40, 36, 32, 28, 24, 20, 14, 10)  # 7 Linears; pp in {1, 2} below
+M, B = 4, 32
+
+
+# ---------------------------------------------------------------------------
+# mesh + static geometry
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_tp_axis_and_layout_note():
+    mesh, layout = make_mesh_with_layout(2, 2, tp=2)
+    assert mesh.axis_names == ("dp", "pp", "tp")
+    assert dict(mesh.shape) == {"dp": 2, "pp": 2, "tp": 2}
+    assert layout in ("topology-aware", "order-preserving")
+    assert mesh_tp(mesh) == 2
+
+
+def test_mesh_tp1_keeps_the_historical_two_axes():
+    mesh = make_mesh(2, 2)
+    assert mesh.axis_names == ("dp", "pp")
+    assert mesh_tp(mesh) == 1
+    assert mesh_tp(make_mesh(2, 2, tp=1)) == 1
+    with pytest.raises(ValueError, match="need 16 devices"):
+        make_mesh(2, 2, tp=4)
+    with pytest.raises(ValueError, match="tp must be >= 1"):
+        make_mesh(1, 1, tp=0)
+
+
+def test_slot_shapes_tp_rounds_to_multiples():
+    spec = Mo.make_model_spec(SIZES, 2, B)
+    base = E.slot_shapes(spec)
+    assert E.slot_shapes(spec, 1) == base  # tp=1 identical (the anchor)
+    for tp in (2, 4):
+        dims = E.slot_shapes(spec, tp)
+        assert all(o % tp == 0 and i % tp == 0 for o, i in dims)
+        # rounding only ever pads upward
+        assert all(o >= bo and i >= bi for (o, i), (bo, bi) in zip(dims, base))
+
+
+def test_tp_local_dims_parity_and_sites():
+    spec = Mo.make_model_spec(SIZES, 2, B)
+    dims = E.slot_shapes(spec, 2)
+    w_dims, b_widths, xs_w, mask_w = E.tp_local_dims(dims, 2)
+    for l, (o, i) in enumerate(dims):
+        if l % 2 == 0:  # column-parallel: W row band, sharded mask
+            assert w_dims[l] == (o // 2, i)
+            assert xs_w[l] == i and mask_w[l] == o // 2
+        else:  # row-parallel: W column band, sharded input
+            assert w_dims[l] == (o, i // 2)
+            assert xs_w[l] == i // 2 and mask_w[l] == o
+        assert b_widths[l] == o // 2
+    fwd, bwd = E.tp_allreduce_sites(spec, 2, training=True)
+    L = len(dims)
+    assert len(fwd) == L // 2 + (L % 2)  # odd slots + closing gather
+    assert len(bwd) == (L + 1) // 2  # even slots
+    fwd_inf, bwd_inf = E.tp_allreduce_sites(spec, 2, training=False)
+    assert fwd_inf == fwd and bwd_inf == []
+
+
+# ---------------------------------------------------------------------------
+# training equivalence
+# ---------------------------------------------------------------------------
+
+
+def _data(seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(2, B, SIZES[0]).astype(np.float32)
+    Y = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (2, B))]
+    return X, Y
+
+
+def _train_mesh(
+    dp, pp, tp, sched=S.GPipeSchedule, zero1=False, gbb=0, bsplit=False,
+    clip=0.05, opt=None,
+):
+    spec = Mo.make_model_spec(SIZES, pp, B)
+    mesh = make_mesh(dp, pp, tp=tp)
+    prog = lower_schedule(sched, M, pp, backward_split=bsplit)
+    stacked, flags = E.init_stacked(spec, mesh)
+    opt = opt or SGD(0.01)
+    ost = E.zero1_init_state(opt, spec, mesh) if zero1 else opt.init(stacked)
+    step = E.make_pipeline_step(
+        mesh, spec, prog, B // dp // M, opt, zero1=zero1, clip_norm=clip,
+        with_grad_norm=True, grad_bucket_bytes=gbb,
+    )
+    X, Y = _data()
+    for i in range(2):
+        stacked, ost, loss, gn = step(
+            stacked, flags, ost, jnp.asarray(X[i]), jnp.asarray(Y[i])
+        )
+    got = [l for s in E.unstack_params(stacked, spec) for l in s]
+    return got, float(loss), float(gn)
+
+
+def _train_sequential(clip=0.05, opt=None):
+    spec1 = Mo.make_model_spec(SIZES, 1, B)
+    params = jax.tree.map(jnp.asarray, Mo.init_model(spec1))
+    opt = opt or SGD(0.01)
+    step1 = trainer.make_train_step(spec1, opt, clip_norm=clip)
+    st = opt.init(params)
+    X, Y = _data()
+    for i in range(2):
+        params, st = step1(
+            params, st,
+            jnp.asarray(X[i].reshape(M, B // M, -1)),
+            jnp.asarray(Y[i].reshape(M, B // M, -1)),
+        )
+    return [l for stage in params for l in stage]
+
+
+TP_LAYOUTS = {
+    # layout -> (dp, pp, tp, kwargs) — the dp x pp x tp lattice corners,
+    # clip active throughout (the norm reduction must span ('pp','tp'))
+    "tp2": (1, 1, 2, {}),
+    "tp4": (1, 1, 4, {}),
+    "dp2-tp2": (2, 1, 2, {}),
+    "pp2-tp2": (1, 2, 2, {}),
+    "dp2-pp2-tp2": (2, 2, 2, dict(sched=S.PipeDreamFlushSchedule)),
+    "zero1-tp2": (2, 2, 2, dict(zero1=True, opt=MomentumSGD(0.005, 0.9))),
+}
+
+
+@pytest.mark.parametrize("layout", sorted(TP_LAYOUTS))
+def test_tp_matches_sequential(layout):
+    """The TP acceptance criterion: every dp x pp x tp lattice corner —
+    including the 8-device dp2 x pp2 x tp2 cube and ZeRO-1 over it —
+    trains to the sequential oracle's weights/loss/grad-norm under the
+    repo's cross-layout tolerance, with global-norm clipping active (the
+    clip factor reads the ('pp','tp')-spanning reduction, so a
+    double-counted or dropped shard would shift every weight)."""
+    dp, pp, tp, kw = TP_LAYOUTS[layout]
+    opt = kw.get("opt")
+    want = _train_sequential(opt=opt)
+    got, loss, gn = _train_mesh(dp, pp, tp, **kw)
+    assert np.isfinite(loss) and np.isfinite(gn), layout
+    for a, b in zip(want, got):
+        np.testing.assert_allclose(
+            np.asarray(a["W"]), b["W"], rtol=5e-4, atol=5e-6, err_msg=layout
+        )
+        np.testing.assert_allclose(
+            np.asarray(a["b"]).reshape(-1), b["b"].reshape(-1),
+            rtol=5e-4, atol=5e-6, err_msg=layout,
+        )
+
+
+def test_tp_bucketed_sync_bitwise_identical_to_anchor():
+    """The bit-identity contract where it GENUINELY holds at tp > 1:
+    bucketed vs anchor gradient sync on the same tp2 layout — weights,
+    loss AND the pre-clip grad norm are array_equal (the dp collectives
+    sum the same per-shard elements either way)."""
+    base_w, base_loss, base_gn = _train_mesh(2, 1, 2)
+    for gbb in (512, 8192):
+        w, loss, gn = _train_mesh(2, 1, 2, gbb=gbb)
+        assert loss == base_loss and gn == base_gn, gbb
+        for a, b in zip(base_w, w):
+            np.testing.assert_array_equal(a["W"], b["W"], err_msg=str(gbb))
+            np.testing.assert_array_equal(a["b"], b["b"], err_msg=str(gbb))
+
+
+def test_tp_backward_split_bitwise_identical_to_unsplit():
+    """Split-backward at tp2: the tp dgrad chain and deferred wgrads are
+    the same expressions at different ticks (the _tp stage functions are
+    literal compositions), so pp2 x tp2 split == unsplit bit for bit."""
+    base_w, base_loss, base_gn = _train_mesh(1, 2, 2)
+    w, loss, gn = _train_mesh(1, 2, 2, bsplit=True)
+    assert loss == base_loss and gn == base_gn
+    for a, b in zip(base_w, w):
+        np.testing.assert_array_equal(a["W"], b["W"])
+        np.testing.assert_array_equal(a["b"], b["b"])
+
+
+def test_tp_zero1_state_roundtrip():
+    """The zero1 flat layout under tp: host logical state -> device rows ->
+    host logical state is the identity (the (pp*tp, dp*chunk) row order
+    matches P(('pp','tp'),'dp')), so tp checkpoints stay layout-free."""
+    spec = Mo.make_model_spec(SIZES, 2, B)
+    mesh = make_mesh(2, 2, tp=2)
+    opt = MomentumSGD(0.005, 0.9)
+    rng = np.random.RandomState(3)
+    logical = {
+        "parts": {
+            "": [
+                [
+                    {
+                        "W": rng.randn(*np.asarray(l["W"]).shape).astype(np.float32),
+                        "b": rng.randn(*np.asarray(l["b"]).shape).astype(np.float32),
+                    }
+                    for l in stage
+                ]
+                for stage in Mo.init_model(spec)
+            ]
+        },
+        "scalars": {},
+    }
+    state = E.zero1_state_from_logical(logical, opt, spec, mesh)
+    back = E.zero1_state_to_logical(state, opt, spec, mesh)
+    for stage_a, stage_b in zip(logical["parts"][""], back["parts"][""]):
+        for a, b in zip(stage_a, stage_b):
+            np.testing.assert_array_equal(a["W"], b["W"])
+            np.testing.assert_array_equal(
+                np.asarray(a["b"]).reshape(-1), np.asarray(b["b"]).reshape(-1)
+            )
+
+
+# ---------------------------------------------------------------------------
+# census contract
+# ---------------------------------------------------------------------------
+
+
+def _compiled_census(dp, pp, tp, training=True, zero1=False):
+    spec = Mo.make_model_spec(SIZES, pp, B)
+    mesh = make_mesh(dp, pp, tp=tp)
+    sched = S.GPipeSchedule if training else S.InferenceSchedule
+    prog = lower_schedule(sched, M, pp, training=training)
+    stacked, flags = E.init_stacked(spec, mesh)
+    mb = B // dp // M
+    if training:
+        opt = SGD(0.01)
+        ost = E.zero1_init_state(opt, spec, mesh) if zero1 else opt.init(stacked)
+        step = E.make_pipeline_step(mesh, spec, prog, mb, opt, zero1=zero1)
+        compiled = step.lower(
+            stacked, flags, ost,
+            jax.ShapeDtypeStruct((B, SIZES[0]), jnp.float32),
+            jax.ShapeDtypeStruct((B, SIZES[-1]), jnp.float32),
+        ).compile()
+    else:
+        step = E.make_pipeline_step(mesh, spec, prog, mb)
+        compiled = step.lower(
+            stacked, flags, jax.ShapeDtypeStruct((B, SIZES[0]), jnp.float32)
+        ).compile()
+    ops = program_audit.parse_collectives(compiled.as_text())
+    expected = program_audit.expected_comms(
+        spec, dp, pp, prog=prog, zero1=zero1, mubatch_size=mb, tp=tp
+    )
+    return ops, program_audit.census_of_ops(ops), expected
+
+
+def test_tp_training_census_matches_contract():
+    ops, census, expected = _compiled_census(2, 2, 2)
+    assert "tp" in expected["axes"]
+    tp_axis = expected["axes"]["tp"]
+    assert tp_axis["hlo_min_all_reduce_ops"] == (
+        tp_axis["sites_fwd"] + tp_axis["sites_bwd"]
+    )
+    # the compiled program really holds the Megatron psums (plus the dp
+    # sync, loss and clip reductions — the floor is a lower bound)
+    assert census["all_reduce"]["count"] >= tp_axis["hlo_min_all_reduce_ops"]
+    program_audit.verify_census(census, expected, ops=ops)
+    # dp payload shrinks: each device syncs only its Megatron shard
+    spec = Mo.make_model_spec(SIZES, 2, B)
+    dp_axis = expected["axes"]["dp"]
+    assert dp_axis["grad_bytes_per_device"] < 4 * E.stacked_flat_len(spec, 2)
+    dims2 = E.slot_shapes(spec, 2)
+    assert E.stacked_flat_len(spec, 2, 2) == sum(
+        o * i // 2 for o, i in dims2
+    ) + sum(o // 2 for o, _ in dims2)
+
+
+def test_tp_census_floor_catches_dropped_collectives():
+    """A contract whose tp floor exceeds the compiled census must refuse:
+    the enforcement leg is real, not decorative."""
+    ops, census, expected = _compiled_census(1, 1, 2)
+    tampered = dict(expected)
+    tampered["axes"] = dict(expected["axes"])
+    tampered["axes"]["tp"] = dict(expected["axes"]["tp"])
+    tampered["axes"]["tp"]["hlo_min_all_reduce_ops"] = (
+        census["all_reduce"]["count"] + 7
+    )
+    with pytest.raises(program_audit.AuditMismatchError, match="tensor-parallel"):
+        program_audit.verify_census(census, tampered, ops=ops)
+    # and the honest contract passes the same census
+    program_audit.verify_census(census, expected, ops=ops)
+
+
+def test_tp_inference_census_forward_only():
+    """Serving under TP: the forward-only contract keeps the gradient
+    collectives forbidden (reduce-scatter/all-gather would mean the
+    training lowering leaked into the serving path) while requiring the
+    per-layer-pair forward psums — and the compiled inference program at
+    pp2 x tp2 satisfies it."""
+    ops, census, expected = _compiled_census(1, 2, 2, training=False)
+    assert expected["inference"] is True
+    assert "reduce_scatter" in expected["forbidden"]
+    assert "all_gather" in expected["forbidden"]
+    assert expected["axes"]["tp"]["sites_bwd"] == 0
+    program_audit.verify_census(census, expected, ops=ops)
+    # a leaked gradient collective is refused — both kinds: the ZeRO
+    # collectives by prohibition, and an EXTRA all-reduce (the anchor-mode
+    # dp sync's shape) by the tp upper pin (at most sites + the preds psum)
+    leaky = dict(census)
+    leaky["reduce_scatter"] = {"count": 1, "bytes": 1024}
+    with pytest.raises(program_audit.AuditMismatchError, match="reduce_scatter"):
+        program_audit.verify_census(leaky, expected, ops=ops)
+    need = expected["axes"]["tp"]["hlo_min_all_reduce_ops"]
+    leaky_ar = dict(census)
+    leaky_ar["all_reduce"] = {
+        "count": need + 2,
+        "bytes": census["all_reduce"]["bytes"] + 4096,
+    }
+    with pytest.raises(
+        program_audit.AuditMismatchError, match="leaked into the serving path"
+    ):
+        program_audit.verify_census(leaky_ar, expected, ops=ops)
+
+
+def test_tp_bucket_plan_sizes_are_local_shards():
+    """The gradsync planners bucket THIS DEVICE's Megatron shards: total
+    planned bytes at tp2 are exactly half the tp1 plan's, and the
+    emitters' leaf shapes match the executor's local gradient shapes."""
+    spec = Mo.make_model_spec(SIZES, 1, B)
+    p1 = gradsync.plan_buckets(spec, 2, 1, 4096, tp=1)
+    p2 = gradsync.plan_buckets(spec, 2, 1, 4096, tp=2)
+    dims2 = E.slot_shapes(spec, 2)
+    w_dims, b_widths, _, _ = E.tp_local_dims(dims2, 2)
+    for group in p2.buckets:
+        for leaf in group:
+            if leaf.kind == "W":
+                assert tuple(leaf.shape)[1:] == w_dims[leaf.slot]
+            else:
+                assert tuple(leaf.shape)[1] == b_widths[leaf.slot]
+    total1 = p1.total_grad_bytes()
+    total2 = p2.total_grad_bytes()
+    # tp2 dims are rounded up before halving, so <= holds with equality
+    # whenever no rounding occurred
+    assert total2 <= total1
+    assert total2 == 4 * E.stacked_flat_len(spec, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# session-level end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tp_data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tp_data")
+    rng = np.random.RandomState(0)
+    for suffix, n in (("train", 128), ("val", 64)):
+        np.save(d / f"x_{suffix}.npy", rng.rand(n, SIZES[0]).astype(np.float32))
+        np.save(
+            d / f"y_{suffix}.npy",
+            np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], n)],
+        )
+    return d
+
+
+def test_tp_session_trains_audited_and_predicts(tp_data_dir):
+    """TrainingSession(tp=2) end to end: strict-audit training (the census
+    contract is enforced before the first dispatch), prediction through
+    the ladder rung programs bitwise-stable, and eval equal to the
+    sequential reference's predictions under the same weights."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    common = dict(
+        sizes=SIZES, global_batch_size=32, mubatches=2, lr=0.01,
+        data_dir=tp_data_dir,
+    )
+    run = TrainingSession(dp=2, tp=2, audit=True, **common)
+    loss = run.train_epoch()
+    assert np.isfinite(loss)
+    seq = TrainingSession(**common)
+    seq.train_epoch()
+    # cross-layout tolerance (split contractions reassociate — the dp
+    # precedent), asserted on the trained weights
+    for a, b in zip(
+        [l for s in seq.params() for l in s],
+        [l for s in run.params() for l in s],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a["W"]), np.asarray(b["W"]), rtol=5e-4, atol=5e-6
+        )
+    # predict: same rows through two different rung programs are bitwise
+    x = np.asarray(np.random.RandomState(5).rand(3, SIZES[0]), np.float32)
+    p_small = run.predict(x)
+    p_large = run.predict(np.concatenate([x, x, x], axis=0))[:3]
+    np.testing.assert_array_equal(p_small, p_large)
+    assert run.accuracy() >= 0.0
+
+
+def test_tp_session_validations():
+    from shallowspeed_tpu.api import TrainingSession
+
+    with pytest.raises(ValueError, match="tp must be >= 1"):
+        TrainingSession(tp=0)
+    with pytest.raises(ValueError, match="pallas"):
+        TrainingSession(dp=2, tp=2, kernel_backend="pallas")
+    with pytest.raises(ValueError, match="sequential path only"):
+        TrainingSession(tp=2, fuse_mubatches=True)

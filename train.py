@@ -38,6 +38,21 @@ def main():
     ap.add_argument("--dp", type=int, default=1, help="data-parallel replicas")
     ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
     ap.add_argument(
+        "--tp",
+        type=int,
+        default=1,
+        help="tensor (model-axis) parallelism: shard every Linear "
+        "Megatron-style across tp devices — even layers column-parallel "
+        "(W split on the output dim, no forward collective), odd layers "
+        "row-parallel (W split on the input dim, one all-reduce over tp) — "
+        "so each fwd+bwd pass costs 2 all-reduces per layer pair and "
+        "per-device weight memory/matmul FLOPs drop by tp. Composes with "
+        "--dp/--pp/--zero1/--grad-bucket-bytes/--backward-split into a "
+        "dp x pp x tp lattice (needs dp*pp*tp devices; --audit verifies "
+        "the per-axis collective census; see docs/performance.md for "
+        "when it pays)",
+    )
+    ap.add_argument(
         "--schedule",
         choices=["naive", "gpipe", "pipedream", "interleaved"],
         default="naive",
@@ -324,6 +339,7 @@ def main():
             audit=args.audit,
             dp=args.dp,
             pp=args.pp,
+            tp=args.tp,
             schedule=args.schedule,
             global_batch_size=args.global_batch_size,
             mubatches=args.mubatches,
@@ -372,14 +388,23 @@ def main():
             "has no mid-epoch entry point — drop --fused-run to finish "
             "the epoch with the step loop"
         )
-    if args.dp == 1 and args.pp == 1 and args.virtual_stages == 1:
+    if (
+        args.dp == 1
+        and args.pp == 1
+        and args.virtual_stages == 1
+        and args.tp == 1
+    ):
         layout = "sequential"
-    elif args.pp == 1 and args.virtual_stages == 1:
-        layout = "data-parallel"
     elif args.virtual_stages > 1:
         layout = f"interleaved pipeline, V={args.virtual_stages}"
-    else:
+    elif args.pp > 1:
         layout = f"{args.schedule} pipeline"
+    elif args.dp > 1:
+        layout = "data-parallel"
+    else:
+        layout = "tensor-parallel"
+    if args.tp > 1 and layout != "tensor-parallel":
+        layout += " + tensor-parallel"
     note = ""
     if args.resume:
         if run.resumed_from is not None:
@@ -389,8 +414,8 @@ def main():
         else:  # --resume auto on an empty checkpoint dir
             note = " no resumable checkpoint found — fresh start"
     print(
-        f"devices={jax.devices()} layout: DP={args.dp} x PP={args.pp} ({layout}) "
-        f"batches/epoch={run.batches_per_epoch}" + note
+        f"devices={jax.devices()} layout: DP={args.dp} x PP={args.pp} x "
+        f"TP={args.tp} ({layout}) batches/epoch={run.batches_per_epoch}" + note
     )
 
     def profiled(i):
