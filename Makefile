@@ -8,8 +8,8 @@ SHELL := /bin/bash
 
 .PHONY: test verify metrics-smoke report-smoke audit-smoke overlap-smoke \
         split-smoke tp-smoke recovery-smoke serve-smoke chaos-smoke \
-        bench-serving data train train-mesh bench bench-scaling schedules \
-        clean
+        fleet-smoke bench-serving data train train-mesh bench bench-scaling \
+        schedules clean
 
 test:
 	python -m pytest tests/ -q
@@ -284,6 +284,51 @@ chaos-smoke:
 	  grep -q "availability" /tmp/chaos/$$lay.report.md; \
 	done
 	@echo "chaos-smoke OK: die/slow/nan/error + hot reload survived on dp2 and gpipe-pp4 — zero lost, bitwise parity, breaker recovered, zero recompiles, Degradation rendered"
+
+# serving-fleet end-to-end (docs/serving.md "Fleet", docs/robustness.md
+# "Fleet failover"): train a short run that leaves step checkpoints, then
+# serve its step-8 snapshot through a 3-replica fleet (separate worker
+# processes, each its own JAX runtime, ladders warmed before traffic)
+# under seeded Poisson load — and SIGKILL the busiest replica mid-soak.
+# Asserts zero silently-lost requests (every admitted id reaches exactly
+# one terminal verdict), zero worker-verified bitwise-parity mismatches,
+# >=1 failover with its in-flight re-queued, a replacement scaled up from
+# the newest good snapshot (ready time measured) without degrading the
+# quorum, and the report CLI rendering the Fleet section from the merged
+# parent + .r{replica_id} shard stream. Then the serve CLI's fleet path:
+# a 2-replica clean run exits 0 with worker-side bitwise parity. Exit 0.
+fleet-smoke:
+	rm -rf /tmp/fleet; mkdir -p /tmp/fleet
+	python -c "import numpy as np; from pathlib import Path; d=Path('/tmp/fleet/data'); d.mkdir(parents=True); rng=np.random.RandomState(0); [(np.save(d/('x_'+s+'.npy'), rng.rand(n,784).astype(np.float32)), np.save(d/('y_'+s+'.npy'), np.eye(10,dtype=np.float32)[rng.randint(0,10,n)])) for s,n in (('train',256),('val',96))]"
+	$(CPU_MESH) python train.py --data-dir /tmp/fleet/data --epochs 2 \
+	    --global-batch-size 32 --no-eval \
+	    --checkpoint-dir /tmp/fleet/ck --checkpoint-every-steps 8 \
+	    > /tmp/fleet/train.out
+	test -f /tmp/fleet/ck/step-00000008.npz \
+	    || { echo "no step-8 checkpoint to serve"; exit 1; }
+	$(CPU_MESH) python -m shallowspeed_tpu.serving.bench_serving --fleet 3 \
+	    --data-dir /tmp/fleet/data --global-batch-size 32 \
+	    --checkpoint /tmp/fleet/ck/step-00000008.npz \
+	    --reload-dir /tmp/fleet/ck --kill-after 15 \
+	    --requests 120 --rates 300 --slo-ms 2000 --seed 0 \
+	    --fleet-out /tmp/fleet/FLEET_CHAOS.json \
+	    --metrics-out /tmp/fleet/fleet.jsonl
+	python -c "import json,sys; rec=json.load(open('/tmp/fleet/FLEET_CHAOS.json')); assert rec['bench']=='serving_fleet_chaos'; assert rec['silently_lost']==[], 'LOST '+str(rec['silently_lost']); assert rec['parity_mismatches']==0, 'parity mismatches'; assert rec['killed_replica'] is not None and rec['replicas_dead']>=1, 'SIGKILL never fired'; assert rec['failovers']>=1 or rec['killed_inflight']==0, 'kill destroyed in-flight work but no failover ran'; assert rec['scale_ups']==1 and rec['scale_up_s'] is not None, 'no measured scale-up'; assert rec['recovery_s'] is not None, 'no measured recovery'; assert not rec['degraded_at_exit'], 'fleet degraded at exit'; v=rec['verdicts']; assert v.get('ok',0)>0, 'nothing served'; print('fleet chaos: %d submitted, verdicts %s, availability %.1f%%, kill stall %.1f ms, replacement ready in %.2f s' % (rec['submitted'], v, 100*rec['availability'], 1e3*rec['kill_stall_s'], rec['scale_up_s']))"
+	ls /tmp/fleet/fleet.jsonl.r0 /tmp/fleet/fleet.jsonl.r1 \
+	    /tmp/fleet/fleet.jsonl.r2 > /dev/null
+	python -m shallowspeed_tpu.observability.report '/tmp/fleet/fleet.jsonl*' \
+	    --format md --slo-ms 2000 > /tmp/fleet/report.md
+	grep -q "## Fleet" /tmp/fleet/report.md
+	grep -q "SIGKILL injected" /tmp/fleet/report.md
+	grep -q "failover: " /tmp/fleet/report.md
+	grep -q "elasticity: 1 scale-up(s)" /tmp/fleet/report.md
+	grep -q "availability" /tmp/fleet/report.md
+	$(CPU_MESH) python -m shallowspeed_tpu.serving --fleet 2 \
+	    --data-dir /tmp/fleet/data --global-batch-size 32 \
+	    --checkpoint /tmp/fleet/ck/step-00000008.npz \
+	    --requests 60 --rate 300 --seed 0 --slo-ms 2000 --verify \
+	    --metrics-out /tmp/fleet/serve_fleet.jsonl
+	@echo "fleet-smoke OK: 3-replica fleet survived a mid-soak SIGKILL — zero lost, worker-verified parity, failover + measured scale-up recovery, Fleet section rendered"
 
 # the full offered-load sweep on the default layouts (see docs/serving.md)
 bench-serving:

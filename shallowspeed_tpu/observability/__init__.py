@@ -28,6 +28,11 @@ evidence trail instead of prose:
                    loss-divergence and grad-spike checks over the flight
                    aux, with a record/warn/halt policy
                    (``TrainingSession(health=...)``, ``train.py --health``);
+- ``stats``        the ONE percentile definition (np.percentile, linear
+                   interpolation) shared by the serving engine's summary,
+                   the fleet summary and the report CLI's killed-run
+                   fallback — three consumers, one definition, so p99 can
+                   never disagree with itself;
 - ``costmodel``    analytical MLP FLOPs + ``Compiled.cost_analysis()``
                    cross-check + MFU accounting (``model_flops``,
                    ``achieved_flops_per_sec``, ``mfu`` gauges per layout);
@@ -63,9 +68,11 @@ from shallowspeed_tpu.observability.metrics import (
     MetricsRecorder,
     NullMetrics,
     read_jsonl,
+    replica_shard_path,
 )
 from shallowspeed_tpu.observability.program_audit import AuditMismatchError
 from shallowspeed_tpu.observability.spans import Span, capture, span
+from shallowspeed_tpu.observability.stats import percentile
 
 __all__ = [
     "SCHEMA_VERSION",
@@ -78,6 +85,8 @@ __all__ = [
     "NullMetrics",
     "Span",
     "capture",
+    "percentile",
     "read_jsonl",
+    "replica_shard_path",
     "span",
 ]

@@ -64,6 +64,16 @@ Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
      "failed"|"none_newer">, "path": ..., "step": ..., "reason":
      "breaker"|"watch"|"manual", "wall_s": ..., "programs_cached": n,
      **fields}                                                       [v6+]
+    {"v": 7, "ts": ..., "kind": "fleet",     "name": "summary",
+     "completed": n, "dropped": n, "failovers": n, "reroutes": n,
+     "routing_skew": ..., "routing": {replica_id: routed},
+     "per_replica": {replica_id: {...}}, **fields}                   [v7+]
+    {"v": 7, "ts": ..., "kind": "fleet_health", "name": <event:
+     "replica_spawned"|"replica_ready"|"replica_dead"|"failover"|
+     "replica_degraded"|"replica_recovered"|"replica_draining"|
+     "replica_retired"|"scale_up"|"scale_down"|"fleet_degraded"|
+     "fleet_recovered"|"reload_broadcast">, "replica_id": r,
+     **fields}                                                       [v7+]
 
 Schema compatibility rules (SCHEMA_VERSION history):
 
@@ -108,6 +118,17 @@ Schema compatibility rules (SCHEMA_VERSION history):
   — lawful under the ignore-unknown-fields rule; no existing
   name/field changed meaning). The v6 reader accepts v1–v5 files
   unchanged; a v7 file is refused.
+- v7  ADDITIVE: the ``fleet`` (one fleet run's aggregate — per-replica
+  verdict counts, routing assignments + skew, failover/reroute counts,
+  availability, the measured recovery and scale-up times) and
+  ``fleet_health`` (one fleet lifecycle event — a replica spawned/ready/
+  dead/degraded/retired, a failover requeue, a scale decision, a
+  fleet-level quorum transition — every one tagged ``replica_id``) kinds,
+  the evidence stream behind the report CLI's Fleet section
+  (shallowspeed_tpu/serving/fleet.py, docs/serving.md "Fleet"). No
+  existing kind or field changed meaning; the v7 reader accepts v1–v6
+  files unchanged and the strict refusal stays one-directional (a v8
+  file is refused).
 
 The contract for future bumps: additive kinds/fields bump the version and
 must keep old records readable; any change to an EXISTING kind's meaning
@@ -117,8 +138,15 @@ known kinds.
 Multihost: a ``JsonlMetrics`` constructed under ``jax.process_count() > 1``
 appends a ``.p{process_index}`` suffix to its path — concurrent hosts
 each own one shard and can never interleave writes into one file.
-``read_jsonl`` accepts a glob (``run.jsonl.p*``) and, given a bare path
-that does not exist, falls back to its ``.p*`` shards automatically.
+Fleet workers reuse the same convention with an ``.r{replica_id}``
+suffix (``replica_shard_path``): every serving replica process owns its
+shard, the parent fleet process owns the bare path, and ``replica_id``
+is the join key between the parent's ``fleet``/``fleet_health`` records
+and each shard's ``request``/``serving_health`` stream
+(docs/observability.md). ``read_jsonl`` accepts a glob
+(``run.jsonl.p*``, ``fleet.jsonl*``) and, given a bare path that does
+not exist, falls back to its ``.p*`` (multihost) or ``.r*`` (fleet)
+shards automatically.
 
 The span taxonomy and the metric names the framework itself emits are
 documented in docs/observability.md.
@@ -132,7 +160,7 @@ import time
 
 from shallowspeed_tpu.observability.spans import Span
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 SCHEMA_NAME = "shallowspeed_tpu.metrics"
 
 
@@ -202,6 +230,12 @@ class NullMetrics:
         pass
 
     def reload(self, name, **fields):
+        pass
+
+    def fleet(self, name, **fields):
+        pass
+
+    def fleet_health(self, name, **fields):
         pass
 
     def flush(self):
@@ -297,6 +331,12 @@ class MetricsRecorder:
 
     def reload(self, name, **fields):
         self._emit({"kind": "reload", "name": name, **fields})
+
+    def fleet(self, name, **fields):
+        self._emit({"kind": "fleet", "name": name, **fields})
+
+    def fleet_health(self, name, **fields):
+        self._emit({"kind": "fleet_health", "name": name, **fields})
 
     # -- recorder-internal hooks --------------------------------------------
 
@@ -486,12 +526,23 @@ def _shard_path(path):
     return path
 
 
+def replica_shard_path(path, replica_id):
+    """The fleet worker's JSONL path: ``path.r{replica_id}`` — the
+    multihost ``.p{process_index}`` convention reused for serving
+    replicas, so N engine worker processes can never interleave writes
+    into one file. The parent fleet process owns the bare ``path``;
+    ``replica_id`` is the join key between its ``fleet``/``fleet_health``
+    records and each shard's per-request stream."""
+    return f"{os.fspath(path)}.r{int(replica_id)}"
+
+
 def _expand_shards(path):
     """``read_jsonl`` path resolution: an existing file is read as-is
     (even when its name contains glob metacharacters); otherwise an
     explicit glob expands to its sorted matches, and a bare path falls
     back to its multihost ``.p*`` shards (what ``JsonlMetrics`` wrote
-    under ``process_count() > 1``)."""
+    under ``process_count() > 1``) or its fleet ``.r*`` shards (what the
+    fleet workers wrote via ``replica_shard_path``)."""
     s = os.fspath(path)
     if os.path.exists(s):
         return [s]
@@ -500,9 +551,12 @@ def _expand_shards(path):
         if not shards:
             raise FileNotFoundError(f"no metrics files match glob {s!r}")
         return shards
-    # only writer-shaped shards (".p" + digits) — a neighbor like
+    # only writer-shaped shards (".p"/".r" + digits) — a neighbor like
     # "run.jsonl.partial" must never be silently merged as a shard
-    shards = sorted(_glob.glob(_glob.escape(s) + ".p[0-9]*"))
+    shards = sorted(
+        _glob.glob(_glob.escape(s) + ".p[0-9]*")
+        + _glob.glob(_glob.escape(s) + ".r[0-9]*")
+    )
     if shards:
         return shards
     return [s]

@@ -50,7 +50,17 @@ human or a bench gate actually asks of a run:
   (schema-v6 ``serving_health``/``reload`` records and the terminal
   failure verdicts): shed/error/unhealthy counts, injected faults,
   breaker trips + hot reloads, the measured recovery time, and the
-  availability verdict. Clean runs and pre-v6 files render unchanged.
+  availability verdict. Clean runs and pre-v6 files render unchanged;
+- a FLEET section (schema-v7 ``fleet``/``fleet_health`` records, the
+  serving fleet's evidence stream): replica lifecycle (started / died /
+  retired, SIGKILLs injected by the chaos soak), failover count + the
+  in-flight requests re-queued, verdict reroutes, elasticity (scale-ups
+  with the measured ready time), per-replica routing counts + the
+  routing skew, per-replica verdict rows (join the ``.r{replica_id}``
+  JSONL shards on ``replica_id`` for each replica's own request
+  stream — pass a glob like ``fleet.jsonl*`` to merge them), and the
+  fleet availability verdict. Single-engine runs and pre-v7 files
+  render unchanged.
 
 ``--baseline`` compares throughput against another run's JSONL or a
 bench-style JSON record (``{"value": ..., "unit": "samples/s"}``, or a
@@ -67,6 +77,7 @@ from pathlib import Path
 
 from shallowspeed_tpu.observability.metrics import read_jsonl
 from shallowspeed_tpu.observability.program_audit import format_bytes
+from shallowspeed_tpu.observability.stats import percentile
 
 BLOCKS = "▁▂▃▄▅▆▇█"  # ▁▂▃▄▅▆▇█
 
@@ -230,6 +241,7 @@ def build_report(records, source="", trace=None, slo_ms=None):
     overlap = _overlap_info(audit, trace)
     reliability = _reliability_info(records, spans)
     serving = _serving_info(records, slo_ms)
+    fleet = _fleet_info(records)
 
     return {
         "source": source,
@@ -271,6 +283,7 @@ def build_report(records, source="", trace=None, slo_ms=None):
         },
         "reliability": reliability,
         "serving": serving,
+        "fleet": fleet,
     }
 
 
@@ -346,18 +359,6 @@ def _reliability_info(records, spans):
     }
 
 
-def _percentile(sorted_vals, q):
-    """Linear-interpolated percentile over an already-sorted list —
-    np.percentile's default method, matching the serving engine's summary
-    so the killed-run fallback and the summary agree on identical data."""
-    n = len(sorted_vals)
-    rank = q / 100.0 * (n - 1)
-    lo = int(rank)
-    hi = min(lo + 1, n - 1)
-    frac = rank - lo
-    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
-
-
 def _serving_info(records, slo_ms=None):
     """Fold the schema-v5 ``request``/``serving`` records into the Serving
     story; None when the run recorded neither (the section is then omitted
@@ -391,16 +392,16 @@ def _serving_info(records, slo_ms=None):
             n = sum(1 for r in requests if r.get("name") == name)
             info[verdict] = n
     info["degradation"] = _degradation_info(records, info)
-    lats = sorted(
-        r["latency_s"] for r in ok if _finite(r.get("latency_s"))
-    )
+    lats = [r["latency_s"] for r in ok if _finite(r.get("latency_s"))]
     if lats and info.get("p50_latency_s") is None:
-        # linear-interpolated percentiles, the engine summary's own
-        # definition (np.percentile default) — a rank index like
-        # int(0.99*n) would pick the MAXIMUM for any n <= 100 and let one
-        # outlier flip the SLO verdict
-        info["p50_latency_s"] = _percentile(lats, 50)
-        info["p99_latency_s"] = _percentile(lats, 99)
+        # the ONE shared percentile definition (observability.stats —
+        # np.percentile, linear interpolation), so this killed-run
+        # fallback can never disagree with the engine or fleet summary
+        # on identical data; a rank index like int(0.99*n) would pick
+        # the MAXIMUM for any n <= 100 and let one outlier flip the SLO
+        # verdict
+        info["p50_latency_s"] = percentile(lats, 50)
+        info["p99_latency_s"] = percentile(lats, 99)
     eff_slo = slo_ms if slo_ms is not None else info.get("slo_ms")
     p99 = info.get("p99_latency_s")
     if eff_slo is None:
@@ -499,6 +500,84 @@ def _degradation_info(records, srv):
         "degraded_at_exit": bool(degraded),
         "verdict": verdict,
     }
+
+
+def _fleet_info(records):
+    """Fold the schema-v7 ``fleet``/``fleet_health`` records into the
+    Fleet story; None when the run recorded neither (single-engine runs
+    and every pre-v7 file render exactly as before).
+
+    The LAST ``fleet`` summary wins (the fleet emits one per load run);
+    the lifecycle counters fall back to counting ``fleet_health`` events
+    when no summary landed (a killed PARENT keeps its per-event
+    evidence, the same discipline as the Serving fallback). The
+    ``replica_id`` on every event is the join key into the per-replica
+    ``.r{id}`` JSONL shards."""
+    health = [r for r in records if r.get("kind") == "fleet_health"]
+    summary = None
+    for r in records:
+        if r.get("kind") == "fleet":
+            summary = {
+                k: v for k, v in r.items() if k not in ("v", "ts", "kind", "name")
+            }
+    if summary is None and not health:
+        return None
+    info = dict(summary) if summary else {}
+
+    def count(name):
+        return sum(1 for r in health if r.get("name") == name)
+
+    if info.get("replicas_started") is None:
+        info["replicas_started"] = count("replica_spawned")
+    if info.get("replicas_dead") is None:
+        info["replicas_dead"] = count("replica_dead")
+    if info.get("replicas_retired") is None:
+        info["replicas_retired"] = count("replica_retired")
+    if info.get("failovers") is None:
+        info["failovers"] = count("failover")
+    if info.get("failover_requeued") is None:
+        info["failover_requeued"] = sum(
+            r.get("requeued") or 0 for r in health if r.get("name") == "failover"
+        )
+    if info.get("reroutes") is None:
+        info["reroutes"] = count("reroute")
+    if info.get("scale_ups") is None:
+        info["scale_ups"] = count("scale_up")
+    if info.get("scale_downs") is None:
+        info["scale_downs"] = count("scale_down")
+    info["sigkills_injected"] = count("replica_sigkill")
+    degraded = info.get("degraded")
+    if degraded is None:
+        # record-order fallback: a fleet_degraded with no recovery after
+        last_deg = max(
+            (i for i, r in enumerate(health) if r.get("name") == "fleet_degraded"),
+            default=None,
+        )
+        last_rec = max(
+            (i for i, r in enumerate(health) if r.get("name") == "fleet_recovered"),
+            default=None,
+        )
+        degraded = last_deg is not None and (
+            last_rec is None or last_rec < last_deg
+        )
+    info["degraded_at_exit"] = bool(degraded)
+    if info["degraded_at_exit"]:
+        verdict = "FLEET DEGRADED at exit: quorum down, admission refused"
+    elif info["replicas_dead"] or info["failovers"]:
+        verdict = (
+            f"recovered from {info['replicas_dead']} replica death(s): "
+            f"{info['failovers']} failover(s)"
+            + (
+                f", {_fmt_time_s(info.get('recovery_s'))} to next served "
+                "response"
+                if info.get("recovery_s") is not None
+                else ""
+            )
+        )
+    else:
+        verdict = "healthy: no replica deaths"
+    info["verdict"] = verdict
+    return info
 
 
 def _overlap_info(audit, trace):
@@ -945,6 +1024,77 @@ def _serving_lines(srv, md):
     return lines
 
 
+def _fleet_lines(fl, md):
+    """The Fleet section: replica lifecycle, routing skew, failover +
+    elasticity accounting, per-replica verdict rows, and the fleet
+    verdict (docs/serving.md "Fleet")."""
+    if not fl:
+        return []
+    lines = ["## Fleet" if md else "fleet:"]
+    line = (
+        f"replicas: {fl.get('replicas_started')} started"
+        + (
+            f" (target {fl['replicas_target']}, {fl.get('replicas_ready')} "
+            "ready at exit)"
+            if fl.get("replicas_target") is not None
+            else ""
+        )
+    )
+    if fl.get("replicas_dead"):
+        line += f", {fl['replicas_dead']} DIED"
+        if fl.get("sigkills_injected"):
+            line += f" ({fl['sigkills_injected']} SIGKILL injected)"
+    if fl.get("replicas_retired"):
+        line += f", {fl['replicas_retired']} retired"
+    lines.append(line)
+    fo = (
+        f"failover: {fl.get('failovers', 0)} event(s), "
+        f"{fl.get('failover_requeued', 0)} in-flight request(s) re-queued"
+    )
+    if fl.get("failover_exhausted"):
+        fo += f", {fl['failover_exhausted']} budget-exhausted"
+    if fl.get("reroutes"):
+        fo += f"; {fl['reroutes']} verdict reroute(s)"
+    lines.append(fo)
+    if fl.get("scale_ups") or fl.get("scale_downs"):
+        sc = (
+            f"elasticity: {fl.get('scale_ups', 0)} scale-up(s), "
+            f"{fl.get('scale_downs', 0)} scale-down(s)"
+        )
+        if fl.get("scale_up_s") is not None:
+            sc += f", last replica ready in {_fmt_time_s(fl['scale_up_s'])}"
+        lines.append(sc)
+    routing = fl.get("routing") or {}
+    if routing:
+        parts = ", ".join(
+            f"r{rid}: {n}" for rid, n in sorted(routing.items(), key=lambda kv: str(kv[0]))
+        )
+        skew = fl.get("routing_skew")
+        lines.append(
+            f"routing: {parts}"
+            + (f" — skew {skew:.2f}x (max/mean)" if _finite(skew) else "")
+        )
+    per = fl.get("per_replica") or {}
+    for rid in sorted(per, key=str):
+        row = per[rid] or {}
+        verdicts = row.get("verdicts") or {}
+        vs = ", ".join(f"{k} {v}" for k, v in sorted(verdicts.items()))
+        lines.append(
+            f"  replica {rid} [{row.get('state')}]: routed "
+            f"{row.get('routed')}, verdicts {{{vs}}}"
+        )
+    avail = fl.get("availability")
+    lines.append(
+        (
+            f"availability {avail * 100:.1f}% — {fl['verdict']}"
+            if _finite(avail)
+            else fl["verdict"]
+        )
+    )
+    lines.append("")
+    return lines
+
+
 def render(report, fmt, comparison=None):
     if fmt == "json":
         out = dict(report)
@@ -970,6 +1120,7 @@ def render(report, fmt, comparison=None):
     lines.extend(_comms_lines(report.get("xla_audit"), md))
     lines.extend(_reliability_lines(report.get("reliability"), md))
     lines.extend(_serving_lines(report.get("serving"), md))
+    lines.extend(_fleet_lines(report.get("fleet"), md))
     header = "## Span breakdown" if md else "span breakdown:"
     lines.append(header)
     if report["spans"]:

@@ -1,0 +1,32 @@
+"""Shared summary statistics: the ONE percentile definition.
+
+Three consumers quote latency percentiles — the serving engine's
+``stats()`` summary, the fleet's fleet-wide summary, and the report CLI's
+killed-run fallback (recomputing p50/p99 from raw ``request`` records when
+no summary landed). Before this module each carried its own
+implementation; two of them agreed only by co-incidence of method
+(np.percentile's default linear interpolation vs a hand-rolled
+re-derivation of it), which is exactly the kind of duplicated definition
+that lets a report and an engine summary disagree on the same data by one
+ULP and flip an SLO verdict.
+
+``percentile`` is now the single definition: ``np.percentile`` on float64
+with its default (linear-interpolation) method — so every consumer is
+EQUAL to ``np.percentile`` by construction, and the unit test pins that
+equality rather than approximates it. ``None`` samples are ignored (the
+recorders use None for "not measured") and an empty sample set returns
+``None``, never 0.0 — an unmeasured percentile must not read as a fast
+one.
+"""
+
+import numpy as np
+
+
+def percentile(values, q):
+    """The shared percentile: ``np.percentile(values, q)`` (float64,
+    linear interpolation) over the non-``None`` samples; ``None`` when no
+    sample survives the filter."""
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return None
+    return float(np.percentile(np.asarray(vals, np.float64), q))

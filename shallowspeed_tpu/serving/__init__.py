@@ -18,7 +18,21 @@ The subsystem behind the repo's second scoreboard — tail latency under load
                      faults");
 - ``loadgen``        seeded Poisson arrivals, open-loop (coordinated-
                      omission-corrected) and closed-loop drivers, each
-                     with the graceful-drain ``should_stop`` hook;
+                     with the graceful-drain ``should_stop`` hook — the
+                     drivers duck-type over an engine OR a fleet;
+- ``router``         fleet routing as pure logic: replica health state
+                     (heartbeat-fed), the bounded fleet queue,
+                     least-queue-depth / power-of-two-choices placement,
+                     the quorum rule;
+- ``fleet``          ``ServingFleet``: N engine replicas as spawned
+                     worker processes (each its own JAX runtime +
+                     checkpoint-loaded session + warmed ladder) behind
+                     the router — heartbeats, failover requeue-at-head
+                     under the shared retry budget, ``scale_up``/
+                     ``scale_down``/``watch_reload`` elasticity,
+                     schema-v7 ``fleet``/``fleet_health`` records and
+                     per-replica ``.r{id}`` JSONL shards
+                     (docs/serving.md "Fleet");
 - ``bench_serving``  the offered-load sweep: p50/p99, goodput, queue depth,
                      padding waste, saturation knee — one versioned JSON
                      record beside ``bench_scaling``'s — plus the seeded
@@ -31,6 +45,12 @@ The subsystem behind the repo's second scoreboard — tail latency under load
 """
 
 from shallowspeed_tpu.serving.engine import Request, ServingEngine
+from shallowspeed_tpu.serving.fleet import (
+    FleetError,
+    ServingFleet,
+    fleet_workers_supported,
+)
+from shallowspeed_tpu.serving.router import FleetRequest, Router
 from shallowspeed_tpu.serving.slots import (
     DEFAULT_SLOT_LADDER,
     DEFAULT_SLOT_ROWS,
@@ -43,8 +63,13 @@ from shallowspeed_tpu.serving.slots import (
 __all__ = [
     "DEFAULT_SLOT_LADDER",
     "DEFAULT_SLOT_ROWS",
+    "FleetError",
+    "FleetRequest",
     "Request",
+    "Router",
     "ServingEngine",
+    "ServingFleet",
+    "fleet_workers_supported",
     "pack_slots",
     "rung_for",
     "slots_needed",

@@ -1,9 +1,11 @@
-"""Serve entry point: wire a checkpoint to the engine and drive it.
+"""Serve entry point: wire a checkpoint to an engine — or a FLEET — and
+drive it.
 
     python -m shallowspeed_tpu.serving [--dp N] [--pp M] [--schedule gpipe]
         [--checkpoint ck.npz] [--requests 200] [--rate 100] [--seed 0]
         [--slo-ms 50] [--verify] [--audit] [--metrics-out serve.jsonl]
         [--faults SPEC] [--retry-budget 2] [--breaker 3]
+        [--fleet N] [--fleet-policy least_queue|p2c] [--fleet-retry 2]
 
 Builds a ``TrainingSession`` on the requested layout (restoring
 ``--checkpoint`` through the PR6 loader when given — any saved layout serves
@@ -21,6 +23,18 @@ loop re-enters with the queue intact, while ``mode=sigkill`` kills the
 process honestly — the per-record-flushed JSONL keeps everything up to
 the kill.
 
+``--fleet N`` serves through a ``ServingFleet`` instead: N replica worker
+processes (each its own JAX runtime + session on the requested layout,
+ladder warmed before it takes traffic) behind the router
+(docs/serving.md "Fleet"). Every per-engine flag applies PER REPLICA
+(``--faults`` / ``SHALLOWSPEED_FAULTS`` inject into every worker — a
+``die@dispatch=N:mode=sigkill`` plan kills replicas honestly and
+exercises failover); ``--verify`` moves the bitwise-parity check into
+each worker, per response. Without ``--checkpoint`` the replicas
+initialize identically (deterministic seeded init), so fleet responses
+stay replica-independent either way. Workers write per-replica
+``<metrics-out>.r{replica_id}`` JSONL shards beside the parent's file.
+
 Graceful drain: SIGTERM/SIGINT stop ADMISSION (no further requests are
 submitted), drain everything already queued to a terminal verdict, flush
 the metrics sink, and exit under the normal code contract — a preempted
@@ -33,8 +47,10 @@ Exit codes (aligned with train.py's documented contract):
      a bitwise mismatch under --verify (or an audit mismatch raising out
      of warm-up);
   2  usage errors (argparse);
-  3  DEGRADED at exit — the health breaker is still open (train.py's 3 is
-     the health-monitor halt; this is its serving mirror).
+  3  DEGRADED at exit — the health breaker is still open; in fleet mode,
+     the fleet is still degraded (a QUORUM of replicas down) at exit
+     (train.py's 3 is the health-monitor halt; this is its serving
+     mirror).
 """
 
 import argparse
@@ -164,6 +180,38 @@ def main(argv=None):
         "(degraded: admission refused; exit 3 if still open at exit)",
     )
     ap.add_argument(
+        "--fleet",
+        type=int,
+        default=0,
+        metavar="N",
+        help="serve through a ServingFleet of N replica worker processes "
+        "(each its own JAX runtime on this layout) instead of one "
+        "in-process engine; exit 3 if a quorum of replicas is down at "
+        "exit",
+    )
+    ap.add_argument(
+        "--fleet-policy",
+        choices=["least_queue", "p2c"],
+        default="least_queue",
+        help="fleet placement policy: least outstanding load, or "
+        "power-of-two-choices",
+    )
+    ap.add_argument(
+        "--fleet-retry",
+        type=int,
+        default=2,
+        help="fleet-level placement budget per request (the shared "
+        "retry.RetryPolicy, one attempt per routing) — failover and "
+        "verdict reroutes consume it",
+    )
+    ap.add_argument(
+        "--fleet-max-queue",
+        type=int,
+        default=None,
+        help="bounded fleet queue: admissions beyond it are DROPPED "
+        "(reason fleet_queue_full); default unbounded",
+    )
+    ap.add_argument(
         "--verify",
         action="store_true",
         help="re-compute every 'ok' response with a direct predict() of the "
@@ -177,6 +225,9 @@ def main(argv=None):
     )
     ap.add_argument("--metrics-out", default=None)
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        return _fleet_main(args)
 
     from shallowspeed_tpu.api import TrainingSession
     from shallowspeed_tpu.observability import JsonlMetrics
@@ -320,6 +371,167 @@ def main(argv=None):
         print(f"telemetry written: {metrics.path}")
     if engine.degraded:
         print("serving: engine DEGRADED at exit (breaker open)", file=sys.stderr)
+        return 3
+    if failures:
+        print(
+            f"serving: {failures} dropped/expired/errored/unhealthy/"
+            "incorrect response(s)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def _fleet_main(args):
+    """The ``--fleet N`` serve path: N replica workers behind the router,
+    the same seeded load, the same exit-code contract (module
+    docstring)."""
+    from shallowspeed_tpu.observability import JsonlMetrics
+    from shallowspeed_tpu.serving.fleet import ServingFleet
+    from shallowspeed_tpu.serving.loadgen import (
+        payload_in_dim,
+        poisson_arrivals,
+        request_payloads,
+        run_closed_loop,
+        run_open_loop,
+    )
+
+    metrics = JsonlMetrics(args.metrics_out) if args.metrics_out else None
+    worker_config = {
+        "session": dict(
+            dp=args.dp,
+            pp=args.pp,
+            tp=args.tp,
+            schedule=args.schedule,
+            virtual_stages=args.virtual_stages,
+            global_batch_size=args.global_batch_size,
+            mubatches=args.mubatches,
+            data_dir=args.data_dir,
+            resume=args.checkpoint,
+            audit=args.audit,
+            predict_slot_rows=args.slot_rows,
+            predict_slot_ladder=(
+                tuple(int(r) for r in args.slot_ladder.split(","))
+                if args.slot_ladder
+                else None
+            ),
+        ),
+        "engine": dict(
+            max_slots=args.max_slots,
+            slo_ms=args.slo_ms,
+            retry=args.retry_budget,
+            breaker_threshold=args.breaker,
+            faults=args.faults,
+        ),
+        "verify": args.verify,
+    }
+    fleet = ServingFleet(
+        worker_config,
+        n_replicas=args.fleet,
+        policy=args.fleet_policy,
+        max_queue=args.fleet_max_queue,
+        slo_ms=args.slo_ms,
+        retry=args.fleet_retry,
+        metrics=metrics,
+        seed=args.seed,
+    )
+    print(
+        f"fleet: {args.fleet} replicas x (DP={args.dp} x PP={args.pp} x "
+        f"TP={args.tp}, {args.schedule}), policy {args.fleet_policy}, "
+        f"{args.requests} requests"
+        + (
+            f" closed-loop C={args.closed_loop}"
+            if args.closed_loop
+            else f" @ {args.rate} rps Poisson (seed {args.seed})"
+        )
+        + (f", weights from {args.checkpoint}" if args.checkpoint else "")
+    )
+    stopper = GracefulStop().install()
+    try:
+        fleet.start()  # every replica's ladder warmed before traffic
+        payloads = request_payloads(
+            args.requests,
+            payload_in_dim(args.data_dir),
+            seed=args.seed,
+            rows_choices=tuple(
+                int(r) for r in args.rows.split(",") if r.strip()
+            ),
+        )
+        if args.closed_loop:
+            done = run_closed_loop(
+                fleet, payloads, concurrency=args.closed_loop,
+                deadline_ms=args.deadline_ms, should_stop=stopper.stop,
+            )
+        else:
+            arrivals = poisson_arrivals(args.rate, args.requests, seed=args.seed)
+            done = run_open_loop(
+                fleet, payloads, arrivals, deadline_ms=args.deadline_ms,
+                should_stop=stopper.stop,
+            )
+        rec = fleet.record_summary(
+            offered_rps=None if args.closed_loop else args.rate
+        )
+    finally:
+        stopper.restore()
+        fleet.stop()
+    if stopper.stop():
+        sig = signal.Signals(stopper.signum).name
+        print(
+            f"{sig} received: admission stopped, fleet drained "
+            f"({rec['completed']} served)"
+        )
+
+    def ms(v):
+        return f"{v * 1e3:.2f} ms" if v is not None else "n/a"
+
+    print(
+        f"completed {rec['completed']}/{args.requests}, dropped "
+        f"{rec['dropped']}, expired {rec['expired']}, errors "
+        f"{rec['errors']}, unhealthy {rec['unhealthy']}; latency p50 "
+        f"{ms(rec['p50_latency_s'])}, p99 {ms(rec['p99_latency_s'])}"
+    )
+    routing = ", ".join(
+        f"r{rid}: {n}" for rid, n in sorted(rec["routing"].items())
+    )
+    print(
+        f"routing: {routing}"
+        + (
+            f" — skew {rec['routing_skew']:.2f}x"
+            if rec["routing_skew"] is not None
+            else ""
+        )
+    )
+    if rec["failovers"] or rec["replicas_dead"]:
+        print(
+            f"failover: {rec['replicas_dead']} replica death(s), "
+            f"{rec['failovers']} failover(s), {rec['failover_requeued']} "
+            f"in-flight re-queued, {rec['reroutes']} reroute(s)"
+            + (
+                f", recovered in {rec['recovery_s'] * 1e3:.1f} ms"
+                if rec["recovery_s"] is not None
+                else ""
+            )
+        )
+    if args.verify:
+        served = rec["completed"]
+        mism = rec["parity_mismatches"]
+        print(
+            f"verify: {served - mism}/{served} responses bitwise-equal to "
+            "the serving replica's direct predict()"
+            + ("" if mism == 0 else f" — {mism} MISMATCHED")
+        )
+    if metrics is not None:
+        metrics.close()
+        print(f"telemetry written: {metrics.path} (+ .r* replica shards)")
+    failures = (
+        rec["dropped"] + rec["expired"] + rec["errors"] + rec["unhealthy"]
+        + rec["parity_mismatches"]
+    )
+    if rec["degraded"]:
+        print(
+            "serving: fleet DEGRADED at exit (quorum of replicas down)",
+            file=sys.stderr,
+        )
         return 3
     if failures:
         print(

@@ -86,6 +86,7 @@ from shallowspeed_tpu.checkpoint import (
     find_newer_good,
 )
 from shallowspeed_tpu.observability import NullMetrics
+from shallowspeed_tpu.observability.stats import percentile
 from shallowspeed_tpu.serving import slots as serving_slots
 
 # terminal request verdicts — every submitted request ends on exactly one
@@ -789,8 +790,8 @@ class ServingEngine:
             "padding_waste": (
                 1.0 - self._useful_rows / padded_rows if padded_rows else None
             ),
-            "p50_latency_s": _pct(lats, 50),
-            "p99_latency_s": _pct(lats, 99),
+            "p50_latency_s": percentile(lats, 50),
+            "p99_latency_s": percentile(lats, 99),
             "max_latency_s": max(lats) if lats else None,
             "mean_queue_s": (sum(queues) / len(queues)) if queues else None,
             "window_s": window,
@@ -849,10 +850,3 @@ class ServingEngine:
         self._dispatches = 0
         self._slots_dispatched = 0
         self._useful_rows = 0
-
-
-def _pct(values, q):
-    values = [v for v in values if v is not None]
-    if not values:
-        return None
-    return float(np.percentile(np.asarray(values, np.float64), q))

@@ -19,6 +19,7 @@ Two drive modes (the classic load-testing pair):
   queue depth.
 """
 
+import os
 import time
 
 import numpy as np
@@ -38,6 +39,19 @@ def _step_reentrant(engine):
         return engine.step()
     except InjectedFault:
         return []
+
+
+def payload_in_dim(data_dir, default=784):
+    """The request payload width for a fleet CLI: the data layer's
+    training-split width when ``data_dir`` holds one, else ``default``
+    (the flagship MLP's MNIST input). The fleet parent never builds a
+    session of its own, so it reads the dimension the way the worker
+    sessions will."""
+    if data_dir:
+        x_path = os.path.join(os.fspath(data_dir), "x_train.npy")
+        if os.path.exists(x_path):
+            return int(np.load(x_path, mmap_mode="r").shape[1])
+    return int(default)
 
 
 def poisson_arrivals(rate_rps, n, seed=0):

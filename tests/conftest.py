@@ -51,3 +51,12 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 gate (-m 'not slow')"
     )
+    # fleet tests spawn real worker processes (multiprocessing spawn +
+    # their own JAX runtimes); they skip-with-reason on platforms that
+    # cannot spawn workers — mirroring the multihost collectives skip —
+    # so tier-1 stays green on constrained runners
+    config.addinivalue_line(
+        "markers",
+        "fleet: multi-process serving-fleet tests (skipped when the "
+        "platform cannot spawn worker processes)",
+    )

@@ -684,10 +684,9 @@ def test_schema_v5_request_and_serving_kinds(tmp_path):
 def test_schema_v6_serving_health_and_reload_kinds(tmp_path):
     """Schema v6 (additive): the serving_health/reload record kinds — the
     serving degradation evidence stream — round-trip with the version
-    stamp AND the non-finite sanitizer, the v6 reader accepts v1-v5 files
-    unchanged, a v7 file is refused (the strict check stays
+    stamp AND the non-finite sanitizer, the reader accepts v1-v5 files
+    unchanged, a future-versioned file is refused (the strict check stays
     one-directional), and NullMetrics no-ops the new hooks."""
-    assert SCHEMA_VERSION == 6
     path = tmp_path / "v6.jsonl"
     with JsonlMetrics(path) as m:
         m.serving_health(
@@ -738,14 +737,114 @@ def test_schema_v6_serving_health_and_reload_kinds(tmp_path):
         p = tmp_path / f"old-v{v}.jsonl"
         p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
         assert read_jsonl(p)[0]["kind"] == rec["kind"]
-    # one-directional refusal: a v7 file fails loudly
-    v7 = tmp_path / "v7.jsonl"
-    v7.write_text(json.dumps({"v": SCHEMA_VERSION + 1, "kind": "event"}) + "\n")
+    # one-directional refusal: a future-versioned file fails loudly
+    v_next = tmp_path / "vnext.jsonl"
+    v_next.write_text(
+        json.dumps({"v": SCHEMA_VERSION + 1, "kind": "event"}) + "\n"
+    )
     with pytest.raises(ValueError, match="newer"):
-        read_jsonl(v7)
+        read_jsonl(v_next)
     n = NullMetrics()
     n.serving_health("breaker_open", dispatch=1)
     n.reload("ok", path="x")
+
+
+def test_schema_v7_fleet_kinds(tmp_path):
+    """Schema v7 (additive): the fleet/fleet_health record kinds — the
+    serving fleet's evidence stream, every event tagged replica_id —
+    round-trip with the version stamp, the v7 reader accepts v1-v6 files
+    unchanged, a v8 file is refused, and NullMetrics no-ops the new
+    hooks."""
+    assert SCHEMA_VERSION == 7
+    path = tmp_path / "v7.jsonl"
+    with JsonlMetrics(path) as m:
+        m.fleet_health("replica_spawned", replica_id=0, checkpoint=None)
+        m.fleet_health("replica_ready", replica_id=0, wall_s=1.5)
+        m.fleet_health("replica_dead", replica_id=0, inflight=3, error=None)
+        m.fleet_health("failover", replica_id=0, requeued=3, exhausted=0)
+        m.fleet(
+            "summary",
+            completed=40, dropped=0, failovers=1, reroutes=2,
+            routing={0: 21, 1: 19}, routing_skew=1.05,
+            per_replica={0: {"routed": 21, "verdicts": {"ok": 21}}},
+            recovery_s=0.004,
+        )
+    recs = read_jsonl(path)
+    assert [r["kind"] for r in recs] == [
+        "meta", "fleet_health", "fleet_health", "fleet_health",
+        "fleet_health", "fleet",
+    ]
+    assert all(r["v"] == 7 for r in recs)
+    assert all(
+        "replica_id" in r for r in recs if r["kind"] == "fleet_health"
+    )
+    assert recs[4]["name"] == "failover" and recs[4]["requeued"] == 3
+    assert recs[5]["routing"] == {"0": 21, "1": 19}  # JSON stringifies keys
+    # v1-v6 files load unchanged under the v7 reader
+    for v, rec in (
+        (1, {"kind": "event", "name": "epoch", "epoch": 0, "loss": 0.5}),
+        (5, {"kind": "serving", "name": "summary", "completed": 7}),
+        (6, {"kind": "serving_health", "name": "breaker_open", "dispatch": 3}),
+    ):
+        p = tmp_path / f"old-v{v}.jsonl"
+        p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
+        assert read_jsonl(p)[0]["kind"] == rec["kind"]
+    # one-directional refusal: a v8 file fails loudly
+    v8 = tmp_path / "v8.jsonl"
+    v8.write_text(json.dumps({"v": 8, "kind": "event"}) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        read_jsonl(v8)
+    n = NullMetrics()
+    n.fleet("summary", completed=1)
+    n.fleet_health("replica_dead", replica_id=0)
+
+
+def test_replica_shard_suffix_and_fallback_read(tmp_path):
+    """Fleet workers reuse the multihost shard convention as .r{id}:
+    replica_shard_path names each worker's own JSONL shard, an explicit
+    glob merges parent + shards, and the bare-path fallback resolves a
+    missing base to its .r shards (never to look-alike neighbors)."""
+    from shallowspeed_tpu.observability.metrics import replica_shard_path
+
+    base = tmp_path / "fleet.jsonl"
+    assert replica_shard_path(base, 2) == str(base) + ".r2"
+    for rid in (0, 1):
+        with JsonlMetrics(replica_shard_path(base, rid)) as m:
+            m.request("ok", id=rid, rows=1, slots=1)
+    # a look-alike neighbor must never be merged by the BARE-PATH
+    # fallback (an explicit glob is the caller's own choice)
+    decoy = tmp_path / "fleet.jsonl.rpartial"
+    decoy.write_text("not json\n")
+    # bare-path fallback: base missing -> its .r shards, sorted
+    recs = read_jsonl(base)
+    assert [r["id"] for r in recs if r["kind"] == "request"] == [0, 1]
+    decoy.unlink()
+    # parent + shards via explicit glob once the base exists too
+    with JsonlMetrics(base) as m:
+        m.fleet("summary", completed=2)
+    recs2 = read_jsonl(str(base) + "*")
+    kinds = [r["kind"] for r in recs2 if r["kind"] != "meta"]
+    assert kinds.count("request") == 2 and kinds.count("fleet") == 1
+
+
+def test_percentile_single_shared_definition():
+    """Satellite: the ONE percentile helper equals np.percentile exactly
+    (not approximately) on arbitrary data, ignores None samples, and
+    returns None — never 0.0 — when nothing was measured. The engine
+    summary, fleet summary and report fallback all call it, so p99 can
+    no longer disagree with itself across consumers."""
+    from shallowspeed_tpu.observability import percentile
+
+    rng = np.random.RandomState(7)
+    for n in (1, 2, 3, 10, 100, 101):
+        vals = list(rng.exponential(0.01, size=n))
+        for q in (0, 50, 90, 99, 100):
+            assert percentile(vals, q) == float(
+                np.percentile(np.asarray(vals, np.float64), q)
+            )
+    assert percentile([None, 3.0, None, 1.0], 50) == 2.0
+    assert percentile([], 99) is None
+    assert percentile([None, None], 99) is None
 
 
 def test_jsonl_multihost_shard_suffix_and_glob_read(tmp_path, monkeypatch):

@@ -593,3 +593,69 @@ def test_report_reliability_omitted_without_v4_records(tmp_path, capsys):
     assert report.main([str(fresh), "--format", "md"]) == 0
     out = capsys.readouterr().out
     assert "recovery: fresh start" in out
+
+
+def test_report_fleet_section(tmp_path, capsys):
+    """The Fleet section: a v7 fleet summary + fleet_health stream renders
+    replica lifecycle, failover, elasticity, routing skew, per-replica
+    verdict rows and the availability verdict; runs without fleet records
+    render exactly as before (fleet is null / section absent)."""
+    path = tmp_path / "fleet.jsonl"
+    with JsonlMetrics(path) as m:
+        for rid in (0, 1, 2):
+            m.fleet_health("replica_spawned", replica_id=rid, checkpoint=None)
+            m.fleet_health("replica_ready", replica_id=rid, wall_s=1.2)
+        m.fleet_health("replica_sigkill", replica_id=1, pid=123)
+        m.fleet_health("replica_dead", replica_id=1, inflight=3, error=None)
+        m.fleet_health("failover", replica_id=1, requeued=3, exhausted=0)
+        m.fleet_health("scale_up", replica_id=3, replacement=True, target=3)
+        m.fleet(
+            "summary",
+            completed=90, dropped=0, expired=0, errors=0, unhealthy=0,
+            availability=1.0, failovers=1, failover_requeued=3,
+            failover_exhausted=0, reroutes=2, replicas_target=3,
+            replicas_started=4, replicas_ready=3, replicas_dead=1,
+            replicas_retired=0, scale_ups=1, scale_downs=0,
+            scale_up_s=1.4, degraded=False, recovery_s=0.004,
+            routing={0: 44, 1: 6, 2: 40, 3: 0}, routing_skew=1.47,
+            per_replica={
+                0: {"state": "ready", "routed": 44, "verdicts": {"ok": 44}},
+                1: {"state": "dead", "routed": 6, "verdicts": {"ok": 5}},
+            },
+            p50_latency_s=0.004, p99_latency_s=0.012,
+        )
+    rep = report.build_report(read_jsonl(path))
+    fl = rep["fleet"]
+    assert fl["failovers"] == 1 and fl["failover_requeued"] == 3
+    assert fl["sigkills_injected"] == 1
+    assert fl["degraded_at_exit"] is False
+    assert "recovered from 1 replica death" in fl["verdict"]
+    assert report.main([str(path), "--format", "md"]) == 0
+    out = capsys.readouterr().out
+    assert "## Fleet" in out
+    assert "1 DIED (1 SIGKILL injected)" in out
+    assert "failover: 1 event(s), 3 in-flight request(s) re-queued" in out
+    assert "skew 1.47x" in out
+    assert "replica 1 [dead]" in out
+    assert "availability 100.0%" in out
+
+    # killed-parent fallback: fleet_health events alone still fold
+    partial = tmp_path / "partial.jsonl"
+    with JsonlMetrics(partial) as m:
+        m.fleet_health("replica_spawned", replica_id=0, checkpoint=None)
+        m.fleet_health("replica_dead", replica_id=0, inflight=2, error=None)
+        m.fleet_health("failover", replica_id=0, requeued=2, exhausted=0)
+        m.fleet_health("fleet_degraded", replica_id=None, healthy=0,
+                       target=1, quorum=1)
+    fl2 = report.build_report(read_jsonl(partial))["fleet"]
+    assert fl2["replicas_dead"] == 1 and fl2["failover_requeued"] == 2
+    assert fl2["degraded_at_exit"] is True
+    assert "DEGRADED" in fl2["verdict"]
+
+    # no fleet records -> section omitted, JSON carries fleet: null
+    plain = tmp_path / "noval.jsonl"
+    with JsonlMetrics(plain) as m:
+        m.event("epoch", epoch=0, loss=0.5, samples_per_sec=10.0, wall_s=1.0)
+    assert report.build_report(read_jsonl(plain))["fleet"] is None
+    assert report.main([str(plain), "--format", "md"]) == 0
+    assert "## Fleet" not in capsys.readouterr().out
