@@ -7,9 +7,9 @@ CPU_MESH = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 SHELL := /bin/bash
 
 .PHONY: test verify metrics-smoke report-smoke audit-smoke overlap-smoke \
-        split-smoke tp-smoke recovery-smoke serve-smoke chaos-smoke \
-        fleet-smoke bench-serving data train train-mesh bench bench-scaling \
-        schedules clean
+        split-smoke tp-smoke recovery-smoke aot-smoke serve-smoke \
+        chaos-smoke fleet-smoke bench-serving bench-ckpt-aot data train \
+        train-mesh bench bench-scaling schedules clean
 
 test:
 	python -m pytest tests/ -q
@@ -204,7 +204,65 @@ recovery-smoke:
 	  grep -q "recovery: resumed from" /tmp/rsmoke/$$lay.report.md; \
 	  grep -q "steps lost to replay: 3" /tmp/rsmoke/$$lay.report.md; \
 	done
-	@echo "recovery-smoke OK: kill-at-step-11 + resume auto is bitwise identical to the uninterrupted twin on dp2 and gpipe-pp4, Reliability section rendered"
+	@# the ASYNC leg (one layout keeps the smoke bounded; the in-suite
+	@# fuzz lattice covers dp2/pp4/tp2): SIGKILL injected INSIDE the
+	@# background writer's write/verify/rename window (die@save=2 fires
+	@# after the temp file is durable, before the rename) — discovery
+	@# must see only fully-verifying snapshots, resume must finish on
+	@# the twin's exact bits, and the report must show the async saves
+	set -e; \
+	  $(CPU_MESH) env SHALLOWSPEED_FAULTS="die@save=2:mode=sigkill" \
+	      python train.py --data-dir /tmp/rsmoke/data --epochs 2 \
+	      --global-batch-size 32 --no-eval --dp 2 --mubatches 2 \
+	      --checkpoint-dir /tmp/rsmoke/ck_async --checkpoint-every-steps 4 \
+	      --async-checkpoint \
+	      --metrics-out /tmp/rsmoke/async.killed.jsonl \
+	      > /tmp/rsmoke/async.killed.out 2>&1 && \
+	      { echo "async: injected in-window SIGKILL did not fire"; exit 1; } || true; \
+	  python -c "import sys; sys.path.insert(0, '.'); from shallowspeed_tpu.checkpoint import find_latest_good, list_step_checkpoints; steps=[g for g,_ in list_step_checkpoints('/tmp/rsmoke/ck_async')]; assert steps==[4,8], 'visible snapshots %r (save 2 = step 12 must never rename)' % steps; p,_,skipped=find_latest_good('/tmp/rsmoke/ck_async'); assert p is not None and p.name=='step-00000008.npz' and skipped==[], 'discovery saw a torn/unverified snapshot: %r %r' % (p, skipped); print('async kill window: only fully-verifying snapshots discoverable (latest %s)' % p.name)"; \
+	  $(CPU_MESH) python train.py --data-dir /tmp/rsmoke/data --epochs 2 \
+	      --global-batch-size 32 --no-eval --dp 2 --mubatches 2 \
+	      --checkpoint-dir /tmp/rsmoke/ck_async --checkpoint-every-steps 4 \
+	      --async-checkpoint --resume auto \
+	      --metrics-out /tmp/rsmoke/async.resumed.jsonl \
+	      > /tmp/rsmoke/async.resumed.out; \
+	  twin_h=$$(grep -o 'final model hash: [0-9a-f]*' /tmp/rsmoke/dp2.twin.out); \
+	  res_h=$$(grep -o 'final model hash: [0-9a-f]*' /tmp/rsmoke/async.resumed.out); \
+	  test -n "$$twin_h" && test "$$twin_h" = "$$res_h" \
+	      || { echo "async: HASH MISMATCH resumed [$$res_h] vs twin [$$twin_h]"; exit 1; }; \
+	  echo "async: SIGKILL-mid-save + resume auto == uninterrupted twin hash"; \
+	  cat /tmp/rsmoke/async.killed.jsonl /tmp/rsmoke/async.resumed.jsonl \
+	      > /tmp/rsmoke/async.combined.jsonl; \
+	  python -m shallowspeed_tpu.observability.report \
+	      /tmp/rsmoke/async.combined.jsonl --format md \
+	      > /tmp/rsmoke/async.report.md; \
+	  grep -q "async checkpointing: " /tmp/rsmoke/async.report.md; \
+	  grep -q "recovery: resumed from" /tmp/rsmoke/async.report.md
+	@echo "recovery-smoke OK: kill-at-step-11 + resume auto is bitwise identical to the uninterrupted twin on dp2 and gpipe-pp4 (plus SIGKILL-mid-async-save), Reliability section rendered"
+
+# AOT executable cache end-to-end (docs/performance.md): cold-compile a
+# dp2 rung ladder into the cache, RESTART the process and assert every
+# rung is a cache hit re-verified by the audit census with ZERO jit
+# compiles (pinned by the counter) and bitwise-equal predictions, then
+# corrupt one cache entry on disk and assert a clean fallback-to-recompile
+# with a recorded aot_cache corrupt event + a rewrite. Exit 0.
+aot-smoke:
+	rm -rf /tmp/aotsmoke; mkdir -p /tmp/aotsmoke
+	python -c "import numpy as np; from pathlib import Path; d=Path('/tmp/aotsmoke/data'); d.mkdir(parents=True); rng=np.random.RandomState(0); [(np.save(d/('x_'+s+'.npy'), rng.rand(n,784).astype(np.float32)), np.save(d/('y_'+s+'.npy'), np.eye(10,dtype=np.float32)[rng.randint(0,10,n)])) for s,n in (('train',256),('val',96))]"
+	$(CPU_MESH) python scripts/aot_smoke.py --phase cold \
+	    --cache-dir /tmp/aotsmoke/aot --data-dir /tmp/aotsmoke/data \
+	    --ref /tmp/aotsmoke/ref.npz --metrics-out /tmp/aotsmoke/cold.jsonl
+	$(CPU_MESH) python scripts/aot_smoke.py --phase warm \
+	    --cache-dir /tmp/aotsmoke/aot --data-dir /tmp/aotsmoke/data \
+	    --ref /tmp/aotsmoke/ref.npz --metrics-out /tmp/aotsmoke/warm.jsonl
+	python -c "import sys; sys.path.insert(0, '.'); from pathlib import Path; from shallowspeed_tpu import faults; entries=sorted(Path('/tmp/aotsmoke/aot').glob('*.aotx')); assert entries, 'no cache entries on disk'; faults.corrupt_checkpoint_bytes(entries[0], seed=5); print('corrupted %s' % entries[0].name)"
+	$(CPU_MESH) python scripts/aot_smoke.py --phase corrupt \
+	    --cache-dir /tmp/aotsmoke/aot --data-dir /tmp/aotsmoke/data \
+	    --ref /tmp/aotsmoke/ref.npz --metrics-out /tmp/aotsmoke/corrupt.jsonl
+	python -m shallowspeed_tpu.observability.report /tmp/aotsmoke/warm.jsonl \
+	    --format md > /tmp/aotsmoke/warm.report.md
+	grep -q "aot executable cache: " /tmp/aotsmoke/warm.report.md
+	@echo "aot-smoke OK: restarted process warmed the ladder from cache with zero recompiles, every deserialized program re-audited, corrupt entry fell back to a clean recompile + rewrite"
 
 # inference serving end-to-end (docs/serving.md): on a CPU dp2 and a
 # gpipe-pp4 layout, drive 200 seeded Poisson requests through the serving
@@ -310,10 +368,11 @@ fleet-smoke:
 	    --data-dir /tmp/fleet/data --global-batch-size 32 \
 	    --checkpoint /tmp/fleet/ck/step-00000008.npz \
 	    --reload-dir /tmp/fleet/ck --kill-after 15 \
+	    --aot-cache /tmp/fleet/aot \
 	    --requests 120 --rates 300 --slo-ms 2000 --seed 0 \
 	    --fleet-out /tmp/fleet/FLEET_CHAOS.json \
 	    --metrics-out /tmp/fleet/fleet.jsonl
-	python -c "import json,sys; rec=json.load(open('/tmp/fleet/FLEET_CHAOS.json')); assert rec['bench']=='serving_fleet_chaos'; assert rec['silently_lost']==[], 'LOST '+str(rec['silently_lost']); assert rec['parity_mismatches']==0, 'parity mismatches'; assert rec['killed_replica'] is not None and rec['replicas_dead']>=1, 'SIGKILL never fired'; assert rec['failovers']>=1 or rec['killed_inflight']==0, 'kill destroyed in-flight work but no failover ran'; assert rec['scale_ups']==1 and rec['scale_up_s'] is not None, 'no measured scale-up'; assert rec['recovery_s'] is not None, 'no measured recovery'; assert not rec['degraded_at_exit'], 'fleet degraded at exit'; v=rec['verdicts']; assert v.get('ok',0)>0, 'nothing served'; print('fleet chaos: %d submitted, verdicts %s, availability %.1f%%, kill stall %.1f ms, replacement ready in %.2f s' % (rec['submitted'], v, 100*rec['availability'], 1e3*rec['kill_stall_s'], rec['scale_up_s']))"
+	python -c "import json,sys; rec=json.load(open('/tmp/fleet/FLEET_CHAOS.json')); assert rec['bench']=='serving_fleet_chaos'; assert rec['silently_lost']==[], 'LOST '+str(rec['silently_lost']); assert rec['parity_mismatches']==0, 'parity mismatches'; assert rec['killed_replica'] is not None and rec['replicas_dead']>=1, 'SIGKILL never fired'; assert rec['failovers']>=1 or rec['killed_inflight']==0, 'kill destroyed in-flight work but no failover ran'; assert rec['scale_ups']==1 and rec['scale_up_s'] is not None, 'no measured scale-up'; assert rec['initial_ready_s_mean'] is not None, 'no cold ready baseline'; assert rec['recovery_s'] is not None, 'no measured recovery'; assert not rec['degraded_at_exit'], 'fleet degraded at exit'; v=rec['verdicts']; assert v.get('ok',0)>0, 'nothing served'; print('fleet chaos: %d submitted, verdicts %s, availability %.1f%%, kill stall %.1f ms, cache-warm replacement ready in %.2f s (initial cache-writing replicas: %.2f s mean)' % (rec['submitted'], v, 100*rec['availability'], 1e3*rec['kill_stall_s'], rec['scale_up_s'], rec['initial_ready_s_mean']))"
 	ls /tmp/fleet/fleet.jsonl.r0 /tmp/fleet/fleet.jsonl.r1 \
 	    /tmp/fleet/fleet.jsonl.r2 > /dev/null
 	python -m shallowspeed_tpu.observability.report '/tmp/fleet/fleet.jsonl*' \
@@ -351,6 +410,12 @@ bench:
 
 bench-scaling:
 	$(CPU_MESH) python scripts/bench_scaling.py
+
+# the two production-path-stall scoreboards (PR 12): step-time checkpoint
+# overhead sync vs async (same-window interleaved legs), and fleet
+# scale_up_s cold vs aot-cache-warm — writes CKPT_AOT_r01.json
+bench-ckpt-aot:
+	$(CPU_MESH) python scripts/bench_ckpt_aot.py
 
 bench-matrix:
 	python scripts/bench_tpu_matrix.py

@@ -1,0 +1,157 @@
+"""aot-smoke driver: prove the AOT executable cache across REAL process
+restarts (`make aot-smoke`).
+
+Three phases, each a fresh interpreter so the jit call cache genuinely
+dies between them (the in-suite tests cover the same machinery
+in-process; this is the cross-process leg):
+
+  cold     build a dp2 session over an empty cache dir, warm the whole
+           rung ladder (every ladder rung compiled + stored), record the
+           predictions and the jit-compile count;
+  warm     RESTARTED process, same cache dir: every rung must come back
+           as a cache hit with ZERO jit compiles (pinned by the counter),
+           every deserialized program re-verified by the audit census
+           before first dispatch (pinned by the xla_audit records), and
+           the predictions bitwise-equal to the cold phase's;
+  corrupt  one cache entry is corrupted on disk by the Makefile between
+           phases; the run must fall back to exactly one clean recompile
+           with a recorded `aot_cache` corrupt event, rewrite the entry,
+           and still serve bitwise-equal predictions — exit 0.
+
+Usage:
+  python scripts/aot_smoke.py --phase cold|warm|corrupt
+      --cache-dir D --data-dir DD --ref R.npz --metrics-out M.jsonl
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+LADDER = (1, 2, 4)
+DP = 2
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--phase", choices=["cold", "warm", "corrupt"],
+                    required=True)
+    ap.add_argument("--cache-dir", required=True)
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--ref", required=True,
+                    help="npz of reference predictions (written by cold, "
+                    "compared bitwise by warm/corrupt)")
+    ap.add_argument("--metrics-out", default=None)
+    args = ap.parse_args(argv)
+
+    from shallowspeed_tpu.api import TrainingSession
+    from shallowspeed_tpu.observability import JsonlMetrics, read_jsonl
+
+    metrics = JsonlMetrics(args.metrics_out) if args.metrics_out else None
+    session = TrainingSession(
+        dp=DP,
+        global_batch_size=32,
+        mubatches=2,
+        data_dir=args.data_dir,
+        metrics=metrics,
+        aot_cache_dir=args.cache_dir,
+        predict_slot_ladder=LADDER,
+    )
+    if not session._aot.supported:
+        # the documented degrade-to-no-op: a backend that cannot
+        # serialize must not fail the smoke — it must RECORD why.
+        # (reading .supported runs the import-level probe, so a jax
+        # build without serialize_executable lands here up front)
+        print(
+            f"aot-smoke: backend cannot serialize "
+            f"({session._aot.disabled_reason}) — cache is a recorded no-op"
+        )
+        return 0
+    rng = np.random.RandomState(7)
+    rows = LADDER[-1] * session.slot_rows
+    X = rng.rand(rows, session.spec.sizes[0]).astype(np.float32)
+    # warm the whole ladder: one dispatch per rung (smallest to largest
+    # row counts walk every rung program)
+    preds = {}
+    for rung in LADDER:
+        n = rung * session.slot_rows
+        preds[f"r{rung}"] = session.predict(X[:n])
+    stats = session._aot.stats()
+    compiles = int(
+        getattr(session._metrics, "counters", {}).get("jit_compiles", 0)
+    )
+    print(f"phase {args.phase}: jit_compiles={compiles}, aot={stats}")
+    if metrics is not None:
+        metrics.close()
+    if stats["disabled"]:
+        # serialize-time disable (import probe passed, the executable
+        # kind itself cannot serialize): still the documented no-op exit
+        print(
+            f"aot-smoke: backend disabled the cache mid-run "
+            f"({stats['disabled_reason']}) — recorded no-op"
+        )
+        return 0
+
+    n_programs = len(LADDER)
+    fail = []
+    if args.phase == "cold":
+        np.savez(args.ref, **preds)
+        if stats["store"] < n_programs:
+            fail.append(
+                f"expected >= {n_programs} stores, got {stats['store']}"
+            )
+        if compiles < n_programs:
+            fail.append(f"cold phase compiled only {compiles}")
+    else:
+        ref = np.load(args.ref)
+        for k, v in preds.items():
+            if not np.array_equal(ref[k], v):
+                fail.append(f"prediction {k} differs from the cold phase")
+        if args.phase == "warm":
+            if compiles != 0:
+                fail.append(
+                    f"warm start recompiled ({compiles} jit compiles)"
+                )
+            if stats["hit"] < n_programs or stats["miss"]:
+                fail.append(f"expected {n_programs} pure hits, got {stats}")
+        else:  # corrupt
+            if stats["corrupt"] != 1:
+                fail.append(f"expected 1 corrupt event, got {stats}")
+            if compiles != 1:
+                fail.append(
+                    f"expected exactly 1 fallback recompile, got {compiles}"
+                )
+            if stats["store"] != 1:
+                fail.append("the corrupted entry was not rewritten")
+        # never serve an unaudited program: every deserialized rung must
+        # carry a clean census in the metrics stream
+        if args.metrics_out:
+            recs = read_jsonl(args.metrics_out)
+            audits = [
+                r for r in recs
+                if r.get("kind") == "xla_audit"
+                and r.get("name") == "inference_program"
+            ]
+            hits = sum(
+                1 for r in recs
+                if r.get("kind") == "aot_cache" and r.get("name") == "hit"
+            )
+            if len(audits) < n_programs:
+                fail.append(
+                    f"{len(audits)} audit records for {n_programs} programs"
+                )
+            if not all(r.get("census_ok") for r in audits):
+                fail.append("a deserialized program failed its census")
+            if args.phase == "warm" and hits < n_programs:
+                fail.append(f"only {hits} recorded hits in the JSONL")
+    if fail:
+        print("aot-smoke FAILED: " + "; ".join(fail), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,233 @@
+"""The two production-path-stall scoreboards (ROADMAP item 5, PR 12):
+
+1. **Checkpoint overhead fraction, sync vs async** — the same dp2
+   training run checkpointing every step, measured as checkpoint wall /
+   (checkpoint + train-dispatch wall): the report Reliability section's
+   exact formula, with the async leg charging only the ON-PATH cost
+   (device->host snapshot + bounded-queue enqueue). Trials interleave
+   sync/async so the pair is same-window (the BENCH_r0x protocol), and
+   the async leg drains its writer before the clock stops — nothing
+   off-path is hidden outside the window.
+
+2. **Fleet `scale_up_s`, cold vs cache-warm** — a real 1-replica
+   ``ServingFleet`` (spawned worker process, own JAX runtime, ladder
+   warmed before ready). The no-cache fleet's replacement recompiles the
+   ladder (cold); the aot-cache fleet's replacement deserializes what
+   the first replica compiled (warm). Both walls are the fleet's own
+   spawn-to-ready measurement — the same number `make fleet-smoke`
+   records.
+
+Writes the versioned record beside bench_scaling's (CKPT_AOT_r01.json
+at the repo root by default). CPU-fallback caveat applies as everywhere:
+on emulated devices these validate machinery and RELATIVE ratios, not
+chip performance.
+"""
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BENCH_VERSION = 1
+LADDER = (1, 2, 4, 8)
+
+
+def _make_data(d):
+    d.mkdir(parents=True, exist_ok=True)
+    rng = np.random.RandomState(0)
+    for suffix, n in (("train", 512), ("val", 96)):
+        np.save(d / f"x_{suffix}.npy", rng.rand(n, 784).astype(np.float32))
+        np.save(
+            d / f"y_{suffix}.npy",
+            np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)],
+        )
+
+
+CKPT_SIZES = (784, 512, 512, 512, 256, 10)  # ~1M params: the regime where
+# verification (sha256 over every byte) + the zip write dominate a save —
+# the flagship MLP is so small that the device->host snapshot (which MUST
+# stay on-path for consistency) hides the off-path win
+
+
+def _ckpt_leg(data_dir, work, async_, steps, trial):
+    """One checkpoint-overhead leg: train `steps` steps checkpointing
+    every step; returns (ckpt_on_path_wall, train_wall)."""
+    from shallowspeed_tpu.api import TrainingSession
+
+    ck = work / f"ck_{'async' if async_ else 'sync'}_{trial}"
+    run = TrainingSession(
+        sizes=CKPT_SIZES, dp=2, global_batch_size=32, mubatches=2,
+        data_dir=data_dir, checkpoint_dir=ck, async_checkpoint=async_,
+        optimizer="momentum",  # optimizer state doubles the saved bytes
+    )
+    run.train_steps(1)  # compile outside the measured window
+    ckpt_wall = 0.0
+    train_wall = 0.0
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        run.train_steps(1)
+        train_wall += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run.save_step_checkpoint()
+        ckpt_wall += time.perf_counter() - t0
+    # drain INSIDE the async leg's accounting window: the off-path work
+    # must finish before the leg's clock stops, or the comparison would
+    # credit async with work it merely deferred past the measurement
+    t0 = time.perf_counter()
+    run.close()
+    drain_wall = time.perf_counter() - t0
+    shutil.rmtree(ck, ignore_errors=True)
+    return ckpt_wall, train_wall, drain_wall
+
+
+def bench_checkpoint_overhead(data_dir, work, steps=16, trials=3):
+    legs = {"sync": [], "async": []}
+    # interleave the pair per trial: same-window ratios
+    for trial in range(trials):
+        for name, async_ in (("sync", False), ("async", True)):
+            legs[name].append(_ckpt_leg(data_dir, work, async_, steps, trial))
+    out = {}
+    for name, rows in legs.items():
+        ck = min(r[0] for r in rows)  # per-leg minima, like the bench
+        tr = min(r[1] for r in rows)
+        out[name] = {
+            "checkpoint_wall_s": ck,
+            "train_wall_s": tr,
+            "drain_wall_s": min(r[2] for r in rows),
+            "overhead_fraction": ck / (ck + tr) if (ck + tr) > 0 else None,
+            "per_save_ms": 1e3 * ck / steps,
+            "trials": [
+                {"checkpoint_wall_s": a, "train_wall_s": b, "drain_wall_s": c}
+                for a, b, c in rows
+            ],
+        }
+    sync_f, async_f = (
+        out["sync"]["overhead_fraction"], out["async"]["overhead_fraction"]
+    )
+    out["steps"] = steps
+    out["overhead_ratio_async_vs_sync"] = (
+        async_f / sync_f if sync_f else None
+    )
+    return out
+
+
+def bench_fleet_scale_up(data_dir, work):
+    """Cold vs cache-warm replacement: two 1-replica fleets, each scaled
+    up once; the replacement's spawn-to-ready wall is the scoreboard."""
+    from shallowspeed_tpu.serving.fleet import (
+        ServingFleet,
+        fleet_workers_supported,
+    )
+
+    if not fleet_workers_supported():
+        return {"skipped": "platform cannot spawn fleet worker processes"}
+    out = {}
+    for name, cache in (("cold", None), ("aot_warm", work / "aot")):
+        # pp2 rung programs: pipeline-step compiles are the expensive
+        # ladder (seconds each on CPU XLA) — the shape where a serving
+        # replica's cold start is genuinely seconds-of-XLA
+        session = dict(
+            pp=2, schedule="gpipe", global_batch_size=32, mubatches=2,
+            data_dir=str(data_dir),
+            predict_slot_ladder=LADDER,
+        )
+        if cache is not None:
+            session["aot_cache_dir"] = str(cache)
+        fleet = ServingFleet({"session": session}, n_replicas=1)
+        try:
+            t0 = time.perf_counter()
+            fleet.start()  # first replica: compiles (and writes the cache)
+            first_ready = time.perf_counter() - t0
+            fleet.scale_up(wait_ready=True)
+            stats = fleet.stats()
+            walls = [
+                r.get("ready_wall_s")
+                for r in stats["per_replica"].values()
+                if r.get("ready_wall_s") is not None
+            ]
+            out[name] = {
+                "first_replica_ready_s": first_ready,
+                "scale_up_s": stats["scale_up_s"],
+                "ready_walls_s": walls,
+            }
+        finally:
+            fleet.stop()
+    if "cold" in out and "aot_warm" in out:
+        cold, warm = out["cold"]["scale_up_s"], out["aot_warm"]["scale_up_s"]
+        out["scale_up_speedup"] = (
+            cold / warm if cold is not None and warm else None
+        )
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None,
+                    help="record path (default: CKPT_AOT_r01.json at the "
+                    "repo root)")
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--skip-fleet", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    work = Path(tempfile.mkdtemp(prefix="bench_ckpt_aot_"))
+    data_dir = work / "data"
+    _make_data(data_dir)
+    record = {
+        "bench": "ckpt_aot",
+        "bench_version": BENCH_VERSION,
+        "created": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "platform": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "cpu_fallback_caveat": (
+            "emulated CPU devices: machinery + relative ratios, not chip "
+            "performance"
+        ),
+        "protocol": (
+            "same-window: sync/async legs interleaved per trial, per-leg "
+            "minima; async leg drains its writer inside the window; fleet "
+            "walls are the fleet's own spawn-to-ready measurement"
+        ),
+        "checkpoint_overhead": bench_checkpoint_overhead(
+            data_dir, work, steps=args.steps, trials=args.trials
+        ),
+    }
+    if not args.skip_fleet:
+        record["fleet_scale_up"] = bench_fleet_scale_up(data_dir, work)
+    out = Path(
+        args.out
+        if args.out
+        else Path(__file__).resolve().parent.parent / "CKPT_AOT_r01.json"
+    )
+    out.write_text(json.dumps(record, indent=2) + "\n")
+    co = record["checkpoint_overhead"]
+    print(f"record written: {out}")
+    print(
+        "checkpoint overhead: sync "
+        f"{co['sync']['overhead_fraction'] * 100:.1f}% -> async "
+        f"{co['async']['overhead_fraction'] * 100:.1f}% "
+        f"({co['sync']['per_save_ms']:.1f} -> "
+        f"{co['async']['per_save_ms']:.1f} ms/save on-path)"
+    )
+    fs = record.get("fleet_scale_up", {})
+    if fs.get("scale_up_speedup") is not None:
+        print(
+            f"fleet scale_up_s: cold {fs['cold']['scale_up_s']:.2f}s -> "
+            f"cache-warm {fs['aot_warm']['scale_up_s']:.2f}s "
+            f"({fs['scale_up_speedup']:.1f}x)"
+        )
+    shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,324 @@
+"""AOT executable cache: cold starts deserialize instead of recompiling.
+
+Every cold start — a serving replica, a fleet ``scale_up()`` replacement,
+a resumed trainer — used to recompile its whole rung ladder from scratch,
+so the fleet's measured ``scale_up_s`` was seconds-of-XLA instead of
+milliseconds-of-deserialize (ROADMAP item 5a). This module is the cache
+that removes that stall: compiled executables are serialized via
+``jax.experimental.serialize_executable`` (the ``jax.stages`` export
+surface) into an on-disk store this repo OWNS, and the compile sites
+(``TrainingSession._inference_step``, the sequential slot-predict
+program, the epoch audit probe — api.py) try it before ``.compile()``.
+
+Design constraints, in contract order:
+
+- **never serve an unaudited program**: a deserialized executable is
+  re-verified by the existing audit-at-compile census
+  (``program_audit.audit_compiled`` against the layout's forward-only
+  contract) BEFORE its first dispatch — the caller (api.py) runs the
+  audit and treats a mismatch like corruption: fall back to a clean
+  recompile, record the cause;
+- **never crash on a bad entry**: corruption, a stale backend
+  fingerprint, a format-version bump, a deserialize failure — every one
+  degrades to a recompile + rewrite with an ``aot_cache`` record naming
+  the cause (``corrupt``/``stale``/``miss``/``fallback``), never an
+  exception into the serving path;
+- **own on-disk format, own write discipline**: one file per entry
+  (``<key>.aotx``: magic + JSON header + pickled payload, the payload's
+  sha256 in the header), written mkstemp -> fsync -> atomic rename —
+  the checkpoint writer's discipline, so a killed process never leaves
+  a torn rename-visible entry;
+- **no jax global cache involvement**: this deliberately does NOT touch
+  ``jax_compilation_cache_dir`` — the jax-0.4.x persistent cache
+  corrupts the CPU client's heap once cached pipeline programs and
+  donated sequential steps mix in one process (the PR 1 segfault gate,
+  tests/conftest.py). The hazard class is avoided structurally: this
+  cache only ever DISPATCHES forward inference programs (which donate
+  nothing), and the one training program it touches (the epoch audit
+  probe) is census-read only, never dispatched;
+- **degrade to no-op, with a recorded reason**, on backends whose
+  executables cannot serialize (``disabled`` event; ``supported``
+  property) — the feature must never make a backend unusable.
+
+Cache key = sha256 over (program label, layout tuple, rung geometry,
+backend fingerprint, program CONTENT hash). The content hash covers the
+lowered StableHLO text, so any change to the traced program — a source
+edit, a flag flip, a shape change — changes the key and the stale entry
+is simply never looked up again (and a fingerprint check inside the file
+catches jaxlib/backend upgrades for keys that would otherwise collide
+across versions).
+"""
+
+import hashlib
+import json
+import pickle
+import struct
+import time
+from pathlib import Path
+
+from shallowspeed_tpu.checkpoint import atomic_write
+from shallowspeed_tpu.observability import NullMetrics
+
+MAGIC = b"SSAOT1\n"
+CACHE_FORMAT_VERSION = 1
+_HEADER_LEN = struct.Struct(">I")
+
+
+def backend_fingerprint(platform=None):
+    """The (jax, jaxlib, backend platform/version) tuple a serialized
+    executable is only valid under — XLA gives no ABI stability across
+    versions, so a mismatch is ``stale``, never an attempted load."""
+    import jax
+
+    fp = {
+        "jax": jax.__version__,
+        "format": CACHE_FORMAT_VERSION,
+    }
+    try:
+        import jaxlib
+
+        fp["jaxlib"] = jaxlib.__version__
+    except Exception:  # noqa: BLE001 — version probe only
+        fp["jaxlib"] = None
+    try:
+        if platform is None:
+            platform = jax.devices()[0].platform
+        fp["platform"] = platform
+        from jax.extend.backend import get_backend
+
+        fp["platform_version"] = get_backend(platform).platform_version
+    except Exception:  # noqa: BLE001 — fingerprint stays usable without it
+        fp.setdefault("platform", platform)
+        fp["platform_version"] = None
+    return fp
+
+
+def content_hash(lowered_text):
+    """sha256 of the lowered (StableHLO) program text — the 'what program
+    is this' half of the cache key. Tracing+lowering is milliseconds; the
+    XLA compile behind it is the seconds this cache removes."""
+    return hashlib.sha256(lowered_text.encode()).hexdigest()
+
+
+def cache_key(program, layout, fingerprint, program_hash):
+    """One stable hex key per (program label, layout tuple, backend
+    fingerprint, program content hash) — the filename stem."""
+    blob = json.dumps(
+        {
+            "program": program,
+            "layout": list(layout),
+            "fingerprint": fingerprint,
+            "content": program_hash,
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+class AotCache:
+    """The on-disk executable store (module docstring).
+
+    ``load``/``store`` never raise on cache-side failures: every outcome
+    is recorded (an ``aot_cache`` metrics record + the ``counts`` dict)
+    and a failed load returns ``None`` — the caller recompiles. The
+    serializer probe is lazy: the first ``store`` on a backend whose
+    executables cannot serialize flips the cache into a recorded
+    no-op (``disabled_reason``)."""
+
+    def __init__(self, cache_dir, metrics=None):
+        self.dir = Path(cache_dir)
+        self._metrics = metrics if metrics is not None else NullMetrics()
+        self._fingerprint = None  # lazy: jax backend may not be up yet
+        self.counts = {
+            "hit": 0, "miss": 0, "store": 0, "stale": 0, "corrupt": 0,
+            "audit_mismatch": 0, "fallback": 0, "disabled": 0,
+        }
+        self.disabled_reason = None
+
+    # -- plumbing ------------------------------------------------------------
+
+    def fingerprint(self):
+        if self._fingerprint is None:
+            self._fingerprint = backend_fingerprint()
+        return self._fingerprint
+
+    def key_for(self, program, layout, lowered_text):
+        return cache_key(
+            program, layout, self.fingerprint(), content_hash(lowered_text)
+        )
+
+    def entry_path(self, key):
+        return self.dir / f"{key}.aotx"
+
+    def record(self, event, program, key=None, wall_s=None, reason=None,
+               **fields):
+        self.counts[event] = self.counts.get(event, 0) + 1
+        rec = dict(program=program, **fields)
+        if key is not None:
+            rec["key"] = key
+        if wall_s is not None:
+            rec["wall_s"] = wall_s
+        if reason is not None:
+            rec["reason"] = reason
+        self._metrics.aot_cache(event, **rec)
+
+    def _serializer(self):
+        """The (serialize, deserialize_and_load) pair, or None with the
+        reason recorded — import failure IS the unsupported-backend
+        signal on jax builds without the experimental surface."""
+        try:
+            from jax.experimental import serialize_executable as se
+
+            return se.serialize, se.deserialize_and_load
+        except Exception as e:  # noqa: BLE001 — degrade, never crash
+            self._disable(f"serialize_executable unavailable: {e}")
+            return None
+
+    def _disable(self, reason):
+        if self.disabled_reason is None:
+            self.disabled_reason = str(reason)[:200]
+            self.record("disabled", program="*", reason=self.disabled_reason)
+
+    @property
+    def supported(self):
+        """False once the cache degraded to a recorded no-op. Reading it
+        runs the import-level serializer probe, so a jax build without
+        the experimental surface answers False BEFORE the first
+        store/load — callers can branch on it up front instead of
+        discovering the disable after a phase of silent no-ops. (A
+        serialize-time failure on an exotic executable kind still only
+        shows at the first ``store``.)"""
+        if self.disabled_reason is None:
+            self._serializer()
+        return self.disabled_reason is None
+
+    # -- the store -----------------------------------------------------------
+
+    def store(self, key, compiled, program="program"):
+        """Serialize ``compiled`` under ``key`` (mkstemp -> fsync ->
+        atomic rename). Returns the entry path, or None (recorded) when
+        the backend cannot serialize or the write failed."""
+        if self.disabled_reason is not None:
+            return None
+        ser = self._serializer()
+        if ser is None:
+            return None
+        serialize, _ = ser
+        t0 = time.perf_counter()
+        try:
+            payload, in_tree, out_tree = serialize(compiled)
+            blob = pickle.dumps((payload, in_tree, out_tree), protocol=4)
+        except Exception as e:  # noqa: BLE001 — unsupported executable kind
+            self._disable(f"{type(e).__name__}: {e}")
+            return None
+        header = json.dumps(
+            {
+                "v": CACHE_FORMAT_VERSION,
+                "key": key,
+                "program": program,
+                "fingerprint": self.fingerprint(),
+                "sha256": hashlib.sha256(blob).hexdigest(),
+                "created": time.strftime("%Y-%m-%d %H:%M:%S"),
+            }
+        ).encode()
+        path = self.entry_path(key)
+
+        def write_entry(f):
+            f.write(MAGIC)
+            f.write(_HEADER_LEN.pack(len(header)))
+            f.write(header)
+            f.write(blob)
+
+        try:
+            self.dir.mkdir(parents=True, exist_ok=True)
+            # the checkpoint module's ONE atomic-write sequence (mkstemp ->
+            # fsync(file) -> rename -> fsync(dir), temp removed on failure)
+            # — shared, not copied, so the disciplines cannot drift
+            atomic_write(path, write_entry, suffix=".aotx.tmp")
+        except OSError as e:
+            self.record(
+                "fallback", program=program, key=key,
+                reason=f"store failed: {e}"[:200],
+            )
+            return None
+        self.record(
+            "store", program=program, key=key,
+            wall_s=time.perf_counter() - t0, bytes=len(blob),
+        )
+        return path
+
+    def load(self, key, program="program"):
+        """Deserialize the entry under ``key``; returns the loaded
+        executable or None — with the outcome recorded as ``hit``,
+        ``miss`` (no entry), ``stale`` (fingerprint/format mismatch) or
+        ``corrupt`` (torn file, checksum mismatch, deserialize failure).
+        The caller still owes the audit census before first dispatch."""
+        if self.disabled_reason is not None:
+            return None
+        ser = self._serializer()
+        if ser is None:
+            return None
+        _, deserialize_and_load = ser
+        path = self.entry_path(key)
+        t0 = time.perf_counter()
+        try:
+            raw = path.read_bytes()
+        except FileNotFoundError:
+            self.record("miss", program=program, key=key)
+            return None
+        except OSError as e:
+            self.record(
+                "corrupt", program=program, key=key,
+                reason=f"unreadable: {e}"[:200],
+            )
+            return None
+        try:
+            if not raw.startswith(MAGIC):
+                raise ValueError("bad magic — not an aot cache entry")
+            off = len(MAGIC)
+            (hlen,) = _HEADER_LEN.unpack(raw[off : off + _HEADER_LEN.size])
+            off += _HEADER_LEN.size
+            header = json.loads(raw[off : off + hlen].decode())
+            blob = raw[off + hlen :]
+            if hashlib.sha256(blob).hexdigest() != header.get("sha256"):
+                raise ValueError("payload sha256 mismatch — torn or bit-rotted")
+        except Exception as e:  # noqa: BLE001 — any parse failure is corrupt
+            self.record(
+                "corrupt", program=program, key=key,
+                reason=f"{type(e).__name__}: {e}"[:200],
+            )
+            return None
+        if (
+            header.get("v") != CACHE_FORMAT_VERSION
+            or header.get("fingerprint") != self.fingerprint()
+        ):
+            self.record(
+                "stale", program=program, key=key,
+                reason="backend fingerprint / format version mismatch",
+            )
+            return None
+        try:
+            payload, in_tree, out_tree = pickle.loads(blob)
+            compiled = deserialize_and_load(payload, in_tree, out_tree)
+        except Exception as e:  # noqa: BLE001 — any load failure is corrupt
+            self.record(
+                "corrupt", program=program, key=key,
+                reason=f"deserialize failed: {type(e).__name__}: {e}"[:200],
+            )
+            return None
+        self.record(
+            "hit", program=program, key=key,
+            wall_s=time.perf_counter() - t0,
+        )
+        return compiled
+
+    def stats(self):
+        """The counts snapshot (+ hit rate over hit/miss lookups) — what
+        the report's Reliability AOT row and the smoke harness read."""
+        looked = self.counts["hit"] + self.counts["miss"]
+        return {
+            **self.counts,
+            "lookups": looked,
+            "hit_rate": (self.counts["hit"] / looked) if looked else None,
+            "disabled_reason": self.disabled_reason,
+        }

@@ -20,6 +20,7 @@ does, while tp=1 programs stay byte-identical to the pre-TP anchors).
 """
 
 import sys
+import threading
 import time
 
 import jax
@@ -33,10 +34,13 @@ from shallowspeed_tpu import model as Mo
 from shallowspeed_tpu import schedules as S
 from shallowspeed_tpu import trainer, utils
 from shallowspeed_tpu.checkpoint import (
+    AsyncCheckpointWriter,
     CheckpointError,
+    assemble_checkpoint,
+    build_snapshot,
     find_latest_good,
     load_checkpoint,
-    rotate_step_checkpoints,
+    run_save_stages,
     save_checkpoint,
     step_checkpoint_path,
 )
@@ -110,7 +114,10 @@ class TrainingSession:
         audit=False,
         checkpoint_dir=None,
         checkpoint_keep=3,
+        async_checkpoint=False,
+        checkpoint_queue=2,
         faults=None,
+        aot_cache_dir=None,
         predict_slot_rows=None,
         predict_slot_ladder=None,
     ):
@@ -272,6 +279,31 @@ class TrainingSession:
         # them without re-reading (their checksums were computed in-process)
         self._trusted_snapshots = set()
         self._faults = F.make_plan(faults)
+        # async checkpointing (docs/robustness.md "The async writer"):
+        # save_step_checkpoint(async_=True) — or async_checkpoint=True as
+        # the session default — keeps only the device->host snapshot on
+        # the step path and hands verify/write/fsync/rename/rotate to a
+        # single background writer behind a bounded queue. The writer is
+        # created lazily on the first async save; save_seq is the
+        # @save=N fault anchor, counted over EVERY save this process
+        # attempts (sync, async, halt flush) so a spec replays
+        # deterministically whichever mode is active.
+        if checkpoint_queue < 1:
+            raise ValueError("checkpoint_queue must be >= 1")
+        self._async_ckpt_default = bool(async_checkpoint)
+        self._ckpt_queue = int(checkpoint_queue)
+        self._ckpt_writer = None
+        self._save_seq = 0
+        # AOT executable cache (shallowspeed_tpu/aot_cache.py): compile
+        # sites try it before .compile(); deserialized programs are
+        # re-audited before first dispatch, every failure falls back to
+        # a clean recompile + rewrite
+        self._aot = None
+        if aot_cache_dir is not None:
+            from shallowspeed_tpu.aot_cache import AotCache
+
+            self._aot = AotCache(aot_cache_dir, metrics=self._metrics)
+        self._slot_predict = None  # sequential slot-shaped predict program
         self.resumed_from = None  # path of the restored snapshot, if any
         self._recovery = None  # the recovery record's fields, if resume ran
         # per-epoch aggregation across train_steps() chunks. steps_counted
@@ -346,17 +378,27 @@ class TrainingSession:
         }
 
         host_opt_state = None  # logical (per-stage ragged) saved state, if any
+        verified = None  # (meta, arrays) of the snapshot discovery verified
         if resume == "auto":
             # crash-recovery discovery: newest VERIFYING snapshot in the
             # checkpoint dir (corrupt/torn/non-finite ones are skipped with
             # their causes recorded); an empty/missing dir is a fresh start,
-            # a dir with snapshots where NONE verifies is unrecoverable
+            # a dir with snapshots where NONE verifies is unrecoverable.
+            # with_arrays: discovery's verified read IS the load's read —
+            # one read, one checksum pass, and the discovery->load TOCTOU
+            # window (the snapshot rotting or rotating away between the
+            # verify and a re-read) is closed by construction instead of
+            # by the re-verification `load` used to repeat
             if self._ckpt_dir is None:
                 raise ValueError(
                     "resume='auto' discovers snapshots in the step-checkpoint "
                     "directory — pass checkpoint_dir"
                 )
-            path, _, skipped = find_latest_good(self._ckpt_dir)
+            path, vmeta, varrays, skipped = find_latest_good(
+                self._ckpt_dir, with_arrays=True
+            )
+            if path is not None:
+                verified = (vmeta, varrays)
             skipped_fields = [
                 {"path": str(p), "cause": cause} for p, cause in skipped
             ]
@@ -386,9 +428,21 @@ class TrainingSession:
                     "skipped": skipped_fields,
                 }
         if resume is not None:
-            host_params, loaded_spec, meta, host_opt_state = load_checkpoint(
-                resume, n_model_stages, self.B, with_opt_state=True
-            )
+            if verified is not None:
+                # resume-auto: assemble from the arrays discovery already
+                # read and checksummed — `load` does not touch the file
+                host_params, loaded_spec, meta, host_opt_state = (
+                    assemble_checkpoint(
+                        resume, verified[0], verified[1], n_model_stages,
+                        self.B, with_opt_state=True,
+                    )
+                )
+            else:  # explicit path: one read+verify via the loader
+                host_params, loaded_spec, meta, host_opt_state = (
+                    load_checkpoint(
+                        resume, n_model_stages, self.B, with_opt_state=True
+                    )
+                )
             self.resumed_from = str(resume)
             if tuple(loaded_spec.sizes) != tuple(self.spec.sizes):
                 raise ValueError(
@@ -688,6 +742,67 @@ class TrainingSession:
             return (self._params, self._opt_state, self._Xe, self._Ye)
         return (self._stacked, self._flags, self._opt_state, self._X, self._Y)
 
+    def _aot_layout(self):
+        """The layout tuple half of the AOT cache key (the program CONTENT
+        hash over the lowered StableHLO does the real invalidation work;
+        this keeps distinct configurations from ever sharing a filename)."""
+        return (
+            tuple(self.spec.sizes), self.dp, self.pp, self.tp, self.V,
+            self.schedule, self.B, self.M, self._precision_name,
+            self._kernel_backend, self._slot_rows,
+        )
+
+    def _aot_resolve(self, program, audit_label, jit_fn, args, expected,
+                     dedup):
+        """Resolve one compiled program through the AOT executable cache
+        (shallowspeed_tpu/aot_cache.py): lower (milliseconds — tracing, no
+        XLA), key on (layout, backend fingerprint, lowered-program hash),
+        try the cache, and fall back to a clean ``.compile()`` + store on
+        any miss/stale/corrupt outcome.
+
+        The audit-at-compile contract survives the cache: a DESERIALIZED
+        program is censused against ``expected`` before this returns — it
+        can never reach a dispatch un-audited — and a census mismatch is
+        treated like corruption (recorded ``audit_mismatch`` + recompile),
+        because a bad cache entry is not a mislowered program; the
+        recompile re-audits under the normal strict rules. Returns
+        ``(compiled, from_cache)``; only a real compile bumps the
+        ``jit_compiles`` counter, which is how the zero-recompile warm
+        start is pinned."""
+        aot = self._aot
+        lowered = jit_fn.lower(*args)
+        key = aot.key_for(program, self._aot_layout(), lowered.as_text())
+        compiled = aot.load(key, program=program)
+        if compiled is not None:
+            rec = program_audit.audit_compiled(
+                compiled,
+                expected=expected,
+                platform=self._cost_model.platform,
+                n_devices=self._cost_model.n_devices,
+            )
+            if rec.get("census_ok") is False:
+                aot.record(
+                    "audit_mismatch", program=program, key=key,
+                    reason="; ".join(rec.get("mismatches", ()))[:200],
+                )
+                aot.record(
+                    "fallback", program=program, key=key,
+                    reason="audit_mismatch",
+                )
+                compiled = None
+            else:
+                if self._metrics.enabled:
+                    self._metrics.audit(audit_label, **rec)
+                self._audit_done.add(dedup)
+                return compiled, True
+        with self._metrics.span("jit_compile"):
+            compiled = lowered.compile()
+        self._metrics.counter("jit_compiles")
+        self._record_audit(compiled, audit_label, dedup=dedup,
+                           expected=expected)
+        aot.store(key, compiled, program=program)
+        return compiled, False
+
     def _ensure_epoch_compiled(self):
         """With metrics enabled, compile the epoch program once inside a
         ``jit_compile`` span (trace + lowering + XLA compile, timed as a
@@ -706,6 +821,22 @@ class TrainingSession:
         program audit needs the compiled object to verify the layout's
         collective contract before the first dispatch."""
         if self._epoch_compiled or not (self._metrics.enabled or self._audit_strict):
+            return
+        if self._aot is not None:
+            # the audit probe rides the AOT cache: a warm start deserializes
+            # the epoch program for its census + cost_analysis instead of
+            # paying the probe's XLA compile. The deserialized object is
+            # PROBE-ONLY — dispatch stays on the jit wrapper (which donates
+            # its buffers; executing a deserialized donating program is the
+            # jax-0.4.x hazard class this cache deliberately avoids)
+            compiled, _ = self._aot_resolve(
+                "epoch_probe", "epoch_program", self._epoch_fn,
+                self._epoch_args(), expected=self._expected_comms,
+                dedup="epoch_program",
+            )
+            self._cost_model.attach_compiled(compiled)
+            self._epoch_compiled = True
+            self._record_cost_model()
             return
         with self._metrics.span("jit_compile"):
             compiled = self._epoch_fn.lower(*self._epoch_args()).compile()
@@ -988,12 +1119,29 @@ class TrainingSession:
             raise
         return steps, epoch_loss
 
-    def save_step_checkpoint(self, reason="step", rotate=True):
+    def save_step_checkpoint(self, reason="step", rotate=True, async_=None):
         """Write the resumable snapshot at the current ``global_step`` into
         the session's checkpoint directory (``step-<global_step>.npz``:
         params + optimizer state + step cursor + content checksum), rotate
         retention down to ``checkpoint_keep``, and emit a schema-v4
         ``checkpoint`` record. Returns the written path.
+
+        ``async_`` (default: the session's ``async_checkpoint`` setting):
+        keep only stage 1 — the device->host snapshot — on the step path
+        and hand verification (sha256 + finiteness), the
+        write-fsync-rename sequence and rotation to the background writer
+        (``checkpoint.AsyncCheckpointWriter``), behind a bounded
+        ``checkpoint_queue``-deep in-flight window whose ``submit``
+        BLOCKS when full (backpressure — a snapshot is never silently
+        dropped, which would widen the replay window past the configured
+        cadence). The stage order — and therefore every crash window —
+        is byte-identical to the synchronous path (shared
+        ``run_save_stages``); the ``checkpoint`` record is emitted from
+        the writer on completion with ``async: true``, the queue depth
+        sampled at enqueue, and the off-path ``verify_s``/``write_s``
+        costs, while ``wall_s`` is the ON-PATH cost only. A writer-side
+        failure re-raises on this thread at the next save or
+        ``drain_checkpoints()``.
 
         Rotation is skipped when ``rotate=False`` (the halt flush opts out)
         AND whenever the snapshot just written is non-finite: once a run
@@ -1011,38 +1159,115 @@ class TrainingSession:
             raise ValueError(
                 "no checkpoint_dir configured on this session"
             )
+        if async_ is None:
+            async_ = self._async_ckpt_default
         gs = self.global_step
+        epoch, sie = self.epoch, self.step_in_epoch
         path = step_checkpoint_path(self._ckpt_dir, gs)
+        save_seq = self._save_seq
+        self._save_seq += 1
+        rotate_dir = self._ckpt_dir if rotate else None
         t0 = time.perf_counter()
-        nbytes, finite = save_checkpoint(
-            path,
+        arrays, meta = build_snapshot(
             self.params(),
             self.spec,
-            self.epoch,
+            epoch,
             extra={"optimizer": self._opt_config},
             opt_state=self.opt_state_logical(),
-            step_in_epoch=self.step_in_epoch,
+            step_in_epoch=sie,
             global_step=gs,
         )
-        if finite:
-            self._trusted_snapshots.add(str(path))
-        if rotate and finite:
-            rotate_step_checkpoints(
-                self._ckpt_dir, self._ckpt_keep,
-                trusted=self._trusted_snapshots,
+
+        def completion(result, on_path_wall, queue_depth=None):
+            # runs inline (sync) or on the writer thread (async): update
+            # the trusted set for rotation ranking, then emit the record.
+            # "trusted" (not "all_finite"): a corrupt-injected snapshot is
+            # finite in its metadata but can never verify — trusting it
+            # would let rotation rank garbage above real fallbacks
+            if result.get("trusted", result["all_finite"]):
+                self._trusted_snapshots.add(str(path))
+            if self._metrics.enabled:
+                fields = dict(
+                    path=str(path),
+                    epoch=epoch,
+                    step_in_epoch=sie,
+                    global_step=gs,
+                    bytes=result["bytes"],
+                    wall_s=on_path_wall,
+                    verify_s=result["verify_s"],
+                    write_s=result["write_s"],
+                )
+                if queue_depth is not None:
+                    fields["async"] = True
+                    fields["queue_depth"] = queue_depth
+                    fields["queued_s"] = result["queued_s"]
+                else:
+                    fields["async"] = False
+                self._metrics.checkpoint(reason, **fields)
+
+        # tuple(): an immutable point-in-time copy (a C-level, GIL-atomic
+        # snapshot of the set). The writer thread's completion callbacks
+        # keep adding to the live set while rotation — on EITHER thread —
+        # iterates its trusted collection with syscalls in between; handing
+        # rotation the live set would be a set-changed-during-iteration
+        # crash waiting for a mixed sync/async save to land it.
+        trusted_now = tuple(self._trusted_snapshots)
+        if not async_:
+            result = run_save_stages(
+                path, arrays, meta,
+                faults=self._faults, save_seq=save_seq,
+                rotate_dir=rotate_dir, rotate_keep=self._ckpt_keep,
+                trusted=trusted_now,
             )
-        wall = time.perf_counter() - t0
-        if self._metrics.enabled:
-            self._metrics.checkpoint(
-                reason,
-                path=str(path),
-                epoch=self.epoch,
-                step_in_epoch=self.step_in_epoch,
-                global_step=gs,
-                bytes=int(nbytes),
-                wall_s=wall,
+            completion(result, time.perf_counter() - t0)
+            return path
+        if self._ckpt_writer is None:
+            self._ckpt_writer = AsyncCheckpointWriter(
+                max_in_flight=self._ckpt_queue,
+                faults=self._faults,
             )
+        depth = self._ckpt_writer.queue_depth
+        # on-path wall = snapshot + enqueue (the enqueue blocks only when
+        # the bounded window is full — that stall IS the backpressure and
+        # is charged honestly to the step path). The tiny event handshake
+        # lets the writer-thread record carry the wall measured HERE,
+        # without racing the submit return.
+        wall_box = {}
+        measured = threading.Event()
+
+        def job_complete(result):
+            measured.wait(timeout=60)
+            completion(
+                result, wall_box.get("wall", 0.0), queue_depth=depth
+            )
+
+        self._ckpt_writer.submit(
+            path, arrays, meta, save_seq,
+            rotate_dir=rotate_dir, rotate_keep=self._ckpt_keep,
+            trusted=trusted_now, on_complete=job_complete,
+        )
+        wall_box["wall"] = time.perf_counter() - t0
+        measured.set()
         return path
+
+    def drain_checkpoints(self):
+        """Block until every async snapshot in flight is durable on disk
+        (rename + fsync complete); writer-side failures re-raise here.
+        No-op when nothing was ever saved asynchronously. ``close()``,
+        the halt flush and ``train.py``'s exit all run this, so no exit
+        path can leave a snapshot half-owned by a daemon thread."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.drain()
+
+    def close(self):
+        """Release the session's background resources: drain + stop the
+        async checkpoint writer (re-raising any writer failure) and flush
+        the metrics sink. Idempotent; the session remains usable for
+        dispatch afterwards (a later async save just restarts a writer)."""
+        if self._ckpt_writer is not None:
+            writer, self._ckpt_writer = self._ckpt_writer, None
+            writer.close()
+        self._metrics.flush()
 
     def _flush_halt_checkpoint(self):
         """The health monitor's halt policy flushes a snapshot BEFORE the
@@ -1050,11 +1275,22 @@ class TrainingSession:
         a finite finding (grad spike, divergence) is resumable from the
         halt step itself; a non-finite one writes an ``all_finite: false``
         snapshot that resume discovery SKIPS, landing on the last healthy
-        step instead. Best-effort — a failing flush never masks the halt."""
+        step instead. Best-effort — a failing flush never masks the halt.
+
+        Stays SYNCHRONOUS regardless of the session's async-checkpoint
+        setting: the process is about to unwind, so the flush must be
+        durable before the HealthError leaves this frame — a snapshot
+        parked in a daemon writer's queue would die with the process.
+        Any async saves already in flight are drained first (best-effort)
+        so the halt snapshot can never rename ahead of an older one."""
         if self._ckpt_dir is None:
             return
         try:
-            self.save_step_checkpoint(reason="halt", rotate=False)
+            self.drain_checkpoints()
+        except Exception as e:  # noqa: BLE001 — never mask the HealthError
+            print(f"halt checkpoint drain failed: {e}", file=sys.stderr)
+        try:
+            self.save_step_checkpoint(reason="halt", rotate=False, async_=False)
             self._metrics.flush()
         except Exception as e:  # noqa: BLE001 — never mask the HealthError
             print(f"halt checkpoint flush failed: {e}", file=sys.stderr)
@@ -1373,10 +1609,11 @@ class TrainingSession:
                 # exactly one program however many slots run, so the
                 # pure-padding rung tail would be wasted work
                 xb = np.pad(chunk, ((0, m * S_rows - chunk.shape[0]), (0, 0)))
+                slot_fn = self._slot_predict_fn()
                 preds = np.concatenate(
                     [
                         np.asarray(
-                            self._predict(
+                            slot_fn(
                                 self._params,
                                 jnp.asarray(xb[k * S_rows : (k + 1) * S_rows]),
                             )
@@ -1399,6 +1636,29 @@ class TrainingSession:
                 )
             outs.append(preds[: chunk.shape[0], :out_dim])
         return np.concatenate(outs, axis=0)
+
+    def _slot_predict_fn(self):
+        """The sequential path's slot-shaped predict program — the one
+        program ``predict()`` dispatches per occupied slot. Without an AOT
+        cache this is just the jit wrapper (today's exact path); with one,
+        the slot program rides the cache like the mesh rungs do, so a
+        sequential serving replica (the fleet's default worker shape)
+        cold-starts with zero compiles too — census-re-verified before
+        first dispatch, like every deserialized program."""
+        if self._slot_predict is None:
+            if self._aot is None:
+                self._slot_predict = self._predict
+            else:
+                x_shape = jax.ShapeDtypeStruct(
+                    (self._slot_rows, self.spec.sizes[0]), jnp.float32
+                )
+                self._slot_predict, _ = self._aot_resolve(
+                    "predict_seq", "inference_program", self._predict,
+                    (self._params, x_shape),
+                    expected=self._expected_comms,
+                    dedup=("inference", "seq"),
+                )
+        return self._slot_predict
 
     def _lower_inference_prog(self, mubatches=1):
         """The layout's inference TickProgram (interleaved-aware) — shared by
@@ -1430,7 +1690,13 @@ class TrainingSession:
                 self._slot_rows // self.dp, precision=self.precision,
                 kernel_backend=self._kernel_backend,
             )
-            if self._metrics.enabled or self._audit_strict:
+            need_audit = (
+                self._aot is not None
+                or self._metrics.enabled
+                or self._audit_strict
+            )
+            expected = None
+            if need_audit:
                 expected = program_audit.expected_comms(
                     self.spec,
                     self.dp,
@@ -1441,14 +1707,26 @@ class TrainingSession:
                     precision=self._precision_name,
                     tp=self.tp,
                 )
+            x_shape = jax.ShapeDtypeStruct(
+                (n_slots * self._slot_rows, self.spec.sizes[0]),
+                jnp.float32,
+            )
+            if self._aot is not None:
+                # the dispatch path itself becomes the resolved executable:
+                # a warm start deserializes every rung with ZERO compiles
+                # (inference programs donate nothing, so dispatching a
+                # deserialized one stays clear of the jax-0.4.x hazard),
+                # and the census re-verifies it before this cache entry
+                # can serve a request
+                step, _ = self._aot_resolve(
+                    f"inference_r{n_slots}", "inference_program", step,
+                    (self._stacked, self._flags, x_shape),
+                    expected=expected, dedup=("inference", n_slots),
+                )
+            elif self._metrics.enabled or self._audit_strict:
                 with self._metrics.span("jit_compile"):
                     compiled = step.lower(
-                        self._stacked,
-                        self._flags,
-                        jax.ShapeDtypeStruct(
-                            (n_slots * self._slot_rows, self.spec.sizes[0]),
-                            jnp.float32,
-                        ),
+                        self._stacked, self._flags, x_shape
                     ).compile()
                 self._metrics.counter("jit_compiles")
                 self._record_audit(
@@ -1519,7 +1797,7 @@ class TrainingSession:
         else:
             self._stacked = F.poison_nan(self._stacked)
 
-    def load_weights(self, path):
+    def load_weights(self, path, verified=None):
         """HOT-swap this session's weights from a checkpoint, between
         dispatches, WITHOUT touching the compiled program caches: the new
         arrays have the same shapes/shardings as the old (enforced — a
@@ -1534,10 +1812,23 @@ class TrainingSession:
         and metrics numbering are untouched — this is a serving-side swap,
         not a training resume (use ``resume=`` at construction for that).
         Returns the checkpoint's metadata dict. Unreadable / corrupt files
-        raise ``CheckpointError`` before any state changes."""
-        host_params, loaded_spec, meta = load_checkpoint(
-            path, self.pp * self.V, self.B
-        )
+        raise ``CheckpointError`` before any state changes.
+
+        ``verified=(meta, arrays)``: the pair a ``with_arrays=True``
+        discovery (``find_latest_good`` / ``find_newer_good``) already
+        read and checksummed — the swap then assembles from those arrays
+        instead of re-reading the file, so a reload is ONE verified read
+        and the discovery->load TOCTOU window (the serving engine's
+        watcher polls a directory a concurrent trainer keeps rotating)
+        is closed by construction."""
+        if verified is not None:
+            host_params, loaded_spec, meta = assemble_checkpoint(
+                path, verified[0], verified[1], self.pp * self.V, self.B
+            )
+        else:
+            host_params, loaded_spec, meta = load_checkpoint(
+                path, self.pp * self.V, self.B
+            )
         if tuple(loaded_spec.sizes) != tuple(self.spec.sizes):
             raise ValueError(
                 f"checkpoint sizes {loaded_spec.sizes} do not match this "

@@ -9,8 +9,9 @@ the API (``TrainingSession(faults=...)``) or the environment
 patching it).
 
 Spec grammar — comma-separated injections, each anchored to a TRAINING
-step (``kind@step=N[:mode=...]``) or a SERVING dispatch
-(``kind@dispatch=N[:mode=...][:ms=...]``)::
+step (``kind@step=N[:mode=...]``), a SERVING dispatch
+(``kind@dispatch=N[:mode=...][:ms=...]``), or a checkpoint SAVE
+(``kind@save=N[:mode=...][:ms=...]``)::
 
     SHALLOWSPEED_FAULTS="die@step=7:mode=sigkill"     # hard kill at step 7
     SHALLOWSPEED_FAULTS="die@step=7"                  # raise InjectedFault
@@ -19,12 +20,24 @@ step (``kind@step=N[:mode=...]``) or a SERVING dispatch
     SHALLOWSPEED_FAULTS="error@dispatch=4"            # raise INSIDE dispatch 4
     SHALLOWSPEED_FAULTS="slow@dispatch=6:ms=50"       # stall dispatch 6 50 ms
     SHALLOWSPEED_FAULTS="nan@dispatch=8"              # poison served weights
+    SHALLOWSPEED_FAULTS="die@save=2:mode=sigkill"     # kill INSIDE save 2's
+                                                      #   write-verify-rename
+                                                      #   window
+    SHALLOWSPEED_FAULTS="slow@save=1:ms=200"          # stall the writer in
+                                                      #   the same window
+    SHALLOWSPEED_FAULTS="corrupt@save=3"              # flip bytes in the
+                                                      #   in-flight buffer
 
 Steps are GLOBAL optimizer-step indices (epoch * batches_per_epoch +
 step_in_epoch — the same cursor the step checkpoints store). Dispatches
 are the serving engine's attempted-dispatch sequence numbers (every
 ``step()`` that has work counts one, failures included, so a chaos spec
-replays deterministically).
+replays deterministically). Saves are ``save_step_checkpoint``'s save
+sequence numbers (the Nth snapshot this process attempts, halt flushes
+included) — the anchor the async checkpoint writer consults, so the
+chaos harness can land a kill at a DETERMINISTIC point inside the
+write/verify/rename window (docs/robustness.md "The async writer's
+crash windows").
 
 Injection points (all driven from the host-side step/serving loop, never
 from inside a jitted program — an instrumented run executes the same XLA):
@@ -41,17 +54,27 @@ from inside a jitted program — an instrumented run executes the same XLA):
             (serving) come out NaN — the deterministic blow-up the
             numerics health monitor / the serving health gate exists to
             catch.
-- ``slow``  (dispatch only) sleep ``ms`` inside dispatch N — the latency
-            spike that drives deadline shedding.
+- ``slow``  (dispatch/save) sleep ``ms`` inside dispatch N — the latency
+            spike that drives deadline shedding — or inside save N's
+            write window (after the temp write, before the rename), so
+            an externally timed SIGKILL lands mid-save deterministically.
 - ``error`` (dispatch only) raise ``InjectedFault`` INSIDE the dispatch
             wrapper, after the batch was popped — the failure shape the
             engine's dispatch-recovery path (re-queue + bounded retry)
             exists to survive.
+- ``corrupt`` (save only) flip bytes in the IN-FLIGHT snapshot buffer
+            AFTER its checksum was stamped — the written file renames
+            into place but can never verify, exactly the bit-rot shape
+            ``find_latest_good`` must skip past. Save-anchored ``die``
+            fires INSIDE the writer's window: after the temp file is
+            written and fsynced, BEFORE the atomic rename — the kill
+            point the crash-safety contract says must leave only the
+            older fully-verifying snapshots discoverable.
 
-Checkpoint corruption is a function, not a step trigger (tests corrupt
-files directly): ``corrupt_checkpoint_bytes(path)`` flips bytes inside an
-existing checkpoint so its content checksum can no longer verify —
-deterministic given ``seed``.
+Checkpoint corruption of files AT REST stays a function, not a step
+trigger (tests corrupt files directly): ``corrupt_checkpoint_bytes(path)``
+flips bytes inside an existing checkpoint so its content checksum can no
+longer verify — deterministic given ``seed``.
 """
 
 import os
@@ -62,6 +85,7 @@ import numpy as np
 ENV_VAR = "SHALLOWSPEED_FAULTS"
 KINDS = ("die", "nan")  # step-triggered (training) kinds
 SERVING_KINDS = ("die", "nan", "slow", "error")  # dispatch-triggered kinds
+SAVE_KINDS = ("die", "slow", "corrupt")  # save-triggered (writer) kinds
 DIE_MODES = ("exc", "sigkill")
 
 
@@ -71,16 +95,20 @@ class InjectedFault(RuntimeError):
 
 
 class Fault:
-    """One parsed injection: ``kind`` at global ``step`` (+ ``mode``), or —
-    serving-side — at attempted-dispatch ``dispatch`` (+ ``ms`` for
-    ``slow``). Exactly one of ``step``/``dispatch`` is set; ``trigger``
-    names which ("step" / "dispatch")."""
+    """One parsed injection: ``kind`` at global ``step`` (+ ``mode``), at
+    attempted-dispatch ``dispatch`` (serving; + ``ms`` for ``slow``), or
+    at checkpoint-save sequence ``save`` (the writer anchor). Exactly one
+    of ``step``/``dispatch``/``save`` is set; ``trigger`` names which."""
 
-    __slots__ = ("kind", "step", "dispatch", "mode", "ms", "fired")
+    __slots__ = ("kind", "step", "dispatch", "save", "mode", "ms", "fired")
 
-    def __init__(self, kind, step=None, mode=None, dispatch=None, ms=None):
-        if (step is None) == (dispatch is None):
-            raise ValueError("a fault anchors to exactly one of step/dispatch")
+    def __init__(self, kind, step=None, mode=None, dispatch=None, ms=None,
+                 save=None):
+        anchors = [a for a in (step, dispatch, save) if a is not None]
+        if len(anchors) != 1:
+            raise ValueError(
+                "a fault anchors to exactly one of step/dispatch/save"
+            )
         if step is not None:
             if kind not in KINDS:
                 raise ValueError(
@@ -88,7 +116,7 @@ class Fault:
                 )
             if step < 0:
                 raise ValueError(f"fault step must be >= 0, got {step}")
-        else:
+        elif dispatch is not None:
             if kind not in SERVING_KINDS:
                 raise ValueError(
                     f"unknown dispatch-fault kind {kind!r} (have "
@@ -98,6 +126,13 @@ class Fault:
                 raise ValueError(
                     f"fault dispatch must be >= 0, got {dispatch}"
                 )
+        else:
+            if kind not in SAVE_KINDS:
+                raise ValueError(
+                    f"unknown save-fault kind {kind!r} (have {SAVE_KINDS})"
+                )
+            if save < 0:
+                raise ValueError(f"fault save must be >= 0, got {save}")
         if kind == "die":
             mode = mode or "exc"
             if mode not in DIE_MODES:
@@ -117,20 +152,19 @@ class Fault:
         self.kind = kind
         self.step = None if step is None else int(step)
         self.dispatch = None if dispatch is None else int(dispatch)
+        self.save = None if save is None else int(save)
         self.mode = mode
         self.ms = ms
         self.fired = False
 
     @property
     def trigger(self):
-        return "step" if self.step is not None else "dispatch"
+        if self.step is not None:
+            return "step"
+        return "dispatch" if self.dispatch is not None else "save"
 
     def __repr__(self):
-        at = (
-            f"step={self.step}"
-            if self.step is not None
-            else f"dispatch={self.dispatch}"
-        )
+        at = f"{self.trigger}={getattr(self, self.trigger)}"
         mode = f":mode={self.mode}" if self.kind == "die" else ""
         ms = f":ms={self.ms:g}" if self.kind == "slow" else ""
         return f"{self.kind}@{at}{mode}{ms}"
@@ -158,13 +192,17 @@ class FaultPlan:
                 )
                 step = fields.pop("step", None)
                 dispatch = fields.pop("dispatch", None)
-                if (step is None) == (dispatch is None):
-                    raise ValueError("need exactly one of step=/dispatch=")
+                save = fields.pop("save", None)
+                if sum(a is not None for a in (step, dispatch, save)) != 1:
+                    raise ValueError(
+                        "need exactly one of step=/dispatch=/save="
+                    )
                 faults.append(
                     Fault(
                         kind.strip(),
                         step=None if step is None else int(step),
                         dispatch=None if dispatch is None else int(dispatch),
+                        save=None if save is None else int(save),
                         mode=fields.pop("mode", None),
                         ms=fields.pop("ms", None),
                     )
@@ -193,6 +231,22 @@ class FaultPlan:
         return [
             f for f in self.faults if not f.fired and f.dispatch is not None
         ]
+
+    @property
+    def pending_save(self):
+        """Save-triggered (checkpoint-writer) injections not fired yet."""
+        return [
+            f for f in self.faults if not f.fired and f.save is not None
+        ]
+
+    def due_at_save(self, n):
+        """Un-fired save faults scheduled AT OR BEFORE save sequence ``n``,
+        in spec order — the checkpoint writer (sync path or the async
+        background thread) fires each exactly once. The <= anchor mirrors
+        ``due_at_dispatch``: a fault whose exact save never ran (e.g. the
+        run died first and resumed with a shorter grid) still fires on
+        the next save instead of silently never."""
+        return [f for f in self.pending_save if f.save <= n]
 
     def first_in(self, lo, hi):
         """Earliest un-fired STEP fault with ``lo <= step < hi``, or None —
@@ -256,6 +310,38 @@ def poison_nan(params):
     if not poisoned[0]:
         raise ValueError("no array leaf to poison in params")
     return out
+
+
+def corrupt_buffer(arrays, nbytes=4, seed=0):
+    """The ``corrupt@save=N`` injection body: flip ``nbytes`` bytes in the
+    first (name-sorted) array of an IN-FLIGHT snapshot buffer — in place,
+    AFTER the content checksum was stamped into the metadata, so the file
+    the writer renames into place can never verify. The on-disk mirror of
+    ``corrupt_checkpoint_bytes``, applied one stage earlier: it produces a
+    rename-visible file that ``find_latest_good`` must skip, which is
+    exactly the fallback path the chaos harness needs to exercise without
+    racing the writer. Deterministic given ``seed``; returns the flipped
+    byte offsets (within the chosen array) for test assertions."""
+    names = sorted(n for n in arrays if n != "meta")
+    if not names:
+        raise ValueError("no array to corrupt in the in-flight buffer")
+    target = arrays[names[0]]
+    # explicit writable copy: host snapshots come off jax.device_get as
+    # read-only views, and the corruption must land in the buffer the
+    # writer will serialize, not raise out of the injection
+    flat = np.array(target, copy=True).view(np.uint8).reshape(-1)
+    if flat.size == 0:
+        raise ValueError(f"array {names[0]!r} is empty — nothing to corrupt")
+    rng = np.random.RandomState(seed)
+    offsets = sorted(
+        int(o)
+        for o in rng.choice(flat.size, size=min(nbytes, flat.size),
+                            replace=False)
+    )
+    for off in offsets:
+        flat[off] ^= 0xFF
+    arrays[names[0]] = flat.view(target.dtype).reshape(target.shape)
+    return offsets
 
 
 def corrupt_checkpoint_bytes(path, nbytes=16, seed=0):

@@ -42,7 +42,9 @@ Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
      "census_ok": bool|null, **audit}                                [v3+]
     {"v": 4, "ts": ..., "kind": "checkpoint", "name": <reason>,
      "path": ..., "epoch": e, "step_in_epoch": s, "global_step": g,
-     "bytes": n, "wall_s": ...}                                      [v4+]
+     "bytes": n, "wall_s": ..., "async": bool [v8], "queue_depth": n
+     [v8], "verify_s": ... [v8], "write_s": ... [v8], "queued_s": ...
+     [v8]}                                                           [v4+]
     {"v": 4, "ts": ..., "kind": "recovery",  "name": <verdict>,
      "resumed_from": path|null, "epoch": e, "step_in_epoch": s,
      "global_step": g, "skipped": [...], **fields}                   [v4+]
@@ -74,6 +76,10 @@ Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
      "replica_retired"|"scale_up"|"scale_down"|"fleet_degraded"|
      "fleet_recovered"|"reload_broadcast">, "replica_id": r,
      **fields}                                                       [v7+]
+    {"v": 8, "ts": ..., "kind": "aot_cache", "name": <event: "hit"|
+     "miss"|"store"|"stale"|"corrupt"|"audit_mismatch"|"fallback"|
+     "disabled">, "program": ..., "key": ..., "wall_s": ...,
+     "reason": ..., **fields}                                        [v8+]
 
 Schema compatibility rules (SCHEMA_VERSION history):
 
@@ -130,6 +136,20 @@ Schema compatibility rules (SCHEMA_VERSION history):
   files unchanged and the strict refusal stays one-directional (a v8
   file is refused).
 
+- v8  ADDITIVE: the ``aot_cache`` kind (one ahead-of-time executable
+  cache decision, named by the event — ``hit``/``miss``/``store``/
+  ``stale``/``corrupt``/``audit_mismatch``/``fallback``/``disabled`` —
+  carrying the program label, cache key, wall time and the recorded
+  reason; shallowspeed_tpu/aot_cache.py), plus additive fields on the
+  EXISTING ``checkpoint`` kind for the async writer (``async``,
+  ``queue_depth`` at enqueue, off-path ``verify_s``/``write_s``/
+  ``queued_s`` — for async saves ``wall_s`` is the ON-PATH cost only:
+  snapshot + enqueue) and ``verify_s`` on the ``reload`` kind (the
+  discovery-verification time of the single-verified-read reload).
+  Lawful under the ignore-unknown-fields rule; no existing name/field
+  changed meaning. The v8 reader accepts v1-v7 files unchanged and the
+  strict refusal stays one-directional (a v9 file is refused).
+
 The contract for future bumps: additive kinds/fields bump the version and
 must keep old records readable; any change to an EXISTING kind's meaning
 requires a new kind name instead. Consumers must ignore unknown fields on
@@ -156,11 +176,12 @@ import glob as _glob
 import json
 import math
 import os
+import threading
 import time
 
 from shallowspeed_tpu.observability.spans import Span
 
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 SCHEMA_NAME = "shallowspeed_tpu.metrics"
 
 
@@ -236,6 +257,9 @@ class NullMetrics:
         pass
 
     def fleet_health(self, name, **fields):
+        pass
+
+    def aot_cache(self, name, **fields):
         pass
 
     def flush(self):
@@ -337,6 +361,9 @@ class MetricsRecorder:
 
     def fleet_health(self, name, **fields):
         self._emit({"kind": "fleet_health", "name": name, **fields})
+
+    def aot_cache(self, name, **fields):
+        self._emit({"kind": "aot_cache", "name": name, **fields})
 
     # -- recorder-internal hooks --------------------------------------------
 
@@ -452,6 +479,11 @@ class JsonlMetrics(MetricsRecorder):
         self.path = _shard_path(path)
         self._flush_every = max(1, int(flush_every))
         self._since_flush = 0
+        # one writer lock: the async checkpoint writer emits its completion
+        # records from the background thread, and two half-interleaved
+        # lines would break the one-JSON-object-per-line contract exactly
+        # on the crash-evidence records that matter most
+        self._write_lock = threading.Lock()
         self._f = open(self.path, mode, encoding="utf-8")
         self._emit(
             {
@@ -463,30 +495,31 @@ class JsonlMetrics(MetricsRecorder):
         )
 
     def _emit(self, record):
-        if self._f is None:
-            raise ValueError(f"JsonlMetrics({self.path!r}) is closed")
-        self._f.write(
-            json.dumps(
-                _json_safe({"v": SCHEMA_VERSION, "ts": time.time(), **record}),
-                allow_nan=False,  # enforced: every line is STRICT JSON
-            )
-            + "\n"
+        line = json.dumps(
+            _json_safe({"v": SCHEMA_VERSION, "ts": time.time(), **record}),
+            allow_nan=False,  # enforced: every line is STRICT JSON
         )
-        self._since_flush += 1
-        if self._since_flush >= self._flush_every:
-            self._f.flush()
-            self._since_flush = 0
+        with self._write_lock:
+            if self._f is None:
+                raise ValueError(f"JsonlMetrics({self.path!r}) is closed")
+            self._f.write(line + "\n")
+            self._since_flush += 1
+            if self._since_flush >= self._flush_every:
+                self._f.flush()
+                self._since_flush = 0
 
     def flush(self):
-        if self._f is not None:
-            self._f.flush()
-            self._since_flush = 0
+        with self._write_lock:
+            if self._f is not None:
+                self._f.flush()
+                self._since_flush = 0
 
     def close(self):
-        if self._f is not None:
-            self._f.flush()
-            self._f.close()
-            self._f = None
+        with self._write_lock:
+            if self._f is not None:
+                self._f.flush()
+                self._f.close()
+                self._f = None
 
     def __enter__(self):
         return self

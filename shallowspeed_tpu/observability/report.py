@@ -299,6 +299,7 @@ def _reliability_info(records, spans):
     landed on. Without that evidence the field stays None (rendered as
     unknown)."""
     ckpts = [r for r in records if r.get("kind") == "checkpoint"]
+    aot = _aot_cache_info(records)
     recoveries = []
     max_step_before = None
     last_step = None
@@ -308,9 +309,19 @@ def _reliability_info(records, spans):
         elif r.get("kind") == "recovery":
             recoveries.append(r)
             max_step_before = last_step
-    if not ckpts and not recoveries:
+    if not ckpts and not recoveries and aot is None:
         return None
+    # for async saves (schema v8) wall_s is the ON-PATH cost only — the
+    # snapshot + bounded-queue enqueue — so the overhead fraction below
+    # automatically becomes the async scoreboard: same formula, the
+    # off-path verify/write walls accounted separately
     ckpt_wall = sum(r["wall_s"] for r in ckpts if _finite(r.get("wall_s")))
+    async_ckpts = [r for r in ckpts if r.get("async")]
+    off_path_s = sum(
+        (r.get("verify_s") or 0.0) + (r.get("write_s") or 0.0)
+        for r in async_ckpts
+        if _finite(r.get("verify_s")) or _finite(r.get("write_s"))
+    )
     train_wall = sum(
         a["total_s"]
         for n, a in spans.items()
@@ -355,7 +366,39 @@ def _reliability_info(records, spans):
         "checkpoint_overhead_fraction": overhead,
         "checkpoint_cadence_steps": cadence,
         "last_checkpoint_bytes": ckpts[-1].get("bytes") if ckpts else None,
+        "checkpoints_async": len(async_ckpts),
+        "checkpoint_off_path_s": round(off_path_s, 4),
+        "aot_cache": aot,
         "recovery": recovery,
+    }
+
+
+def _aot_cache_info(records):
+    """Fold the schema-v8 ``aot_cache`` records into the hit/miss story;
+    None when the run recorded none (pre-v8 files render unchanged)."""
+    recs = [r for r in records if r.get("kind") == "aot_cache"]
+    if not recs:
+        return None
+    counts = {}
+    for r in recs:
+        counts[r.get("name")] = counts.get(r.get("name"), 0) + 1
+    lookups = counts.get("hit", 0) + counts.get("miss", 0)
+    hit_walls = [
+        r["wall_s"] for r in recs
+        if r.get("name") == "hit" and _finite(r.get("wall_s"))
+    ]
+    disabled = [r.get("reason") for r in recs if r.get("name") == "disabled"]
+    return {
+        "hits": counts.get("hit", 0),
+        "misses": counts.get("miss", 0),
+        "stores": counts.get("store", 0),
+        "stale": counts.get("stale", 0),
+        "corrupt": counts.get("corrupt", 0),
+        "audit_mismatches": counts.get("audit_mismatch", 0),
+        "fallbacks": counts.get("fallback", 0),
+        "hit_rate": (counts.get("hit", 0) / lookups) if lookups else None,
+        "hit_wall_s": sum(hit_walls) if hit_walls else None,
+        "disabled_reason": disabled[0] if disabled else None,
     }
 
 
@@ -495,6 +538,20 @@ def _degradation_info(records, srv):
         "breaker_trips": trips,
         "breaker_closed_events": len(closed),
         "reloads": n_reloads,
+        # what the recovery wall actually spent verifying snapshots
+        # (schema-v8 reload.verify_s — the single-verified-read path's
+        # discovery cost, previously invisible inside wall_s)
+        "reload_verify_s": (
+            sum(
+                r["verify_s"] for r in reloads
+                if r.get("name") == "ok" and _finite(r.get("verify_s"))
+            )
+            if any(
+                r.get("name") == "ok" and _finite(r.get("verify_s"))
+                for r in reloads
+            )
+            else None
+        ),
         "recovery_s": recovery_s,
         "availability": avail,
         "degraded_at_exit": bool(degraded),
@@ -903,6 +960,50 @@ def _reliability_lines(rel, md):
         if rel.get("last_checkpoint_bytes") is not None:
             line += f", {format_bytes(rel['last_checkpoint_bytes'])} each"
         lines.append(line)
+        if rel.get("checkpoints_async"):
+            lines.append(
+                f"async checkpointing: {rel['checkpoints_async']} of "
+                f"{rel['checkpoints']} saves off-path (on-path wall is the "
+                f"overhead above; verify+write "
+                f"{_fmt_time_s(rel.get('checkpoint_off_path_s'))} ran in "
+                "the background writer)"
+            )
+    aot = rel.get("aot_cache")
+    if aot is not None:
+        if aot.get("hit_rate") is not None:
+            line = (
+                f"aot executable cache: {aot['hits']} hit(s) / "
+                f"{aot['misses']} miss(es) "
+                f"(hit rate {aot['hit_rate'] * 100:.0f}%"
+                + (
+                    f", deserialize {_fmt_time_s(aot['hit_wall_s'])} vs "
+                    "a cold recompile"
+                    if aot.get("hit_wall_s") is not None
+                    else ""
+                )
+                + ")"
+            )
+        else:
+            line = "aot executable cache: no lookups"
+        if aot.get("stores"):
+            line += f", {aot['stores']} entr(ies) written"
+        lines.append(line)
+        bad = []
+        if aot.get("stale"):
+            bad.append(f"{aot['stale']} stale")
+        if aot.get("corrupt"):
+            bad.append(f"{aot['corrupt']} corrupt")
+        if aot.get("audit_mismatches"):
+            bad.append(f"{aot['audit_mismatches']} audit-mismatched")
+        if bad:
+            lines.append(
+                "  " + ", ".join(bad)
+                + " entr(ies) fell back to a clean recompile"
+            )
+        if aot.get("disabled_reason"):
+            lines.append(
+                f"  cache disabled on this backend: {aot['disabled_reason']}"
+            )
     rec = rel.get("recovery")
     if rec is not None:
         if rec["verdict"] == "resumed":
@@ -1011,6 +1112,11 @@ def _serving_lines(srv, md):
         )
         if deg.get("recovery_s") is not None:
             breaker += f", recovery {_fmt_time_s(deg['recovery_s'])}"
+        if deg.get("reload_verify_s") is not None:
+            breaker += (
+                f" (snapshot verify {_fmt_time_s(deg['reload_verify_s'])}, "
+                "single-read)"
+            )
         lines.append(breaker)
         avail = deg.get("availability")
         lines.append(

@@ -580,7 +580,8 @@ class ServingEngine:
 
     # -- hot weight reload ---------------------------------------------------
 
-    def reload(self, path=None, reason="manual"):
+    def reload(self, path=None, reason="manual", verified=None,
+               verify_s=None):
         """Hot-swap the served weights from ``path`` (default: the newest
         VERIFYING snapshot in ``reload_dir`` via ``find_latest_good`` —
         including the one already loaded, whose in-memory copy may be
@@ -591,15 +592,35 @@ class ServingEngine:
         per-rung ``xla_audit`` dedup). A successful reload closes the
         breaker. Raises ``CheckpointError``/``ValueError`` when the swap
         is impossible (no snapshot verifies, sizes differ); returns the
-        loaded checkpoint's metadata."""
+        loaded checkpoint's metadata.
+
+        Single-verified-read: discovery reads each candidate WITH its
+        arrays (``with_arrays=True``), and the swap assembles from
+        exactly those bytes — the snapshot is read and checksummed once,
+        and the discovery->load TOCTOU window (a concurrent trainer
+        rotating the file away, or bit-rot between verify and a re-read)
+        is closed by construction. The discovery's verification time is
+        recorded as ``verify_s`` in the ``reload`` record, so the
+        Degradation subsection's recovery accounting can see what
+        verification costs instead of it hiding inside ``wall_s``.
+        ``verified``/``verify_s``: a caller (``watch_reload``) that
+        already ran a verified discovery passes its result through —
+        ``wall_s`` stays end-to-end (discovery + verify + swap) either
+        way."""
         t0 = self.clock()
+        pre_verified_s = verify_s or 0.0  # discovery ran before t0
         step = None
         if path is None:
             if self._reload_dir is None:
                 raise ValueError(
                     "reload() needs a path, or a reload_dir on the engine"
                 )
-            found, meta, skipped = find_latest_good(self._reload_dir)
+            tv = self.clock()
+            found, meta, arrays, skipped = find_latest_good(
+                self._reload_dir, with_arrays=True
+            )
+            verify_s = self.clock() - tv
+            pre_verified_s = 0.0  # this discovery is inside t0's window
             if found is None:
                 raise CheckpointError(
                     self._reload_dir,
@@ -608,14 +629,21 @@ class ServingEngine:
                 )
             path = found
             step = meta.get("global_step")
-        # transient read errors retry under the shared policy; a
-        # deterministic CheckpointError (corruption) surfaces immediately
-        meta = R.retry_call(
-            lambda: self._session.load_weights(path),
-            attempts=2,
-            retry_on=(OSError,),
-        )
-        wall = self.clock() - t0
+            verified = (meta, arrays)
+        if verified is not None:
+            # the verified arrays are in memory: the swap is pure
+            # assembly, no second read — nothing to retry
+            meta = self._session.load_weights(path, verified=verified)
+        else:
+            # explicit-path reload: ONE read+verify through the loader;
+            # transient read errors retry under the shared policy, a
+            # deterministic CheckpointError (corruption) surfaces
+            meta = R.retry_call(
+                lambda: self._session.load_weights(path),
+                attempts=2,
+                retry_on=(OSError,),
+            )
+        wall = self.clock() - t0 + pre_verified_s
         if step is None:
             step = meta.get("global_step")
         if step is not None:
@@ -627,6 +655,7 @@ class ServingEngine:
             step=step,
             reason=reason,
             wall_s=wall,
+            verify_s=verify_s,
             programs_cached=len(getattr(self._session, "_predict_cache", ())),
         )
         self.close_breaker()
@@ -659,22 +688,32 @@ class ServingEngine:
         loop."""
         if self._reload_dir is None:
             raise ValueError("watch_reload() needs a reload_dir on the engine")
-        step, path, meta, skipped = find_newer_good(
-            self._reload_dir, than_step=self._loaded_step
+        tv = self.clock()
+        step, path, meta, arrays, skipped = find_newer_good(
+            self._reload_dir, than_step=self._loaded_step, with_arrays=True
         )
+        verify_s = self.clock() - tv
         if path is None:
             if skipped:
                 self._metrics.reload(
                     "none_newer",
                     path=str(self._reload_dir),
                     reason="watch",
+                    verify_s=verify_s,
                     skipped=[
                         {"path": str(p), "cause": c} for p, c in skipped
                     ],
                 )
             return None
         try:
-            self.reload(path=path, reason="watch")
+            # the watcher's single verified read rides through: the swap
+            # assembles the arrays discovery just checksummed, so the
+            # snapshot a concurrent trainer is free to rotate away can no
+            # longer vanish between the verify and the load
+            self.reload(
+                path=path, reason="watch", verified=(meta, arrays),
+                verify_s=verify_s,
+            )
         except (CheckpointError, ValueError, OSError) as e:
             self._metrics.reload(
                 "failed", path=str(path), reason="watch", error=str(e)[:200],

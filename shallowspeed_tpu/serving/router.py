@@ -130,6 +130,15 @@ class ReplicaInfo:
             "queue_depth": self.queue_depth,
             "inflight": self.inflight,
             "loaded_step": self.loaded_step,
+            # spawn -> ready wall (None until ready): the per-replica
+            # cold-start cost — the scoreboard the AOT executable cache
+            # moves (cache-warm replicas ready in a fraction of the
+            # cold-compile wall; docs/performance.md)
+            "ready_wall_s": (
+                self.ready_t - self.spawn_t
+                if self.ready_t is not None and self.spawn_t is not None
+                else None
+            ),
         }
 
 

@@ -1,0 +1,222 @@
+"""AOT executable cache tests (shallowspeed_tpu/aot_cache.py): the store's
+own format + write discipline, the key scheme, every degraded outcome
+(miss / stale / corrupt / disabled) falling back to a clean recompile, and
+the session-level contract — a warm start serves every rung from the cache
+with ZERO jit compiles (pinned by the counter), every deserialized program
+re-audited before first dispatch, bitwise-equal predictions across the
+cache boundary. The cross-PROCESS restart leg lives in `make aot-smoke`;
+these tests pin the same machinery in-process.
+"""
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu import aot_cache as AC
+from shallowspeed_tpu import faults
+from shallowspeed_tpu.api import TrainingSession
+from shallowspeed_tpu.observability import MetricsRecorder, read_jsonl
+
+SIZES = (24, 20, 18, 16)
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("aot_data")
+    rng = np.random.RandomState(0)
+    for suffix, n in (("train", 256), ("val", 96)):
+        np.save(d / f"x_{suffix}.npy", rng.randn(n, SIZES[0]).astype(np.float32))
+        np.save(
+            d / f"y_{suffix}.npy",
+            np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], n)],
+        )
+    return d
+
+
+def _session(data_dir, cache_dir, **kw):
+    kw.setdefault("sizes", SIZES)
+    kw.setdefault("global_batch_size", 64)
+    return TrainingSession(
+        data_dir=data_dir, aot_cache_dir=cache_dir, **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# the store itself
+# ---------------------------------------------------------------------------
+
+
+def test_cache_key_is_stable_and_input_sensitive():
+    fp = {"jax": "0.0.0", "jaxlib": "0.0.0", "platform": "cpu"}
+    k1 = AC.cache_key("p", (1, 2), fp, AC.content_hash("module {}"))
+    k2 = AC.cache_key("p", (1, 2), fp, AC.content_hash("module {}"))
+    assert k1 == k2 and len(k1) == 64
+    # every key ingredient matters
+    assert k1 != AC.cache_key("q", (1, 2), fp, AC.content_hash("module {}"))
+    assert k1 != AC.cache_key("p", (1, 4), fp, AC.content_hash("module {}"))
+    assert k1 != AC.cache_key("p", (1, 2), fp, AC.content_hash("module {x}"))
+    assert k1 != AC.cache_key(
+        "p", (1, 2), {**fp, "jaxlib": "9.9.9"}, AC.content_hash("module {}")
+    )
+
+
+def test_store_load_roundtrip_and_failure_modes(tmp_path):
+    """Entry round trip on a real compiled program, then every defence:
+    miss, torn/corrupt payload, stale fingerprint — each recorded, each
+    returning None (the caller recompiles), never raising."""
+    import jax
+    import jax.numpy as jnp
+
+    compiled = (
+        jax.jit(lambda x: x * 2.0).lower(jnp.ones((4,), jnp.float32)).compile()
+    )
+    cache = AC.AotCache(tmp_path / "aot")
+    key = cache.key_for("p", (1,), "module {}")
+    assert cache.load(key, program="p") is None  # miss
+    assert cache.counts["miss"] == 1
+    path = cache.store(key, compiled, program="p")
+    if not cache.supported:  # backend cannot serialize: recorded no-op
+        assert cache.counts["disabled"] == 1
+        return
+    assert path is not None and path.exists()
+    loaded = cache.load(key, program="p")
+    assert loaded is not None
+    np.testing.assert_array_equal(
+        np.asarray(loaded(jnp.ones((4,), jnp.float32))),
+        np.asarray(compiled(jnp.ones((4,), jnp.float32))),
+    )
+    # corruption: flip payload bytes -> sha mismatch -> recorded + None
+    faults.corrupt_checkpoint_bytes(path, seed=1)
+    assert cache.load(key, program="p") is None
+    assert cache.counts["corrupt"] == 1
+    # a rewrite heals it
+    cache.store(key, compiled, program="p")
+    assert cache.load(key, program="p") is not None
+    # truncation is also corrupt, not a crash
+    path.write_bytes(path.read_bytes()[:16])
+    assert cache.load(key, program="p") is None
+    assert cache.counts["corrupt"] == 2
+    # stale fingerprint: same key on disk, different backend identity
+    cache.store(key, compiled, program="p")
+    other = AC.AotCache(tmp_path / "aot")
+    other._fingerprint = {**cache.fingerprint(), "jaxlib": "0.0.0-other"}
+    assert other.load(key, program="p") is None
+    assert other.counts["stale"] == 1
+    stats = cache.stats()
+    assert stats["lookups"] >= 2 and stats["disabled_reason"] is None
+
+
+# ---------------------------------------------------------------------------
+# the session-level contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "kw", [dict(), dict(dp=2)], ids=["seq", "dp2"]
+)
+def test_warm_start_serves_ladder_with_zero_compiles(data_dir, tmp_path, kw):
+    """The cold session compiles + stores; a second session over the same
+    cache dir serves the SAME rows bitwise-identically with jit_compiles
+    == 0 (the counter pin) and a recorded hit per program — and the
+    xla_audit record proves the deserialized program was censused before
+    its first dispatch."""
+    cache = tmp_path / "aot"
+    X = np.random.RandomState(7).rand(20, SIZES[0]).astype(np.float32)
+
+    m1 = MetricsRecorder()
+    cold = _session(data_dir, cache, metrics=m1, **kw)
+    p_cold = cold.predict(X)
+    if not cold._aot.supported:
+        pytest.skip(f"backend cannot serialize: {cold._aot.disabled_reason}")
+    assert m1.counters.get("jit_compiles", 0) >= 1
+    assert cold._aot.counts["store"] >= 1
+
+    m2 = MetricsRecorder()
+    audits = []
+    m2.audit = lambda name, **f: audits.append((name, f))
+    warm = _session(data_dir, cache, metrics=m2, **kw)
+    p_warm = warm.predict(X)
+    assert m2.counters.get("jit_compiles", 0) == 0, "warm start recompiled"
+    assert warm._aot.counts["hit"] >= 1 and warm._aot.counts["miss"] == 0
+    np.testing.assert_array_equal(p_cold, p_warm)
+    # never serve an unaudited program: the deserialized rung was censused
+    assert audits and all(n == "inference_program" for n, _ in audits)
+    assert all(f.get("census_ok") for _, f in audits)
+
+
+def test_corrupt_entry_falls_back_to_clean_recompile(data_dir, tmp_path):
+    """A deliberately corrupted on-disk entry must cost a recompile, a
+    recorded corrupt event and a rewrite — never a crash, never served."""
+    cache = tmp_path / "aot"
+    X = np.random.RandomState(7).rand(8, SIZES[0]).astype(np.float32)
+    cold = _session(data_dir, cache, metrics=MetricsRecorder())
+    p0 = cold.predict(X)
+    if not cold._aot.supported:
+        pytest.skip(f"backend cannot serialize: {cold._aot.disabled_reason}")
+    entry = sorted((tmp_path / "aot").glob("*.aotx"))[0]
+    faults.corrupt_checkpoint_bytes(entry, seed=3)
+    m = MetricsRecorder()
+    s = _session(data_dir, cache, metrics=m)
+    p1 = s.predict(X)
+    assert s._aot.counts["corrupt"] == 1
+    assert s._aot.counts["store"] == 1  # rewritten after the fallback
+    assert m.counters.get("jit_compiles", 0) == 1
+    np.testing.assert_array_equal(p0, p1)
+    # and the healed entry serves the next session from cache again
+    m3 = MetricsRecorder()
+    s3 = _session(data_dir, cache, metrics=m3)
+    s3.predict(X)
+    assert m3.counters.get("jit_compiles", 0) == 0
+
+
+def test_aot_events_land_in_jsonl_with_schema_v8(data_dir, tmp_path):
+    """The aot_cache records flow through the JSONL sink self-describing:
+    kind aot_cache, v8 stamp, program + key + outcome names."""
+    from shallowspeed_tpu.observability import JsonlMetrics
+
+    cache = tmp_path / "aot"
+    jl = tmp_path / "m.jsonl"
+    X = np.random.RandomState(7).rand(8, SIZES[0]).astype(np.float32)
+    with JsonlMetrics(jl) as m:
+        s = _session(data_dir, cache, metrics=m)
+        s.predict(X)
+    recs = [r for r in read_jsonl(jl) if r["kind"] == "aot_cache"]
+    if not s._aot.supported:
+        assert [r["name"] for r in recs] == ["disabled"]
+        return
+    names = [r["name"] for r in recs]
+    assert "miss" in names and "store" in names
+    assert all(r["v"] == 8 and r.get("program") for r in recs)
+
+
+def test_epoch_audit_probe_rides_the_cache_probe_only(data_dir, tmp_path):
+    """The trainer's cold-start leg: with metrics on, the epoch AUDIT
+    probe (census + cost_analysis) deserializes from the cache on a warm
+    start instead of paying its XLA compile — while dispatch stays on
+    the jit wrapper (the deserialized object is probe-only: executing a
+    deserialized DONATING program is the jax-0.4.x hazard class the
+    cache avoids structurally). Training math is unchanged either way."""
+    cache = tmp_path / "aot"
+    ref = TrainingSession(
+        sizes=SIZES, global_batch_size=64, data_dir=data_dir
+    )
+    ref_loss = ref.train_epoch()
+
+    m1 = MetricsRecorder()
+    cold = _session(data_dir, cache, metrics=m1)
+    cold_loss = cold.train_epoch()
+    if not cold._aot.supported:
+        pytest.skip(f"backend cannot serialize: {cold._aot.disabled_reason}")
+    assert cold._aot.counts["store"] >= 1  # the probe was stored
+    # probe compile + the jit wrapper's own first-dispatch compile
+    cold_compiles = m1.counters.get("jit_compiles", 0)
+    assert cold_compiles >= 1
+
+    m2 = MetricsRecorder()
+    warm = _session(data_dir, cache, metrics=m2)
+    warm_loss = warm.train_epoch()
+    assert warm._aot.counts["hit"] >= 1  # the probe came from cache
+    # the probe's compile disappeared; dispatch still jit-compiles once,
+    # so the counter drops by exactly the probe
+    assert m2.counters.get("jit_compiles", 0) == 0
+    assert warm_loss == cold_loss == ref_loss
+    assert warm.model_hash() == cold.model_hash() == ref.model_hash()

@@ -371,3 +371,201 @@ def test_corrupt_newest_falls_back_to_previous_good(tmp_path):
         )
     path, meta, skipped = find_latest_good(tmp_path)
     assert path is None and meta is None and len(skipped) == 3
+
+
+# ---------------------------------------------------------------------------
+# the async writer (docs/robustness.md "The async writer's crash windows")
+# ---------------------------------------------------------------------------
+
+
+def _snapshot_job(step=0, value=1.0):
+    """A tiny stage-1 (arrays, meta) pair the writer tests feed in."""
+    spec = Mo.make_model_spec((4, 3, 2), 1, 4)
+    params = [
+        [
+            {"W": np.full((3, 4), value, np.float32),
+             "b": np.zeros((1, 3), np.float32)},
+            {"W": np.full((2, 3), value, np.float32),
+             "b": np.zeros((1, 2), np.float32)},
+        ]
+    ]
+    return C.build_snapshot(
+        params, spec, epoch=0, step_in_epoch=step % 1, global_step=step
+    )
+
+
+def test_async_writer_writes_in_order_and_drains(tmp_path):
+    """Jobs rename into place in submit order, drain() blocks until every
+    snapshot is durable, and each completion callback carries the
+    verify/write timings plus the stamped finiteness flag."""
+    results = []
+    w = C.AsyncCheckpointWriter(max_in_flight=2)
+    for step in (1, 2, 3):
+        arrays, meta = _snapshot_job(step)
+        w.submit(
+            step_checkpoint_path(tmp_path, step), arrays, meta, step,
+            on_complete=results.append,
+        )
+    w.drain()
+    assert [gs for gs, _ in list_step_checkpoints(tmp_path)] == [1, 2, 3]
+    assert [r["meta"]["global_step"] for r in results] == [1, 2, 3]
+    assert all(
+        r["all_finite"] and r["bytes"] > 0
+        and r["verify_s"] >= 0 and r["write_s"] >= 0
+        for r in results
+    )
+    # every renamed file fully verifies — the writer's whole point
+    for _, p in list_step_checkpoints(tmp_path):
+        verify_checkpoint(p, require_finite=True)
+    w.close()
+    w.close()  # idempotent
+
+
+def test_async_writer_bounded_queue_applies_backpressure(tmp_path):
+    """submit() BLOCKS when max_in_flight jobs are pending — a snapshot is
+    never dropped to keep the step path fast. A slow@save injection
+    stalls the writer inside the write window; the 3rd submit can only
+    return after the stalled job vacates the queue."""
+    import time as _time
+
+    plan = faults.FaultPlan.parse("slow@save=0:ms=300")
+    w = C.AsyncCheckpointWriter(max_in_flight=1, faults=plan)
+    arrays, meta = _snapshot_job(1)
+    w.submit(step_checkpoint_path(tmp_path, 1), arrays, meta, 0)
+    arrays, meta = _snapshot_job(2)
+    w.submit(step_checkpoint_path(tmp_path, 2), arrays, meta, 1)
+    t0 = _time.perf_counter()
+    arrays, meta = _snapshot_job(3)
+    w.submit(step_checkpoint_path(tmp_path, 3), arrays, meta, 2)
+    blocked = _time.perf_counter() - t0
+    w.drain()
+    assert blocked > 0.05, "full queue did not block the submitter"
+    assert [gs for gs, _ in list_step_checkpoints(tmp_path)] == [1, 2, 3]
+    assert plan.faults[0].fired
+    w.close()
+
+
+def test_async_writer_die_in_window_leaves_no_visible_torn_file(tmp_path):
+    """die@save (exc mode in-process; sigkill is the subprocess shape)
+    fires AFTER the temp write, BEFORE the rename: the victim snapshot is
+    never rename-visible, older snapshots stay fully-verifying, and the
+    failure re-raises on the submitting thread at drain()."""
+    plan = faults.FaultPlan.parse("die@save=1")
+    w = C.AsyncCheckpointWriter(max_in_flight=2, faults=plan)
+    for seq, step in enumerate((4, 8)):
+        arrays, meta = _snapshot_job(step)
+        w.submit(step_checkpoint_path(tmp_path, step), arrays, meta, seq)
+    with pytest.raises(faults.InjectedFault, match="die@save=1"):
+        w.drain()
+    # save 0 (step 4) is durable and verifying; save 1 (step 8) never
+    # renamed — discovery cannot see anything torn
+    assert [gs for gs, _ in list_step_checkpoints(tmp_path)] == [4]
+    p, meta, skipped = find_latest_good(tmp_path)
+    assert p.name == "step-00000004.npz" and skipped == []
+    w.close()
+
+
+def test_corrupt_save_injection_renames_but_never_verifies(tmp_path):
+    """corrupt@save flips the in-flight buffer AFTER the checksum stamp:
+    the file lands rename-visible but fails verification, and discovery
+    falls back past it to the previous good snapshot — the exact bit-rot
+    shape the chaos harness needs without racing the writer."""
+    plan = faults.FaultPlan.parse("corrupt@save=1")
+    w = C.AsyncCheckpointWriter(max_in_flight=2, faults=plan)
+    for seq, step in enumerate((4, 8)):
+        arrays, meta = _snapshot_job(step)
+        w.submit(step_checkpoint_path(tmp_path, step), arrays, meta, seq)
+    w.drain()
+    assert [gs for gs, _ in list_step_checkpoints(tmp_path)] == [4, 8]
+    p, meta, skipped = find_latest_good(tmp_path)
+    assert p.name == "step-00000004.npz"
+    assert len(skipped) == 1 and "checksum" in skipped[0][1]
+    w.close()
+
+
+def test_async_writer_rotation_runs_after_rename(tmp_path):
+    """Rotation is armed per job and runs strictly AFTER the new snapshot
+    is durable — retention converges to keep while every survivor
+    verifies."""
+    w = C.AsyncCheckpointWriter(max_in_flight=2)
+    for seq, step in enumerate((1, 2, 3, 4)):
+        arrays, meta = _snapshot_job(step)
+        w.submit(
+            step_checkpoint_path(tmp_path, step), arrays, meta, seq,
+            rotate_dir=tmp_path, rotate_keep=2,
+        )
+    w.drain()
+    assert [gs for gs, _ in list_step_checkpoints(tmp_path)] == [3, 4]
+    w.close()
+
+
+def test_sync_and_async_saves_produce_identical_files(tmp_path):
+    """The shared-stages contract: the synchronous path and the writer
+    produce byte-wise interchangeable snapshots (same arrays, same
+    checksum) — the crash-consistency analysis covers both because they
+    ARE the same code."""
+    arrays_a, meta_a = _snapshot_job(5)
+    arrays_b, meta_b = _snapshot_job(5)
+    sync_p = step_checkpoint_path(tmp_path / "sync", 5)
+    C.run_save_stages(sync_p, arrays_a, meta_a)
+    w = C.AsyncCheckpointWriter(max_in_flight=1)
+    async_p = step_checkpoint_path(tmp_path / "async", 5)
+    w.submit(async_p, arrays_b, meta_b, 0)
+    w.drain()
+    w.close()
+    ma = verify_checkpoint(sync_p)
+    mb = verify_checkpoint(async_p)
+    assert ma["checksum"] == mb["checksum"]
+    assert ma == mb
+
+
+# ---------------------------------------------------------------------------
+# single-verified-read discovery (with_arrays)
+# ---------------------------------------------------------------------------
+
+
+def test_find_latest_good_with_arrays_is_one_read(tmp_path, monkeypatch):
+    """with_arrays=True returns the arrays of the SAME read discovery
+    verified, and assemble_checkpoint loads from them without touching
+    the file again — pinned by counting _read_arrays calls and by
+    deleting the file between discovery and assembly (the TOCTOU window
+    that used to need a second read is gone by construction)."""
+    params, spec = _params_and_spec()
+    p = step_checkpoint_path(tmp_path, 3)
+    save_checkpoint(p, params, spec, epoch=0, step_in_epoch=0, global_step=3)
+    reads = []
+    real = C._read_arrays
+
+    def counting(path):
+        reads.append(str(path))
+        return real(path)
+
+    monkeypatch.setattr(C, "_read_arrays", counting)
+    path, meta, arrays, skipped = find_latest_good(tmp_path, with_arrays=True)
+    assert path == p and skipped == []
+    assert len(reads) == 1
+    p.unlink()  # nothing re-reads it: the TOCTOU window is closed
+    loaded, lspec, lmeta = C.assemble_checkpoint(path, meta, arrays, 1)
+    assert len(reads) == 1  # still one read, assembly touched no file
+    assert lmeta["global_step"] == 3
+    for a, b in zip(
+        [l for st in params for l in st], [l for st in loaded for l in st]
+    ):
+        np.testing.assert_array_equal(np.asarray(a["W"]), b["W"])
+
+
+def test_find_newer_good_with_arrays(tmp_path):
+    params, spec = _params_and_spec()
+    for gs in (2, 4):
+        save_checkpoint(
+            step_checkpoint_path(tmp_path, gs), params, spec,
+            epoch=0, step_in_epoch=0, global_step=gs,
+        )
+    step, path, meta, arrays, skipped = C.find_newer_good(
+        tmp_path, than_step=2, with_arrays=True
+    )
+    assert step == 4 and meta["global_step"] == 4 and "w0" in arrays
+    step, path, meta, arrays, skipped = C.find_newer_good(
+        tmp_path, than_step=4, with_arrays=True
+    )
+    assert step is None and arrays is None

@@ -552,3 +552,106 @@ def test_random_kernel_variant_fuzz(seed):
                 np.testing.assert_array_equal(
                     np.asarray(a), np.asarray(b), err_msg=label
                 )
+
+
+# ---------------------------------------------------------------------------
+# the async-save dimension of the kill-resume lattice (PR 12)
+# ---------------------------------------------------------------------------
+
+ASYNC_KILL_LAYOUTS = {
+    "dp2": ["--dp", "2"],
+    "gpipe-pp4": ["--pp", "4", "--schedule", "gpipe"],
+    "tp2": ["--tp", "2"],
+}
+
+
+@pytest.fixture(scope="module")
+def flagship_data_dir(tmp_path_factory):
+    """784-dim synthetic data: the subprocess legs drive the real train.py,
+    which trains the flagship model."""
+    d = tmp_path_factory.mktemp("async_kill_data")
+    rng = np.random.RandomState(0)
+    for suffix, n in (("train", 256), ("val", 96)):
+        np.save(d / f"x_{suffix}.npy", rng.rand(n, 784).astype(np.float32))
+        np.save(
+            d / f"y_{suffix}.npy",
+            np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)],
+        )
+    return d
+
+
+@pytest.mark.parametrize("layout", sorted(ASYNC_KILL_LAYOUTS))
+def test_async_save_sigkill_in_writer_window_resumes_bitwise(
+    layout, flagship_data_dir, tmp_path
+):
+    """The async-save dimension of the kill-resume lattice
+    (docs/robustness.md "The async writer's crash windows"): a REAL
+    train.py process checkpointing through the background writer is
+    SIGKILLed at a fault-injected point INSIDE the writer's
+    write/verify/rename window (die@save=N — after the temp file is
+    durable, before the rename), across dp2 / gpipe-pp4 / tp2. The
+    contract: `find_latest_good` never sees a torn or unverified file
+    (only older fully-verifying snapshots are discoverable; the victim's
+    temp is rename-invisible), and the resumed run finishes bitwise
+    identical to the uninterrupted twin."""
+    import os
+    import re
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    from shallowspeed_tpu.checkpoint import (
+        find_latest_good,
+        list_step_checkpoints,
+    )
+
+    root = Path(__file__).resolve().parent.parent
+    lflags = ASYNC_KILL_LAYOUTS[layout]
+    common = [
+        "--data-dir", str(flagship_data_dir), "--epochs", "2",
+        "--global-batch-size", "32", "--no-eval",
+    ]
+
+    def run(args, check=True, faults_spec=None):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("SHALLOWSPEED_FAULTS", None)
+        if faults_spec:
+            env["SHALLOWSPEED_FAULTS"] = faults_spec
+        r = subprocess.run(
+            [sys.executable, str(root / "train.py"), *args],
+            capture_output=True, text=True, timeout=540, cwd=root, env=env,
+        )
+        if check:
+            assert r.returncode == 0, r.stderr[-2000:]
+        return r
+
+    twin = run(common + lflags)
+    twin_hash = re.search(r"final model hash: ([0-9a-f]{40})", twin.stdout)
+    assert twin_hash, twin.stdout
+
+    ck = tmp_path / "ck"
+    killed_args = common + lflags + [
+        "--checkpoint-dir", str(ck), "--checkpoint-every-steps", "3",
+        "--async-checkpoint",
+    ]
+    r = run(
+        killed_args, check=False, faults_spec="die@save=2:mode=sigkill"
+    )
+    assert r.returncode == -9, (r.returncode, r.stderr[-1000:])
+    # saves land at steps 3, 6, 9, ... — save seq 2 (step 9) was killed
+    # INSIDE the window: its temp is durable but never renamed, so
+    # discovery sees only the older fully-verifying snapshots
+    steps = [gs for gs, _ in list_step_checkpoints(ck)]
+    assert steps == [3, 6], (layout, steps)
+    p, meta, skipped = find_latest_good(ck)
+    assert p is not None and p.name == "step-00000006.npz", layout
+    assert skipped == [], (layout, skipped)  # nothing torn is discoverable
+
+    resumed = run(killed_args + ["--resume", "auto"])
+    assert "resumed at epoch" in resumed.stdout, resumed.stdout
+    res_hash = re.search(
+        r"final model hash: ([0-9a-f]{40})", resumed.stdout
+    )
+    assert res_hash and res_hash.group(1) == twin_hash.group(1), layout

@@ -752,10 +752,10 @@ def test_schema_v6_serving_health_and_reload_kinds(tmp_path):
 def test_schema_v7_fleet_kinds(tmp_path):
     """Schema v7 (additive): the fleet/fleet_health record kinds — the
     serving fleet's evidence stream, every event tagged replica_id —
-    round-trip with the version stamp, the v7 reader accepts v1-v6 files
-    unchanged, a v8 file is refused, and NullMetrics no-ops the new
-    hooks."""
-    assert SCHEMA_VERSION == 7
+    round-trip with the version stamp, and the reader accepts v1-v6
+    files unchanged. (The version pin and the one-ahead refusal live
+    with the NEWEST schema's test — test_schema_v8_async_ckpt_and_aot —
+    so a bump edits exactly one test.)"""
     path = tmp_path / "v7.jsonl"
     with JsonlMetrics(path) as m:
         m.fleet_health("replica_spawned", replica_id=0, checkpoint=None)
@@ -774,7 +774,7 @@ def test_schema_v7_fleet_kinds(tmp_path):
         "meta", "fleet_health", "fleet_health", "fleet_health",
         "fleet_health", "fleet",
     ]
-    assert all(r["v"] == 7 for r in recs)
+    assert all(r["v"] == SCHEMA_VERSION for r in recs)
     assert all(
         "replica_id" in r for r in recs if r["kind"] == "fleet_health"
     )
@@ -789,14 +789,65 @@ def test_schema_v7_fleet_kinds(tmp_path):
         p = tmp_path / f"old-v{v}.jsonl"
         p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
         assert read_jsonl(p)[0]["kind"] == rec["kind"]
-    # one-directional refusal: a v8 file fails loudly
-    v8 = tmp_path / "v8.jsonl"
-    v8.write_text(json.dumps({"v": 8, "kind": "event"}) + "\n")
-    with pytest.raises(ValueError, match="newer"):
-        read_jsonl(v8)
     n = NullMetrics()
     n.fleet("summary", completed=1)
     n.fleet_health("replica_dead", replica_id=0)
+
+
+def test_schema_v8_async_ckpt_and_aot(tmp_path):
+    """Schema v8 (additive): the aot_cache kind plus the async-writer
+    fields on checkpoint and verify_s on reload — round-trip with the
+    version stamp, the v8 reader accepts v1-v7 files unchanged, a v9
+    file is refused, and NullMetrics no-ops the new hook."""
+    assert SCHEMA_VERSION == 8
+    path = tmp_path / "v8.jsonl"
+    with JsonlMetrics(path) as m:
+        m.aot_cache("miss", program="inference_r4", key="ab12")
+        m.aot_cache(
+            "store", program="inference_r4", key="ab12", wall_s=0.01,
+            bytes=2048,
+        )
+        m.aot_cache("hit", program="inference_r4", key="ab12", wall_s=0.002)
+        m.aot_cache(
+            "corrupt", program="inference_r4", key="ab12",
+            reason="payload sha256 mismatch — torn or bit-rotted",
+        )
+        m.checkpoint(
+            "step", path="ck/step-00000004.npz", global_step=4, bytes=100,
+            wall_s=0.001, **{"async": True}, queue_depth=1,
+            verify_s=0.0005, write_s=0.002, queued_s=0.0001,
+        )
+        m.reload("ok", path="ck/step-00000008.npz", step=8, reason="watch",
+                 wall_s=0.01, verify_s=0.004)
+    recs = read_jsonl(path)
+    kinds = [r["kind"] for r in recs]
+    assert kinds == [
+        "meta", "aot_cache", "aot_cache", "aot_cache", "aot_cache",
+        "checkpoint", "reload",
+    ]
+    assert all(r["v"] == 8 for r in recs)
+    assert [r["name"] for r in recs if r["kind"] == "aot_cache"] == [
+        "miss", "store", "hit", "corrupt",
+    ]
+    ck = recs[5]
+    assert ck["async"] is True and ck["queue_depth"] == 1
+    assert ck["verify_s"] == 0.0005 and ck["write_s"] == 0.002
+    assert recs[6]["verify_s"] == 0.004
+    # v1-v7 files load unchanged under the v8 reader
+    for v, rec in (
+        (4, {"kind": "checkpoint", "name": "step", "global_step": 2}),
+        (6, {"kind": "reload", "name": "ok", "path": "x"}),
+        (7, {"kind": "fleet", "name": "summary", "completed": 3}),
+    ):
+        p = tmp_path / f"old-v{v}.jsonl"
+        p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
+        assert read_jsonl(p)[0]["kind"] == rec["kind"]
+    # one-directional refusal: a v9 file fails loudly
+    v9 = tmp_path / "v9.jsonl"
+    v9.write_text(json.dumps({"v": 9, "kind": "event"}) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        read_jsonl(v9)
+    NullMetrics().aot_cache("hit", program="x")
 
 
 def test_replica_shard_suffix_and_fallback_read(tmp_path):

@@ -524,3 +524,228 @@ def test_sigkill_mode_parses_but_is_not_fired_in_process():
     it parses and targets the right signal surface."""
     plan = faults.FaultPlan.parse("die@step=4:mode=sigkill")
     assert plan.faults[0].mode == "sigkill"
+
+
+# ---------------------------------------------------------------------------
+# the async checkpoint writer, session level (PR 12)
+# ---------------------------------------------------------------------------
+
+
+def test_save_fault_grammar_round_trip():
+    """The @save= anchor joins the grammar: die/slow/corrupt parse (and
+    refuse what they must), due_at_save fires each exactly once with the
+    <= catch-up anchor, and save faults never count as pending STEP
+    injections (a training entry point must not refuse a run over
+    them)."""
+    plan = faults.FaultPlan.parse(
+        "die@save=2:mode=sigkill, slow@save=1:ms=50, corrupt@save=3"
+    )
+    assert [repr(f) for f in plan.faults] == [
+        "die@save=2:mode=sigkill", "slow@save=1:ms=50", "corrupt@save=3"
+    ]
+    assert plan.pending == []  # step-pending stays empty: entry points run
+    assert [f.kind for f in plan.pending_save] == ["die", "slow", "corrupt"]
+    assert [f.kind for f in plan.due_at_save(0)] == []
+    assert [f.kind for f in plan.due_at_save(1)] == ["slow"]
+    plan.faults[1].fired = True
+    # catch-up: an anchor whose exact save never ran fires on the next
+    assert [f.kind for f in plan.due_at_save(5)] == ["die", "corrupt"]
+    for bad in (
+        "nan@save=1",            # nan is not a writer fault
+        "error@save=1",          # error is dispatch-only
+        "slow@save=1",           # slow needs ms
+        "corrupt@save=1:ms=5",   # corrupt takes no ms
+        "corrupt@dispatch=1",    # corrupt is save-only
+        "die@save=1:step=2",     # exactly one anchor
+    ):
+        with pytest.raises(ValueError, match="fault"):
+            faults.FaultPlan.parse(bad)
+
+
+def test_corrupt_buffer_breaks_checksum_deterministically():
+    from shallowspeed_tpu.checkpoint import content_checksum
+
+    arrays = {"w0": np.arange(64, dtype=np.float32).reshape(8, 8)}
+    stamped = content_checksum(arrays)
+    offs = faults.corrupt_buffer(arrays, seed=4)
+    assert offs and content_checksum(arrays) != stamped
+    arrays2 = {"w0": np.arange(64, dtype=np.float32).reshape(8, 8)}
+    assert faults.corrupt_buffer(arrays2, seed=4) == offs
+    with pytest.raises(ValueError, match="corrupt"):
+        faults.corrupt_buffer({})
+
+
+def test_async_kill_and_resume_bitwise_equals_uninterrupted(
+    data_dir, tmp_path
+):
+    """The headline contract survives the move off the step path: a run
+    checkpointing ASYNCHRONOUSLY dies mid-run (die@step, with saves still
+    in flight through the bounded writer), resume auto discovers only
+    fully-verifying snapshots, and the finish is bitwise the twin's. The
+    v8 checkpoint records carry async/queue-depth/off-path evidence."""
+    twin = _session(data_dir, optimizer="momentum")
+    for _ in range(2):
+        twin.train_epoch()
+
+    ck = tmp_path / "ck"
+    jsonl = tmp_path / "killed.jsonl"
+    with JsonlMetrics(jsonl) as m:
+        run = _session(
+            data_dir, optimizer="momentum", checkpoint_dir=ck,
+            async_checkpoint=True, faults="die@step=5", metrics=m,
+        )
+        with pytest.raises(faults.InjectedFault):
+            while run.epoch < 2:
+                run.train_steps(2)
+                run.save_step_checkpoint()
+        run.close()  # the die left the writer alive: drain it
+    recs = [r for r in read_jsonl(jsonl) if r["kind"] == "checkpoint"]
+    assert recs and all(r["async"] is True for r in recs)
+    assert all(
+        r["verify_s"] >= 0 and r["write_s"] >= 0 and r["queue_depth"] >= 0
+        for r in recs
+    )
+    # every discoverable snapshot fully verifies (no torn file ever
+    # rename-visible), and resume lands on the newest one
+    res = _session(
+        data_dir, optimizer="momentum", checkpoint_dir=ck, resume="auto",
+    )
+    assert res._recovery["skipped"] == []
+    assert res.global_step == 5
+    while res.epoch < 2:
+        res.train_steps(2)
+    assert res.model_hash() == twin.model_hash()
+
+
+def test_async_halt_flush_stays_synchronous_and_drains_first(
+    data_dir, tmp_path
+):
+    """The PR 6 health-halt flush contract under async checkpointing: the
+    halt snapshot is written SYNCHRONOUSLY (the process is unwinding — a
+    snapshot parked in a daemon queue would die with it) after draining
+    whatever the writer still holds, so discovery sees the full history:
+    healthy grid saves, then the non-finite halt snapshot it skips."""
+    jsonl = tmp_path / "halt.jsonl"
+    ck = tmp_path / "ck"
+    with JsonlMetrics(jsonl) as m:
+        run = _session(
+            data_dir, checkpoint_dir=ck, async_checkpoint=True,
+            health="halt", faults="nan@step=3", metrics=m,
+        )
+        with pytest.raises(HealthError):
+            while run.epoch < 2:
+                run.train_steps(2)
+                run.save_step_checkpoint()
+    steps = [gs for gs, _ in list_step_checkpoints(ck)]
+    assert steps == [2, 3, 4]
+    recs = [r for r in read_jsonl(jsonl) if r["kind"] == "checkpoint"]
+    by_reason = {r["name"]: r for r in recs}
+    assert by_reason["halt"]["async"] is False  # the flush stayed sync
+    assert by_reason["step"]["async"] is True
+    res = _session(data_dir, checkpoint_dir=ck, resume="auto")
+    assert res.resumed_from == str(step_checkpoint_path(ck, 3))
+
+
+def test_writer_failure_surfaces_on_the_training_thread(data_dir, tmp_path):
+    """A writer-side failure (here: an injected in-window die) must
+    re-raise on the thread that owns the training loop — at the next
+    save or drain — never vanish into a daemon-thread traceback."""
+    ck = tmp_path / "ck"
+    run = _session(
+        data_dir, checkpoint_dir=ck, async_checkpoint=True,
+        faults="die@save=1",
+    )
+    run.train_steps(1)
+    run.save_step_checkpoint()  # save 0: fine
+    run.train_steps(1)
+    run.save_step_checkpoint()  # save 1: dies inside the write window
+    with pytest.raises(faults.InjectedFault, match="die@save=1"):
+        run.drain_checkpoints()
+    # the failed save never became visible; the good one verifies
+    assert [gs for gs, _ in list_step_checkpoints(ck)] == [1]
+
+
+def test_resume_auto_reads_the_snapshot_exactly_once(
+    data_dir, tmp_path, monkeypatch
+):
+    """The folded double read: discovery verifies (read+checksum) the
+    chosen snapshot and resume assembles from THOSE arrays — one read
+    total of the restored file, where PR 6 documented a deliberate
+    second verify-read."""
+    from shallowspeed_tpu import checkpoint as C
+
+    ck = tmp_path / "ck"
+    run = _session(data_dir, checkpoint_dir=ck)
+    run.train_steps(2)
+    run.save_step_checkpoint()
+    reads = []
+    real = C._read_arrays
+
+    def counting(path):
+        reads.append(str(path))
+        return real(path)
+
+    monkeypatch.setattr(C, "_read_arrays", counting)
+    res = _session(data_dir, checkpoint_dir=ck, resume="auto")
+    assert res.global_step == 2
+    assert reads == [str(step_checkpoint_path(ck, 2))]  # exactly one read
+
+
+def test_rotation_trusts_the_snapshot_it_just_wrote(
+    data_dir, tmp_path, monkeypatch
+):
+    """Review fix: rotation inside run_save_stages must trust the snapshot
+    written moments earlier in the same stage pipeline (its checksum was
+    computed in-process) — otherwise EVERY rotating save re-reads and
+    re-checksums its own file, the exact redundant verify-read the
+    trusted ranking exists to skip."""
+    from shallowspeed_tpu import checkpoint as C
+
+    ck = tmp_path / "ck"
+    run = _session(data_dir, checkpoint_dir=ck, checkpoint_keep=2)
+    for _ in range(3):
+        run.train_steps(1)
+        run.save_step_checkpoint()
+    verified = []
+    real = C.verify_checkpoint
+
+    def counting(path, **kw):
+        verified.append(str(path))
+        return real(path, **kw)
+
+    monkeypatch.setattr(C, "verify_checkpoint", counting)
+    run.train_steps(1)
+    run.save_step_checkpoint()  # rotation fires (4 snapshots > keep=2)
+    # this session wrote every candidate finite: rotation re-verifies NONE
+    assert verified == []
+    assert [gs for gs, _ in list_step_checkpoints(ck)] == [3, 4]
+
+
+def test_corrupt_save_injection_never_rotates_away_the_good_snapshot(
+    data_dir, tmp_path
+):
+    """Review fix: a corrupt@save-injected snapshot is finite in its
+    metadata but can never verify — it must count as UNUSABLE everywhere
+    the finite flag gates: no rotation off it, never added to the
+    trusted set. With keep=1 the corrupted save must not delete the one
+    good snapshot the fallback path exists to land on."""
+    ck = tmp_path / "ck"
+    run = _session(
+        data_dir, checkpoint_dir=ck, checkpoint_keep=1,
+        faults="corrupt@save=1",
+    )
+    run.train_steps(1)
+    run.save_step_checkpoint()  # save 0: good (rotation may run)
+    run.train_steps(1)
+    run.save_step_checkpoint()  # save 1: corrupted in flight
+    run.drain_checkpoints()
+    # both files visible; the corrupt one neither rotated the good one
+    # away nor entered the trusted set
+    steps = [gs for gs, _ in list_step_checkpoints(ck)]
+    assert steps == [1, 2]
+    assert str(step_checkpoint_path(ck, 2)) not in run._trusted_snapshots
+    res = _session(data_dir, checkpoint_dir=ck, resume="auto")
+    assert res.resumed_from == str(step_checkpoint_path(ck, 1))
+    assert res._recovery["skipped"] and "checksum" in (
+        res._recovery["skipped"][0]["cause"]
+    )

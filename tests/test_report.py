@@ -659,3 +659,70 @@ def test_report_fleet_section(tmp_path, capsys):
     assert report.build_report(read_jsonl(plain))["fleet"] is None
     assert report.main([str(plain), "--format", "md"]) == 0
     assert "## Fleet" not in capsys.readouterr().out
+
+
+def test_report_reliability_async_and_aot_rows(tmp_path, capsys):
+    """The schema-v8 Reliability additions: async saves render their
+    off-path accounting next to the (now on-path-only) overhead
+    fraction, the aot_cache records fold into a hit-rate row with the
+    degraded outcomes named, and the Degradation breaker line carries
+    the reload's single-read verify time."""
+    path = tmp_path / "v8.jsonl"
+    with JsonlMetrics(path) as m:
+        with m.span("train_steps"):
+            pass
+        for gs in (4, 8):
+            m.checkpoint(
+                "step", path=f"/ck/step-{gs:08d}.npz", epoch=0,
+                step_in_epoch=gs, global_step=gs, bytes=4096,
+                wall_s=0.002, **{"async": True}, queue_depth=1,
+                verify_s=0.1, write_s=0.15, queued_s=0.001,
+            )
+        m.aot_cache("miss", program="inference_r4", key="k1")
+        m.aot_cache("store", program="inference_r4", key="k1", bytes=100)
+        m.aot_cache("hit", program="inference_r4", key="k1", wall_s=0.004)
+        m.aot_cache("hit", program="inference_r8", key="k2", wall_s=0.006)
+        m.aot_cache(
+            "corrupt", program="inference_r2", key="k3",
+            reason="payload sha256 mismatch",
+        )
+    rep = report.build_report(read_jsonl(path))
+    rel = rep["reliability"]
+    assert rel["checkpoints_async"] == 2
+    assert rel["checkpoint_off_path_s"] == pytest.approx(0.5)
+    # on-path wall only: async saves cost milliseconds on the step path
+    assert rel["checkpoint_wall_s"] == pytest.approx(0.004)
+    aot = rel["aot_cache"]
+    assert aot["hits"] == 2 and aot["misses"] == 1
+    assert aot["hit_rate"] == pytest.approx(2 / 3)
+    assert aot["corrupt"] == 1 and aot["stores"] == 1
+
+    assert report.main([str(path), "--format", "md"]) == 0
+    out = capsys.readouterr().out
+    assert "async checkpointing: 2 of 2 saves off-path" in out
+    assert "aot executable cache: 2 hit(s) / 1 miss(es)" in out
+    assert "hit rate 67%" in out
+    assert "1 corrupt entr(ies) fell back to a clean recompile" in out
+
+    # an aot-only stream (a serving replica) still gets the section
+    aot_only = tmp_path / "aot_only.jsonl"
+    with JsonlMetrics(aot_only) as m:
+        m.aot_cache("hit", program="inference_r4", key="k1", wall_s=0.004)
+    rep2 = report.build_report(read_jsonl(aot_only))
+    assert rep2["reliability"]["aot_cache"]["hits"] == 1
+
+    # reload verify accounting reaches the Degradation breaker line
+    deg = tmp_path / "deg.jsonl"
+    with JsonlMetrics(deg) as m:
+        m.serving("summary", completed=5, dropped=0, breaker_trips=1,
+                  reloads=1, recovery_s=0.02)
+        m.serving_health("breaker_open", dispatch=3, consecutive_failures=3)
+        m.reload("ok", path="/ck/step-00000008.npz", step=8,
+                 reason="breaker", wall_s=0.03, verify_s=0.012)
+    rep3 = report.build_report(read_jsonl(deg))
+    assert rep3["serving"]["degradation"]["reload_verify_s"] == pytest.approx(
+        0.012
+    )
+    assert report.main([str(deg), "--format", "md"]) == 0
+    out3 = capsys.readouterr().out
+    assert "snapshot verify" in out3 and "single-read" in out3

@@ -165,6 +165,30 @@ def main():
         "newest recovery)",
     )
     ap.add_argument(
+        "--async-checkpoint",
+        action="store_true",
+        help="write step checkpoints through the background writer: the "
+        "step path pays only the device->host snapshot + a bounded-queue "
+        "enqueue, while sha256/finiteness verification, the "
+        "write-fsync-rename sequence and rotation run off-path "
+        "(docs/robustness.md 'The async writer'). Crash windows are "
+        "identical to the synchronous path — a kill at any instant "
+        "leaves only fully-verifying snapshots discoverable — and the "
+        "run drains the writer before exiting",
+    )
+    ap.add_argument(
+        "--aot-cache",
+        default=None,
+        metavar="DIR",
+        help="AOT executable cache directory: compiled programs (the "
+        "inference rung ladder, the epoch audit probe) are serialized "
+        "here and cold starts deserialize instead of recompiling — "
+        "keyed by layout + jaxlib/backend fingerprint + lowered-program "
+        "hash, re-verified by the audit census before first dispatch, "
+        "falling back to a clean recompile on any corruption "
+        "(docs/performance.md)",
+    )
+    ap.add_argument(
         "--resume",
         default=None,
         help="checkpoint to resume from (any layout -> any layout), or "
@@ -305,6 +329,8 @@ def main():
         )
     if args.resume == "auto" and args.checkpoint_dir is None:
         ap.error("--resume auto discovers snapshots in --checkpoint-dir")
+    if args.async_checkpoint and args.checkpoint_dir is None:
+        ap.error("--async-checkpoint needs --checkpoint-dir")
     if args.resume == "auto" and args.fused_run:
         ap.error(
             "--resume auto may land mid-epoch, and the fused run has no "
@@ -364,6 +390,8 @@ def main():
             kernel_backend=args.kernel_backend,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_keep=args.keep,
+            async_checkpoint=args.async_checkpoint,
+            aot_cache_dir=args.aot_cache,
         )
     except CheckpointError as e:
         # unrecoverable checkpoint state: the named file (or every snapshot
@@ -529,6 +557,24 @@ def main():
             metrics.close()
             print(f"telemetry written: {metrics.path}")
         sys.exit(3)
+    finally:
+        # EVERY exceptional exit drains the async checkpoint writer — a
+        # KeyboardInterrupt or a failing eval must not strand accepted
+        # snapshots in a daemon thread's queue. Best-effort only while
+        # an exception is propagating (a drain failure must never mask
+        # it); the clean path closes below, LOUDLY, so writer errors
+        # still fail the run.
+        if sys.exc_info()[0] is not None:
+            try:
+                run.close()
+            except Exception as e:  # noqa: BLE001 — never mask the exit
+                print(
+                    f"checkpoint writer drain failed: {e}", file=sys.stderr
+                )
+    # drain the async checkpoint writer BEFORE claiming success: a clean
+    # exit must leave every accepted snapshot durable (writer-side
+    # failures re-raise here instead of dying silently in a daemon thread)
+    run.close()
     print(
         f"Epoch: {run.epoch}, Time Spent: {time.time() - t0:.2f}s, "
         f"Accuracy: {final_acc * 100:.2f}%"
