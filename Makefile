@@ -6,10 +6,11 @@ CPU_MESH = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 # verify needs bash (pipefail / PIPESTATUS)
 SHELL := /bin/bash
 
-.PHONY: test verify metrics-smoke report-smoke audit-smoke overlap-smoke \
-        split-smoke tp-smoke recovery-smoke aot-smoke serve-smoke \
-        chaos-smoke fleet-smoke bench-serving bench-ckpt-aot data train \
-        train-mesh bench bench-scaling schedules clean
+.PHONY: test verify lint analyze-smoke metrics-smoke report-smoke \
+        audit-smoke overlap-smoke split-smoke tp-smoke recovery-smoke \
+        aot-smoke serve-smoke chaos-smoke fleet-smoke bench-serving \
+        bench-ckpt-aot data train train-mesh bench bench-scaling \
+        schedules clean
 
 test:
 	python -m pytest tests/ -q
@@ -17,6 +18,35 @@ test:
 # the ROADMAP tier-1 command, verbatim — the gate every PR must keep green
 verify:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# the house-rule linter (shallowspeed_tpu/analysis/lint.py,
+# docs/static-analysis.md): repo-wide AST rules — justified broad
+# excepts, strict-JSON metrics writes, the one-atomic-write discipline,
+# the donation whitelist, the metrics schema-kind registry, lock
+# discipline. Exit 0 clean / 2 with file:line findings; --format json
+# is the stable machine-readable mode. Also run inside tier-1
+# (tests/test_lint.py::test_repo_is_lint_clean).
+lint:
+	python -m shallowspeed_tpu.analysis.lint
+
+# static program analysis end-to-end (docs/static-analysis.md): every
+# training layout (seq, dp2, gpipe-pp4, zero1-dp2xpp2) compiled with
+# --audit + one serving rung — the lowering-time passes (send/recv
+# match, MPMD deadlock-freedom, stash lifetime) and the HLO donation
+# dispatch-safety pass all green BEFORE first dispatch, the report CLI
+# renders the Static checks row — then one deliberately-broken program
+# per check class (unmatched send, leaked stash, cyclic wait, donating
+# executable) each asserted REFUSED naming the offending tick/evidence
+analyze-smoke:
+	rm -rf /tmp/asmoke; mkdir -p /tmp/asmoke
+	python -c "import numpy as np; from pathlib import Path; d=Path('/tmp/asmoke/data'); d.mkdir(parents=True); rng=np.random.RandomState(0); [(np.save(d/('x_'+s+'.npy'), rng.rand(n,784).astype(np.float32)), np.save(d/('y_'+s+'.npy'), np.eye(10,dtype=np.float32)[rng.randint(0,10,n)])) for s,n in (('train',256),('val',96))]"
+	$(CPU_MESH) python scripts/analyze_smoke.py --phase clean \
+	    --data-dir /tmp/asmoke/data --out-dir /tmp/asmoke
+	$(CPU_MESH) python scripts/analyze_smoke.py --phase violate
+	python -m shallowspeed_tpu.observability.report /tmp/asmoke/pp4.jsonl \
+	    --format md > /tmp/asmoke/pp4.report.md
+	grep -q "static checks" /tmp/asmoke/pp4.report.md
+	@echo "analyze-smoke OK: four layouts + the serving rung ladder statically clean before dispatch, all injected violations refused, Static checks row rendered"
 
 # telemetry end-to-end smoke: 1 CPU epoch with --metrics-out, then assert
 # the file is non-empty valid JSONL with a per-epoch record (needs data:

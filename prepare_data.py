@@ -114,7 +114,7 @@ def prepare(save_dir: Path, source: str = "auto") -> str:
             x, y = loaders[name]()
             used = name
             break
-        except Exception as e:  # offline, missing sklearn, etc.
+        except Exception as e:  # noqa: BLE001 — any loader failure (offline, missing sklearn) falls through to the next source; the last cause is re-raised when all fail
             last_err = e
     if x is None:
         raise RuntimeError(f"all data sources failed; last error: {last_err}")
@@ -140,7 +140,7 @@ def prepare(save_dir: Path, source: str = "auto") -> str:
 
         pd.DataFrame(x_train).to_parquet(save_dir / "x_train.parquet")
         pd.DataFrame(x_val).to_parquet(save_dir / "x_val.parquet")
-    except Exception:
+    except Exception:  # noqa: BLE001 — parquet parity is best-effort (pandas/pyarrow are optional); the .npy files above are the real dataset
         pass
     print(
         f"wrote {save_dir} from source={used}: "

@@ -32,10 +32,15 @@ Design constraints, in contract order:
   ``jax_compilation_cache_dir`` — the jax-0.4.x persistent cache
   corrupts the CPU client's heap once cached pipeline programs and
   donated sequential steps mix in one process (the PR 1 segfault gate,
-  tests/conftest.py). The hazard class is avoided structurally: this
-  cache only ever DISPATCHES forward inference programs (which donate
-  nothing), and the one training program it touches (the epoch audit
-  probe) is census-read only, never dispatched;
+  tests/conftest.py). The hazard class is PROVEN absent per program,
+  not just avoided structurally: every executable this cache resolves
+  for DISPATCH passes the HLO dispatch-safety check
+  (``program_audit.verify_dispatch_safety`` parses
+  ``input_output_alias`` from the compiled text and refuses any
+  donation — api.py ``_aot_resolve(dispatch=True)``), while the one
+  donating program it touches (the epoch audit probe) stays
+  census-read only, never dispatched, and is resolved with
+  ``dispatch=False``;
 - **degrade to no-op, with a recorded reason**, on backends whose
   executables cannot serialize (``disabled`` event; ``supported``
   property) — the feature must never make a backend unusable.

@@ -596,6 +596,14 @@ class TrainingSession:
                     S.SCHEDULES[schedule], mubatches, pp, virtual=self.V,
                     backward_split=self._backward_split,
                 )
+            if self._metrics.enabled or self._audit_strict:
+                # program-level static analysis at lowering time, BEFORE
+                # anything compiles or dispatches: send/recv match, MPMD
+                # deadlock-freedom, stash lifetimes (analysis/;
+                # docs/static-analysis.md) — the machine-checked form of
+                # the invariants the lowering simulator constructs by
+                # simulation (the simulator is the spec, this is the proof)
+                self._record_static_analysis(prog, "epoch_program")
             if self._metrics.enabled:
                 # per-tick program stats, recorded once at lowering time:
                 # the executor's runtime tick behaviour is fully determined
@@ -752,8 +760,41 @@ class TrainingSession:
             self._kernel_backend, self._slot_rows,
         )
 
+    def _record_static_analysis(self, prog, program):
+        """The program-level static passes (shallowspeed_tpu/analysis)
+        over one lowered TickProgram: send/recv match & MPMD
+        deadlock-freedom over the tables, stash-lifetime discipline.
+        Run at lowering time — a violated contract raises
+        ``ProgramAnalysisError`` BEFORE the program can compile or
+        dispatch, with the evidence recorded first (schema-v9
+        ``static_analysis`` record, findings count + the finding text),
+        exactly the census's record-then-refuse shape."""
+        from shallowspeed_tpu.analysis import (
+            ProgramAnalysisError,
+            analyze_program,
+        )
+
+        try:
+            verdict = analyze_program(prog, program=program)
+        except ProgramAnalysisError as e:
+            if self._metrics.enabled:
+                self._metrics.static_analysis(
+                    program,
+                    passes=["send_recv", "deadlock", "stash"],
+                    findings=1,
+                    finding=str(e),
+                )
+                self._metrics.flush()  # the refusal evidence hits disk first
+            raise
+        if self._metrics.enabled:
+            self._metrics.static_analysis(
+                program,
+                **{k: v for k, v in verdict.items() if k != "program"},
+            )
+        return verdict
+
     def _aot_resolve(self, program, audit_label, jit_fn, args, expected,
-                     dedup):
+                     dedup, dispatch=False):
         """Resolve one compiled program through the AOT executable cache
         (shallowspeed_tpu/aot_cache.py): lower (milliseconds — tracing, no
         XLA), key on (layout, backend fingerprint, lowered-program hash),
@@ -768,7 +809,21 @@ class TrainingSession:
         recompile re-audits under the normal strict rules. Returns
         ``(compiled, from_cache)``; only a real compile bumps the
         ``jit_compiles`` counter, which is how the zero-recompile warm
-        start is pinned."""
+        start is pinned.
+
+        ``dispatch=True`` declares that the RESOLVED EXECUTABLE is the
+        dispatch path (the inference rungs, the sequential slot-predict
+        program) — then the HLO dispatch-safety pass
+        (``program_audit.verify_dispatch_safety``) additionally proves
+        the program donates no buffers before it can ever run: a
+        donating CACHE entry is treated like corruption (recorded
+        ``audit_mismatch`` + clean recompile), and a donating RECOMPILE
+        raises ``AuditMismatchError`` unlatched, because executing a
+        deserialized donating program is the jax-0.4.x heap-corruption
+        hazard and a donating serving program is a use-after-free (the
+        PR 1/PR 12 rule, now proven instead of assumed; probe-only
+        resolutions like the epoch audit probe keep ``dispatch=False``
+        — they lawfully donate and are never executed)."""
         aot = self._aot
         lowered = jit_fn.lower(*args)
         key = aot.key_for(program, self._aot_layout(), lowered.as_text())
@@ -780,10 +835,20 @@ class TrainingSession:
                 platform=self._cost_model.platform,
                 n_devices=self._cost_model.n_devices,
             )
+            reason = None
             if rec.get("census_ok") is False:
+                reason = "; ".join(rec.get("mismatches", ()))[:200]
+            elif dispatch:
+                try:
+                    program_audit.verify_dispatch_safety(
+                        compiled, context=program
+                    )
+                except program_audit.AuditMismatchError as e:
+                    reason = f"dispatch-safety: {e}"[:200]
+            if reason is not None:
                 aot.record(
                     "audit_mismatch", program=program, key=key,
-                    reason="; ".join(rec.get("mismatches", ()))[:200],
+                    reason=reason,
                 )
                 aot.record(
                     "fallback", program=program, key=key,
@@ -800,6 +865,10 @@ class TrainingSession:
         self._metrics.counter("jit_compiles")
         self._record_audit(compiled, audit_label, dedup=dedup,
                            expected=expected)
+        if dispatch:
+            # a freshly-compiled dispatch-path program that donates is a
+            # real lowering bug, not a bad cache entry: refuse, unlatched
+            program_audit.verify_dispatch_safety(compiled, context=program)
         aot.store(key, compiled, program=program)
         return compiled, False
 
@@ -1657,6 +1726,7 @@ class TrainingSession:
                     (self._params, x_shape),
                     expected=self._expected_comms,
                     dedup=("inference", "seq"),
+                    dispatch=True,
                 )
         return self._slot_predict
 
@@ -1685,15 +1755,20 @@ class TrainingSession:
         step = self._predict_cache.get(n_slots)
         if step is None:
             prog = self._lower_inference_prog(n_slots)
-            step = E.make_pipeline_step(
-                self.mesh, self.spec, prog,
-                self._slot_rows // self.dp, precision=self.precision,
-                kernel_backend=self._kernel_backend,
-            )
             need_audit = (
                 self._aot is not None
                 or self._metrics.enabled
                 or self._audit_strict
+            )
+            if need_audit:
+                # the serving rung's tick tables get the same lowering-
+                # time static passes as the epoch program — a malformed
+                # inference program never compiles, let alone serves
+                self._record_static_analysis(prog, f"inference_r{n_slots}")
+            step = E.make_pipeline_step(
+                self.mesh, self.spec, prog,
+                self._slot_rows // self.dp, precision=self.precision,
+                kernel_backend=self._kernel_backend,
             )
             expected = None
             if need_audit:
@@ -1722,6 +1797,7 @@ class TrainingSession:
                     f"inference_r{n_slots}", "inference_program", step,
                     (self._stacked, self._flags, x_shape),
                     expected=expected, dedup=("inference", n_slots),
+                    dispatch=True,
                 )
             elif self._metrics.enabled or self._audit_strict:
                 with self._metrics.span("jit_compile"):
@@ -1734,6 +1810,12 @@ class TrainingSession:
                     "inference_program",
                     dedup=("inference", n_slots),
                     expected=expected,
+                )
+                # serving-path dispatch safety: the rung must donate
+                # nothing (its params serve the very next request) —
+                # proven from the compiled HLO, unlatched like the census
+                program_audit.verify_dispatch_safety(
+                    compiled, context=f"inference_r{n_slots}"
                 )
             self._predict_cache[n_slots] = step
         return step

@@ -678,6 +678,12 @@ class AsyncCheckpointWriter:
         self._queue = queue_mod.Queue(maxsize=int(max_in_flight))
         self._faults = faults
         self._on_complete = on_complete
+        # the one cross-thread mutable: failures append on the writer
+        # thread and swap-drain on the submitting thread. The lock makes
+        # the discipline explicit (and machine-checked — the house-rule
+        # linter's SSP006 pass flags any unlocked touch) instead of
+        # leaning on CPython list-op atomicity.
+        self._errors_lock = threading.Lock()
         self._errors = []  # EVERY writer-side failure, in job order
         # completed trusted paths, writer-thread-confined: merged into
         # each job's (submit-time) trusted tuple so rotation never
@@ -702,9 +708,10 @@ class AsyncCheckpointWriter:
         failed job is kept (a disk-full burst fails several in a row, and
         swallowing the tail would let the caller believe those snapshots
         are durable); the first raises, carrying the rest by name."""
-        if not self._errors:
-            return
-        errs, self._errors = self._errors, []
+        with self._errors_lock:
+            if not self._errors:
+                return
+            errs, self._errors = self._errors, []
         first = errs[0]
         if len(errs) > 1:
             rest = "; ".join(
@@ -778,7 +785,8 @@ class AsyncCheckpointWriter:
             try:
                 self._process(job)
             except BaseException as e:  # noqa: BLE001 — surfaced on drain
-                self._errors.append(e)
+                with self._errors_lock:
+                    self._errors.append(e)
             finally:
                 self._queue.task_done()
 

@@ -80,6 +80,8 @@ Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
      "miss"|"store"|"stale"|"corrupt"|"audit_mismatch"|"fallback"|
      "disabled">, "program": ..., "key": ..., "wall_s": ...,
      "reason": ..., **fields}                                        [v8+]
+    {"v": 9, "ts": ..., "kind": "static_analysis", "name": <program |
+     "lint">, "passes": [...], "findings": n, **verdict}             [v9+]
 
 Schema compatibility rules (SCHEMA_VERSION history):
 
@@ -150,6 +152,19 @@ Schema compatibility rules (SCHEMA_VERSION history):
   changed meaning. The v8 reader accepts v1-v7 files unchanged and the
   strict refusal stays one-directional (a v9 file is refused).
 
+- v9  ADDITIVE: the ``static_analysis`` kind (one static-analysis
+  verdict, named by the program it covers for the compile-time passes —
+  send/recv match, MPMD deadlock-freedom, stash lifetime over the
+  lowered tick tables, plus the HLO dispatch-safety pass — or ``lint``
+  for a house-rule lint run; carries the pass list, per-pass stats and
+  the finding count; shallowspeed_tpu/analysis/,
+  docs/static-analysis.md), plus the ``SCHEMA_KINDS`` registry below —
+  the machine-readable half of this docstring, which the house-rule
+  linter enforces: a record kind not registered here cannot be emitted.
+  No existing kind or field changed meaning; the v9 reader accepts
+  v1-v8 files unchanged and the strict refusal stays one-directional
+  (a v10 file is refused).
+
 The contract for future bumps: additive kinds/fields bump the version and
 must keep old records readable; any change to an EXISTING kind's meaning
 requires a new kind name instead. Consumers must ignore unknown fields on
@@ -181,8 +196,41 @@ import time
 
 from shallowspeed_tpu.observability.spans import Span
 
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 SCHEMA_NAME = "shallowspeed_tpu.metrics"
+
+# The schema table: every record kind this schema version can write,
+# mapped to the SCHEMA_VERSION that introduced it (the machine-readable
+# half of the docstring above). This is a REGISTRY, not documentation:
+# the house-rule linter (shallowspeed_tpu/analysis/rules.py, rule
+# SSP005) parses it by AST and refuses any ``_emit`` whose "kind"
+# literal is absent — so adding a kind forces the schema-version
+# discipline (additive bump + history entry) instead of quietly leaking
+# an undocumented record shape into published JSONL. Keep it a pure
+# literal: the linter reads it with ast.literal_eval, without importing
+# (or depending on) this module's jax-adjacent imports.
+SCHEMA_KINDS = {
+    "meta": 1,
+    "counter": 1,
+    "gauge": 1,
+    "histogram": 1,
+    "timer": 1,
+    "span": 1,
+    "event": 1,
+    "step": 2,
+    "health": 2,
+    "xla_audit": 3,
+    "checkpoint": 4,
+    "recovery": 4,
+    "request": 5,
+    "serving": 5,
+    "serving_health": 6,
+    "reload": 6,
+    "fleet": 7,
+    "fleet_health": 7,
+    "aot_cache": 8,
+    "static_analysis": 9,
+}
 
 
 class _NullContext:
@@ -260,6 +308,9 @@ class NullMetrics:
         pass
 
     def aot_cache(self, name, **fields):
+        pass
+
+    def static_analysis(self, name, **fields):
         pass
 
     def flush(self):
@@ -365,6 +416,9 @@ class MetricsRecorder:
     def aot_cache(self, name, **fields):
         self._emit({"kind": "aot_cache", "name": name, **fields})
 
+    def static_analysis(self, name, **fields):
+        self._emit({"kind": "static_analysis", "name": name, **fields})
+
     # -- recorder-internal hooks --------------------------------------------
 
     def _record_span(self, span):
@@ -453,6 +507,14 @@ def _json_safe(value):
     if isinstance(value, (list, tuple)):
         return [_json_safe(v) for v in value]
     return value
+
+
+# public alias: every OTHER writer of record-shaped JSON (the report CLI's
+# --format json, trace_stats' per-op lines, the bench records) shares the
+# same sanitizer, so `json.dumps(..., allow_nan=False)` — which the
+# house-rule linter now demands on metrics paths (rule SSP002) — can never
+# crash on legitimately non-finite evidence values
+json_safe = _json_safe
 
 
 class JsonlMetrics(MetricsRecorder):

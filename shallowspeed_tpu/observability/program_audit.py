@@ -135,6 +135,124 @@ class AuditMismatchError(ValueError):
     analytical contract — either the lowering or the contract regressed."""
 
 
+# the HLO module header's donation evidence: ``input_output_alias={ {0}:
+# (0, {}, may-alias), {1,0}: (2, {1}, must-alias), ... }`` — each entry
+# maps an output (tuple) index to the (parameter number, parameter tuple
+# index, alias kind) whose buffer it reuses. jit's donate_argnums is what
+# puts entries here; a program with NO donation has no such clause.
+_ALIAS_MARKER = "input_output_alias={"
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{(?P<out>[0-9,\s]*)\}:\s*\(\s*(?P<param>\d+)\s*,\s*"
+    r"\{(?P<pidx>[0-9,\s]*)\}\s*(?:,\s*(?P<kind>[a-z_-]+)\s*)?\)"
+)
+
+
+def _alias_block(hlo_text):
+    """The brace-balanced body of the module header's
+    ``input_output_alias={...}`` clause, or None when the program
+    declares no aliasing. Brace-scanned, not regexed: the body nests
+    one brace level per tuple index and a paren-naive match would
+    truncate exactly the entries this pass exists to see."""
+    start = hlo_text.find(_ALIAS_MARKER)
+    if start < 0:
+        return None
+    i = start + len(_ALIAS_MARKER)
+    depth = 1
+    for j in range(i, min(len(hlo_text), i + 100_000)):
+        c = hlo_text[j]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return hlo_text[i:j]
+    return hlo_text[i:]  # unterminated header: parse what is there
+
+
+def parse_input_output_aliases(hlo_text):
+    """Every input/output buffer alias the compiled program declares, as
+    ``{"output_index", "param_number", "param_index", "kind"}`` dicts
+    (``kind`` is ``may-alias``/``must-alias``; empty list = the whole
+    parameter/output, not a tuple leaf). An empty list means the program
+    donates nothing — the property the dispatch-safety pass proves."""
+    body = _alias_block(hlo_text)
+    if body is None:
+        return []
+    out = []
+    for m in _ALIAS_ENTRY_RE.finditer(body):
+        out.append(
+            {
+                "output_index": [
+                    int(v) for v in m.group("out").split(",") if v.strip()
+                ],
+                "param_number": int(m.group("param")),
+                "param_index": [
+                    int(v) for v in m.group("pidx").split(",") if v.strip()
+                ],
+                "kind": m.group("kind") or "may-alias",
+            }
+        )
+    return out
+
+
+def donation_census(hlo_text):
+    """Aggregate donation evidence for one program: alias entry count,
+    the distinct donated parameter numbers, and the per-kind split —
+    the field set the ``static_analysis``/``xla_audit`` records carry."""
+    aliases = parse_input_output_aliases(hlo_text)
+    kinds = {}
+    for a in aliases:
+        kinds[a["kind"]] = kinds.get(a["kind"], 0) + 1
+    return {
+        "aliased_outputs": len(aliases),
+        "donated_params": sorted({a["param_number"] for a in aliases}),
+        "kinds": kinds,
+    }
+
+
+def check_dispatch_safety(hlo_text, context="compiled program"):
+    """The dispatch-safety leg: a program that will be DISPATCHED from a
+    deserialized (AOT-cache) executable, or that serves requests, must
+    not donate its buffers — executing a deserialized donating program
+    is the jax-0.4.x heap-corruption hazard PR 1 hit (conftest's
+    segfault gate), and a serving program's params are reused by the
+    very next dispatch, so donation there is a use-after-free by
+    construction (serving/engine.py). Returns a list of human-readable
+    mismatch strings (empty = dispatch-safe)."""
+    census = donation_census(hlo_text)
+    if not census["aliased_outputs"]:
+        return []
+    return [
+        f"{context}: program donates its input buffers "
+        f"(input_output_alias: {census['aliased_outputs']} aliased "
+        f"output(s) over params {census['donated_params']}, kinds "
+        f"{census['kinds']}) — dispatching it from a deserialized "
+        "executable or a serving path is the documented use-after-free "
+        "hazard (docs/static-analysis.md, docs/robustness.md)"
+    ]
+
+
+def verify_dispatch_safety(compiled_or_text, context="compiled program"):
+    """``check_dispatch_safety`` that fails loudly (AuditMismatchError,
+    unlatched like the census — a caught-and-retried caller re-verifies
+    and re-raises). Accepts a ``Compiled`` object or its ``as_text()``
+    dump; returns the donation census record on a pass. A backend that
+    exposes no HLO text yields ``None`` — no evidence, recorded as
+    unverifiable, never a silent pass/fail."""
+    text = compiled_or_text
+    if not isinstance(text, str):
+        try:
+            text = compiled_or_text.as_text()
+        except Exception:  # noqa: BLE001 — backend-optional surface
+            text = None
+    if text is None:
+        return None
+    mismatches = check_dispatch_safety(text, context=context)
+    if mismatches:
+        raise AuditMismatchError("; ".join(mismatches))
+    return donation_census(text)
+
+
 def _shape_bytes_each(type_str):
     """Byte size of every shape token in an HLO type (a shape, or a tuple
     of shapes), in order. Unknown dtypes count 0 bytes — the census must

@@ -75,7 +75,7 @@ import math
 import sys
 from pathlib import Path
 
-from shallowspeed_tpu.observability.metrics import read_jsonl
+from shallowspeed_tpu.observability.metrics import json_safe, read_jsonl
 from shallowspeed_tpu.observability.program_audit import format_bytes
 from shallowspeed_tpu.observability.stats import percentile
 
@@ -242,6 +242,7 @@ def build_report(records, source="", trace=None, slo_ms=None):
     reliability = _reliability_info(records, spans)
     serving = _serving_info(records, slo_ms)
     fleet = _fleet_info(records)
+    static_analysis = _static_analysis_info(records)
 
     return {
         "source": source,
@@ -284,6 +285,45 @@ def build_report(records, source="", trace=None, slo_ms=None):
         "reliability": reliability,
         "serving": serving,
         "fleet": fleet,
+        "static_analysis": static_analysis,
+    }
+
+
+def _static_analysis_info(records):
+    """Fold the schema-v9 ``static_analysis`` records into the one-line
+    Static checks verdict; None when the run recorded none (pre-v9 files
+    render exactly as before). One verdict per distinct program name —
+    last record wins, so a refused-then-fixed rerun reads fixed."""
+    by_program = {}
+    for r in records:
+        if r.get("kind") == "static_analysis":
+            by_program[r.get("name")] = r
+    if not by_program:
+        return None
+    passes = set()
+    total = 0
+    texts = []
+    for name, r in sorted(by_program.items()):
+        passes.update(r.get("passes") or ())
+        n = int(r.get("findings") or 0)
+        total += n
+        if not n:
+            continue
+        # compile-time passes carry ONE refusal text ("finding"); a lint
+        # run carries the per-finding lines ("finding_lines") — render
+        # whichever evidence the record holds, never an unnamed count
+        lines = r.get("finding_lines") or (
+            [r["finding"]] if r.get("finding") else []
+        )
+        if lines:
+            texts.extend(f"{name}: {line}" for line in lines)
+        else:
+            texts.append(f"{name}: {n} finding(s)")
+    return {
+        "programs": sorted(by_program),
+        "passes": sorted(passes),
+        "findings": total,
+        "finding_text": texts,
     }
 
 
@@ -794,6 +834,18 @@ def _rows(report):
             )
             detail = f"{share} of comm hideable (model bound; {sync})"
         rows.append(("overlap efficiency", detail))
+    sa = report.get("static_analysis")
+    if sa is not None:
+        if sa["findings"]:
+            detail = (
+                f"{sa['findings']} finding(s) — " + "; ".join(sa["finding_text"])
+            )
+        else:
+            detail = (
+                f"{len(sa['programs'])} program(s) clean "
+                f"({', '.join(sa['passes'])})"
+            )
+        rows.append(("static checks", detail))
     rows.append(("health", report["health"]["verdict"]))
     return rows
 
@@ -1206,7 +1258,10 @@ def render(report, fmt, comparison=None):
         out = dict(report)
         if comparison is not None:
             out["baseline_comparison"] = comparison
-        return json.dumps(out, indent=2)
+        # strict JSON like every record line: non-finite stats (a blown-up
+        # run's loss mean) become the sanitizer's string forms, never bare
+        # NaN tokens a downstream jq/ingest would choke on
+        return json.dumps(json_safe(out), indent=2, allow_nan=False)
     md = fmt == "md"
     lines = []
     title = f"Run report: {report['source']}"

@@ -25,7 +25,7 @@ import time
 try:  # jax is a hard dependency of the framework, but spans must degrade to
     # pure wall-clock timers if the profiler surface is ever unavailable
     from jax.profiler import TraceAnnotation as _TraceAnnotation
-except Exception:  # pragma: no cover - exercised only on crippled installs
+except Exception:  # noqa: BLE001 — degrade to wall-clock-only spans on crippled installs (pragma: no cover)
     _TraceAnnotation = None
 
 _tls = threading.local()

@@ -190,7 +190,9 @@ def main(argv=None):
     for t in traces:
         s = summarize(t)
         if args.json:
-            print(json.dumps(s))
+            from shallowspeed_tpu.observability.metrics import json_safe
+
+            print(json.dumps(json_safe(s), allow_nan=False))
         else:
             print(f"{s['trace']}:")
             for k, v in s.items():

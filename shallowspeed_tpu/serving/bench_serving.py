@@ -69,6 +69,7 @@ import time
 import numpy as np
 
 from shallowspeed_tpu import faults as F
+from shallowspeed_tpu.observability.metrics import json_safe
 from shallowspeed_tpu.serving.engine import ServingEngine
 from shallowspeed_tpu.serving.loadgen import (
     poisson_arrivals,
@@ -704,7 +705,7 @@ def main(argv=None):
             breaker_threshold=args.breaker,
             max_slots=args.max_slots,
         )
-        text = json.dumps(record, indent=2)
+        text = json.dumps(json_safe(record), indent=2, allow_nan=False)
         if args.chaos_out:
             with open(args.chaos_out, "w", encoding="utf-8") as f:
                 f.write(text + "\n")
@@ -752,7 +753,7 @@ def main(argv=None):
         rows_choices=tuple(int(r) for r in args.rows.split(",") if r.strip()),
         metrics=metrics,
     )
-    text = json.dumps(record, indent=2)
+    text = json.dumps(json_safe(record), indent=2, allow_nan=False)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
@@ -812,7 +813,7 @@ def _fleet_main(args, metrics):
         retry=args.fleet_retry,
         policy=args.fleet_policy,
     )
-    text = json.dumps(record, indent=2)
+    text = json.dumps(json_safe(record), indent=2, allow_nan=False)
     if args.fleet_out:
         with open(args.fleet_out, "w", encoding="utf-8") as f:
             f.write(text + "\n")

@@ -25,7 +25,14 @@ microbatches (arXiv 2004.09910). ``ServingEngine`` owns that loop on top of
   deliberately NOT used here: the params are reused by the very next
   dispatch (and by training), so donating their buffers would be a
   use-after-free, not an optimization — steady-state residency comes from
-  holding the arrays, the executor aliases them read-only;
+  holding the arrays, the executor aliases them read-only. Since PR 13
+  that is a PROVEN property, not a convention: every rung program's
+  compiled HLO passes the dispatch-safety check
+  (``program_audit.verify_dispatch_safety`` refuses any
+  ``input_output_alias`` on the serving path BEFORE a request is
+  served — docs/static-analysis.md), and the ``donate_argnums``
+  whitelist lint rule keeps donation out of this module at the source
+  level;
 - **accounting**: per-request enqueue -> dispatch -> complete timestamps,
   queue wait, padding waste, and a bounded queue-depth ring (the flight-
   recorder pattern) — emitted as schema-v5 ``request`` records plus a

@@ -245,7 +245,7 @@ def _worker_main(conn, config):
                     "error": f"{type(e).__name__}: {e}"[:500],
                 }
             )
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — the pipe to the parent is already dead; the fatal report is best-effort
             pass
         if inner is not None:
             inner.close()

@@ -1,0 +1,8 @@
+"""BLE001 bad twin: a broad except that swallows, with no justification."""
+
+
+def load(path):
+    try:
+        return open(path).read()
+    except Exception:  # MARK
+        pass

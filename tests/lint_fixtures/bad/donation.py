@@ -1,0 +1,5 @@
+"""SSP004 bad twin: donation outside the whitelisted modules."""
+
+
+def make_step(jax, step_impl):
+    return jax.jit(step_impl, donate_argnums=(0,))  # MARK

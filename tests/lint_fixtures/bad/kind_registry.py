@@ -1,0 +1,9 @@
+"""SSP005 bad twin: an _emit record kind missing from SCHEMA_KINDS."""
+
+
+class Recorder:
+    def _emit(self, record):
+        raise NotImplementedError
+
+    def shiny_new(self, name, **fields):
+        self._emit({"kind": "shiny_new_kind", "name": name, **fields})  # MARK

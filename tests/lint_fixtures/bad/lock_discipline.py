@@ -1,0 +1,19 @@
+"""SSP006 bad twin: a lock-guarded attribute touched outside the lock."""
+
+import threading
+
+
+class Sink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []
+
+    def append(self, item):
+        with self._lock:
+            self._buf = self._buf + [item]
+
+    def drain(self):
+        out = self._buf  # MARK
+        with self._lock:
+            self._buf = []
+        return out

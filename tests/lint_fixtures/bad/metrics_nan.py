@@ -1,0 +1,7 @@
+"""SSP002 bad twin: a metrics-path json.dumps without allow_nan=False."""
+
+import json
+
+
+def emit(record, f):
+    f.write(json.dumps(record) + "\n")  # MARK

@@ -1,0 +1,8 @@
+"""SSP003 bad twin: a non-atomic write in a durable-format module."""
+
+import json
+
+
+def save_entry(path, record):
+    with open(path, "w", encoding="utf-8") as f:  # MARK
+        json.dump(record, f)

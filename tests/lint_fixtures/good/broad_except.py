@@ -1,0 +1,22 @@
+"""BLE001 good twin: justified, narrowed, and re-raising broad excepts."""
+
+
+def load_justified(path):
+    try:
+        return open(path).read()
+    except Exception:  # noqa: BLE001 — probe is best-effort; absence is the signal
+        return None
+
+
+def load_narrow(path):
+    try:
+        return open(path).read()
+    except (OSError, UnicodeDecodeError):
+        return None
+
+
+def load_reraise(path):
+    try:
+        return open(path).read()
+    except Exception:
+        raise ValueError(f"unreadable: {path}")

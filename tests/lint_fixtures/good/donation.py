@@ -1,0 +1,6 @@
+"""SSP004 good twin: no donation outside the whitelist (serving-shaped
+code holds its buffers — the params serve the very next dispatch)."""
+
+
+def make_step(jax, step_impl):
+    return jax.jit(step_impl)

@@ -1,0 +1,12 @@
+"""SSP005 good twin: only registered record kinds reach _emit."""
+
+
+class Recorder:
+    def _emit(self, record):
+        raise NotImplementedError
+
+    def event(self, name, **fields):
+        self._emit({"kind": "event", "name": name, **fields})
+
+    def static_analysis(self, name, **fields):
+        self._emit({"kind": "static_analysis", "name": name, **fields})

@@ -1,0 +1,18 @@
+"""SSP006 good twin: every touch of the guarded attribute holds the lock."""
+
+import threading
+
+
+class Sink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []
+
+    def append(self, item):
+        with self._lock:
+            self._buf = self._buf + [item]
+
+    def drain(self):
+        with self._lock:
+            out, self._buf = self._buf, []
+        return out

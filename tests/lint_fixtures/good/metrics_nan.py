@@ -1,0 +1,7 @@
+"""SSP002 good twin: strict-JSON metrics writes."""
+
+import json
+
+
+def emit(record, f):
+    f.write(json.dumps(record, allow_nan=False) + "\n")

@@ -1,0 +1,16 @@
+"""SSP003 good twin: the durable write routed through atomic_write
+(reads stay unrestricted)."""
+
+import json
+
+from shallowspeed_tpu.checkpoint import atomic_write
+
+
+def save_entry(path, record):
+    payload = json.dumps(record, allow_nan=False).encode()
+    atomic_write(path, lambda f: f.write(payload), suffix=".json.tmp")
+
+
+def load_entry(path):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)

@@ -14,7 +14,11 @@ import pytest
 from shallowspeed_tpu import aot_cache as AC
 from shallowspeed_tpu import faults
 from shallowspeed_tpu.api import TrainingSession
-from shallowspeed_tpu.observability import MetricsRecorder, read_jsonl
+from shallowspeed_tpu.observability import (
+    SCHEMA_VERSION,
+    MetricsRecorder,
+    read_jsonl,
+)
 
 SIZES = (24, 20, 18, 16)
 
@@ -185,7 +189,9 @@ def test_aot_events_land_in_jsonl_with_schema_v8(data_dir, tmp_path):
         return
     names = [r["name"] for r in recs]
     assert "miss" in names and "store" in names
-    assert all(r["v"] == 8 and r.get("program") for r in recs)
+    # the live stamp follows SCHEMA_VERSION (the exact-version pin lives
+    # with the newest schema's test in test_observability.py)
+    assert all(r["v"] == SCHEMA_VERSION and r.get("program") for r in recs)
 
 
 def test_epoch_audit_probe_rides_the_cache_probe_only(data_dir, tmp_path):

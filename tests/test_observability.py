@@ -754,7 +754,7 @@ def test_schema_v7_fleet_kinds(tmp_path):
     serving fleet's evidence stream, every event tagged replica_id —
     round-trip with the version stamp, and the reader accepts v1-v6
     files unchanged. (The version pin and the one-ahead refusal live
-    with the NEWEST schema's test — test_schema_v8_async_ckpt_and_aot —
+    with the NEWEST schema's test — test_schema_v9_static_analysis —
     so a bump edits exactly one test.)"""
     path = tmp_path / "v7.jsonl"
     with JsonlMetrics(path) as m:
@@ -797,9 +797,9 @@ def test_schema_v7_fleet_kinds(tmp_path):
 def test_schema_v8_async_ckpt_and_aot(tmp_path):
     """Schema v8 (additive): the aot_cache kind plus the async-writer
     fields on checkpoint and verify_s on reload — round-trip with the
-    version stamp, the v8 reader accepts v1-v7 files unchanged, a v9
-    file is refused, and NullMetrics no-ops the new hook."""
-    assert SCHEMA_VERSION == 8
+    version stamp, the v8 reader accepts v1-v7 files unchanged, and
+    NullMetrics no-ops the new hook. (Version pin + one-ahead refusal
+    live with the newest schema's test, per the bump convention.)"""
     path = tmp_path / "v8.jsonl"
     with JsonlMetrics(path) as m:
         m.aot_cache("miss", program="inference_r4", key="ab12")
@@ -825,7 +825,7 @@ def test_schema_v8_async_ckpt_and_aot(tmp_path):
         "meta", "aot_cache", "aot_cache", "aot_cache", "aot_cache",
         "checkpoint", "reload",
     ]
-    assert all(r["v"] == 8 for r in recs)
+    assert all(r["v"] == SCHEMA_VERSION for r in recs)
     assert [r["name"] for r in recs if r["kind"] == "aot_cache"] == [
         "miss", "store", "hit", "corrupt",
     ]
@@ -842,12 +842,62 @@ def test_schema_v8_async_ckpt_and_aot(tmp_path):
         p = tmp_path / f"old-v{v}.jsonl"
         p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
         assert read_jsonl(p)[0]["kind"] == rec["kind"]
-    # one-directional refusal: a v9 file fails loudly
-    v9 = tmp_path / "v9.jsonl"
-    v9.write_text(json.dumps({"v": 9, "kind": "event"}) + "\n")
-    with pytest.raises(ValueError, match="newer"):
-        read_jsonl(v9)
     NullMetrics().aot_cache("hit", program="x")
+
+
+def test_schema_v9_static_analysis(tmp_path):
+    """Schema v9 (additive): the static_analysis kind (one verdict per
+    analyzed program: pass list, per-pass stats, finding count) plus the
+    SCHEMA_KINDS registry — round-trip with the version stamp, the v9
+    reader accepts v1-v8 files unchanged, a v10 file is refused, and
+    NullMetrics no-ops the new hook. Carries the version pin and the
+    one-ahead refusal (the newest-schema convention)."""
+    from shallowspeed_tpu.observability.metrics import SCHEMA_KINDS
+
+    assert SCHEMA_VERSION == 9
+    # the registry IS the docstring's kind list: every recorder hook has
+    # a registered kind, and the newest kind carries the newest version
+    assert SCHEMA_KINDS["static_analysis"] == 9
+    assert max(SCHEMA_KINDS.values()) == SCHEMA_VERSION
+    path = tmp_path / "v9.jsonl"
+    with JsonlMetrics(path) as m:
+        m.static_analysis(
+            "epoch_program",
+            passes=["send_recv", "deadlock", "stash"],
+            findings=0,
+            send_recv={"sends_fwd": 12, "sends_bwd": 12},
+            stash={"stash": {"peak": 4}},
+        )
+        m.static_analysis(
+            "inference_r2",
+            passes=["send_recv", "deadlock", "stash"],
+            findings=1,
+            finding="tick 3 stage 1: reads fwd mailbox slot 0 which holds"
+                    " no message",
+        )
+        m.static_analysis("lint", passes=["BLE001"], findings=0)
+    recs = read_jsonl(path)
+    assert [r["kind"] for r in recs] == [
+        "meta", "static_analysis", "static_analysis", "static_analysis",
+    ]
+    assert all(r["v"] == 9 for r in recs)
+    assert recs[1]["findings"] == 0 and recs[1]["send_recv"]["sends_fwd"] == 12
+    assert "tick 3" in recs[2]["finding"]
+    # v1-v8 files load unchanged under the v9 reader
+    for v, rec in (
+        (1, {"kind": "event", "name": "epoch", "epoch": 0, "loss": 0.5}),
+        (3, {"kind": "xla_audit", "name": "epoch_program", "census": {}}),
+        (8, {"kind": "aot_cache", "name": "hit", "program": "x"}),
+    ):
+        p = tmp_path / f"old-v{v}.jsonl"
+        p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
+        assert read_jsonl(p)[0]["kind"] == rec["kind"]
+    # one-directional refusal: a v10 file fails loudly
+    v10 = tmp_path / "v10.jsonl"
+    v10.write_text(json.dumps({"v": 10, "kind": "event"}) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        read_jsonl(v10)
+    NullMetrics().static_analysis("epoch_program", findings=0)
 
 
 def test_replica_shard_suffix_and_fallback_read(tmp_path):
