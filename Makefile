@@ -8,9 +8,9 @@ SHELL := /bin/bash
 
 .PHONY: test verify lint analyze-smoke metrics-smoke report-smoke \
         audit-smoke overlap-smoke split-smoke tp-smoke recovery-smoke \
-        aot-smoke serve-smoke chaos-smoke fleet-smoke bench-serving \
-        bench-ckpt-aot data train train-mesh bench bench-scaling \
-        schedules clean
+        aot-smoke serve-smoke chaos-smoke fleet-smoke trace-smoke \
+        bench-serving bench-ckpt-aot data train train-mesh bench \
+        bench-scaling schedules clean
 
 test:
 	python -m pytest tests/ -q
@@ -418,6 +418,47 @@ fleet-smoke:
 	    --requests 60 --rate 300 --seed 0 --slo-ms 2000 --verify \
 	    --metrics-out /tmp/fleet/serve_fleet.jsonl
 	@echo "fleet-smoke OK: 3-replica fleet survived a mid-soak SIGKILL — zero lost, worker-verified parity, failover + measured scale-up recovery, Fleet section rendered"
+
+# distributed request tracing end-to-end (docs/observability.md § Tracing):
+# a 2-replica fleet soak under seeded Poisson load with one injected
+# SIGKILL — every terminal request must leave a COMPLETE, clock-aligned
+# span chain across the parent + .r{replica_id} shards (zero
+# orphan/unclosed chains: the soak record's trace_problems field and an
+# independent strict re-verification both gate it), and the report CLI
+# must render the Tracing section (aggregate + p99-conditional phase
+# attribution, per-replica clock alignment with uncertainty, worst-k
+# request waterfalls). Then the measured op-issue roofline: a 1-epoch
+# gpipe-pp4 training run with --dispatch-probe must leave a
+# dispatch_overhead bench record (measured share + provenance — the
+# number docs/performance.md's CPU caveats cite) and the report must
+# render its row. Exit 0.
+trace-smoke:
+	rm -rf /tmp/tsmoke; mkdir -p /tmp/tsmoke
+	python -c "import numpy as np; from pathlib import Path; d=Path('/tmp/tsmoke/data'); d.mkdir(parents=True); rng=np.random.RandomState(0); [(np.save(d/('x_'+s+'.npy'), rng.rand(n,784).astype(np.float32)), np.save(d/('y_'+s+'.npy'), np.eye(10,dtype=np.float32)[rng.randint(0,10,n)])) for s,n in (('train',256),('val',96))]"
+	$(CPU_MESH) python -m shallowspeed_tpu.serving.bench_serving --fleet 2 \
+	    --data-dir /tmp/tsmoke/data --global-batch-size 32 \
+	    --kill-after 10 --requests 80 --rates 300 --slo-ms 2000 --seed 0 \
+	    --fleet-out /tmp/tsmoke/FLEET_TRACE.json \
+	    --metrics-out /tmp/tsmoke/trace.jsonl
+	python -c "import json; rec=json.load(open('/tmp/tsmoke/FLEET_TRACE.json')); assert rec['silently_lost']==[], 'LOST '+str(rec['silently_lost']); assert rec['killed_replica'] is not None, 'SIGKILL never fired'; assert rec['trace_chains'] and rec['trace_chains']>0, 'no span chains recorded'; assert rec['trace_problems']==[], 'INCOMPLETE CHAINS: %s' % rec['trace_problems'][:5]; print('soak record: %d span chains, zero orphan/unclosed across the kill' % rec['trace_chains'])"
+	python -c "from shallowspeed_tpu.observability.metrics import read_jsonl; from shallowspeed_tpu.observability import tracing; recs=read_jsonl('/tmp/tsmoke/trace.jsonl*'); chains=tracing.assemble_chains(recs); tracing.verify_terminal_chains(recs, chains, strict=True); offs=tracing.clock_offsets(recs); assert set(offs), 'no clock_offset records'; fo=[c for c in chains.values() if any(s['name']=='failover.requeue' for s in c.spans)]; att=tracing.attribution(chains, slo_ms=2000); assert att and att['phases_mean'], 'no attribution'; print('strict re-verify: %d chains complete, %d replicas aligned (max +/-%.2f ms), %d failover-linked chain(s)' % (len(chains), len(offs), 1e3*max(o['uncertainty_s'] for o in offs.values()), len(fo)))"
+	python -m shallowspeed_tpu.observability.report '/tmp/tsmoke/trace.jsonl*' \
+	    --format md --slo-ms 2000 > /tmp/tsmoke/trace.report.md
+	grep -q "## Tracing" /tmp/tsmoke/trace.report.md
+	grep -q "all terminal requests traced end to end" /tmp/tsmoke/trace.report.md
+	grep -q "clock alignment: " /tmp/tsmoke/trace.report.md
+	grep -q "phase attribution (mean): " /tmp/tsmoke/trace.report.md
+	grep -q "p99-conditional" /tmp/tsmoke/trace.report.md
+	grep -q "slowest requests:" /tmp/tsmoke/trace.report.md
+	$(CPU_MESH) python train.py --data-dir /tmp/tsmoke/data --epochs 1 \
+	    --global-batch-size 32 --no-eval --pp 4 --schedule gpipe --mubatches 4 \
+	    --dispatch-probe --dispatch-probe-out /tmp/tsmoke/DISPATCH.json \
+	    --metrics-out /tmp/tsmoke/train.jsonl
+	python -c "import json; rec=json.load(open('/tmp/tsmoke/DISPATCH.json')); assert rec['bench']=='dispatch_overhead' and rec['bench_version']==1; v=rec['value']; assert v is not None and 0.0 <= v < 1.0, 'unmeasured share %r' % v; assert rec['op_events']>0 and rec['provenance'], 'no measurement evidence'; print('dispatch-overhead record: %.1f%% of epoch wall is host-side op issue (%d op events, %s)' % (100*v, rec['op_events'], rec['op_source']))"
+	python -m shallowspeed_tpu.observability.report /tmp/tsmoke/train.jsonl \
+	    --format md > /tmp/tsmoke/train.report.md
+	grep -q "dispatch overhead" /tmp/tsmoke/train.report.md
+	@echo "trace-smoke OK: 2-replica kill-injected soak left a complete clock-aligned span chain for every terminal request, Tracing attribution + waterfalls rendered, measured dispatch-overhead record written"
 
 # the full offered-load sweep on the default layouts (see docs/serving.md)
 bench-serving:

@@ -32,7 +32,18 @@ evidence trail instead of prose:
                    interpolation) shared by the serving engine's summary,
                    the fleet summary and the report CLI's killed-run
                    fallback — three consumers, one definition, so p99 can
-                   never disagree with itself;
+                   never disagree with itself — plus the ONE
+                   first-enqueue→last-complete serving-window definition
+                   (``ThroughputWindow``) behind both summaries' rates;
+- ``tracing``      distributed request tracing (schema-v10 ``trace``
+                   records): the span ``Tracer`` the serving engine and
+                   fleet emit through, cross-process clock alignment from
+                   the fleet handshake's round-trip offset estimates, the
+                   chain reader that joins parent + ``.r*`` shards onto
+                   one parent timeline (refusing orphan/unclosed chains
+                   for terminal requests), and the phase-attribution /
+                   waterfall analysis behind the report's Tracing
+                   section;
 - ``costmodel``    analytical MLP FLOPs + ``Compiled.cost_analysis()``
                    cross-check + MFU accounting (``model_flops``,
                    ``achieved_flops_per_sec``, ``mfu`` gauges per layout);
@@ -72,7 +83,8 @@ from shallowspeed_tpu.observability.metrics import (
 )
 from shallowspeed_tpu.observability.program_audit import AuditMismatchError
 from shallowspeed_tpu.observability.spans import Span, capture, span
-from shallowspeed_tpu.observability.stats import percentile
+from shallowspeed_tpu.observability.stats import ThroughputWindow, percentile
+from shallowspeed_tpu.observability.tracing import TraceError, Tracer
 
 __all__ = [
     "SCHEMA_VERSION",
@@ -84,6 +96,9 @@ __all__ = [
     "MetricsRecorder",
     "NullMetrics",
     "Span",
+    "ThroughputWindow",
+    "TraceError",
+    "Tracer",
     "capture",
     "percentile",
     "read_jsonl",

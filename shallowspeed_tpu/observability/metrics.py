@@ -82,6 +82,12 @@ Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
      "reason": ..., **fields}                                        [v8+]
     {"v": 9, "ts": ..., "kind": "static_analysis", "name": <program |
      "lint">, "passes": [...], "findings": n, **verdict}             [v9+]
+    {"v": 10, "ts": ..., "kind": "trace",    "name": <span:
+     "fleet.queue"|"route"|"worker.queue"|"pack"|"dispatch"|"verify"|
+     "failover.requeue"|"ack" — or "clock_offset">, "trace_id": ...,
+     "span_id": ..., "parent_id": ...|null, "t0": ..., "t1": ...,
+     "clock": "parent"|"worker", "replica_id": r|null,
+     "terminal": bool, **fields}                                    [v10+]
 
 Schema compatibility rules (SCHEMA_VERSION history):
 
@@ -165,6 +171,27 @@ Schema compatibility rules (SCHEMA_VERSION history):
   v1-v8 files unchanged and the strict refusal stays one-directional
   (a v10 file is refused).
 
+- v10 ADDITIVE: the ``trace`` kind (distributed request tracing,
+  observability/tracing.py, docs/observability.md § Tracing): one CLOSED
+  span per record — named by the span type (``fleet.queue``/``route``/
+  ``worker.queue``/``pack``/``dispatch``/``verify``/``failover.requeue``/
+  ``ack``), carrying the ``trace_id`` every record of one request shares
+  across processes, a process-unique ``span_id``, the ``parent_id``
+  linkage (carried over the worker pipe alongside the request, so chains
+  stay connected across the process hop), raw ``t0``/``t1`` perf_counter
+  endpoints in the emitting process's clock domain (``clock``:
+  ``parent`` or ``worker``), the emitting ``replica_id``, and
+  ``terminal`` marking the one span that ends the request. The special
+  name ``clock_offset`` records the fleet handshake's per-replica
+  round-trip clock estimate (``offset_s``/``rtt_s``/``uncertainty_s``) —
+  what lets a reader place every shard on the parent timeline. The
+  EXISTING ``request`` kind additionally gains the ``trace_id`` field
+  (the join key from a request's terminal verdict to its span chain —
+  additive field on a known kind, lawful under the ignore-unknown-fields
+  rule). No existing kind or field changed meaning; the v10 reader
+  accepts v1–v9 files unchanged and the strict refusal stays
+  one-directional (a v11 file is refused).
+
 The contract for future bumps: additive kinds/fields bump the version and
 must keep old records readable; any change to an EXISTING kind's meaning
 requires a new kind name instead. Consumers must ignore unknown fields on
@@ -196,7 +223,7 @@ import time
 
 from shallowspeed_tpu.observability.spans import Span
 
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 SCHEMA_NAME = "shallowspeed_tpu.metrics"
 
 # The schema table: every record kind this schema version can write,
@@ -230,6 +257,7 @@ SCHEMA_KINDS = {
     "fleet_health": 7,
     "aot_cache": 8,
     "static_analysis": 9,
+    "trace": 10,
 }
 
 
@@ -311,6 +339,9 @@ class NullMetrics:
         pass
 
     def static_analysis(self, name, **fields):
+        pass
+
+    def trace(self, name, **fields):
         pass
 
     def flush(self):
@@ -418,6 +449,9 @@ class MetricsRecorder:
 
     def static_analysis(self, name, **fields):
         self._emit({"kind": "static_analysis", "name": name, **fields})
+
+    def trace(self, name, **fields):
+        self._emit({"kind": "trace", "name": name, **fields})
 
     # -- recorder-internal hooks --------------------------------------------
 

@@ -60,7 +60,19 @@ human or a bench gate actually asks of a run:
   JSONL shards on ``replica_id`` for each replica's own request
   stream — pass a glob like ``fleet.jsonl*`` to merge them), and the
   fleet availability verdict. Single-engine runs and pre-v7 files
-  render unchanged.
+  render unchanged;
+- a TRACING section (schema-v10 ``trace`` records joined by
+  ``observability.tracing``, docs/observability.md § Tracing): span
+  chains assembled across the parent + ``.r*`` shards with the
+  handshake-recorded per-replica clock offsets (shown with their
+  uncertainty), the chain-completeness verdict (orphan/unclosed chains
+  for terminal requests are NAMED, never glossed), aggregate phase
+  attribution — mean and p99-CONDITIONAL (which phase dominates the
+  slowest 1%, the makespan-quantization scoreboard) — SLO burn per
+  phase, and per-request text waterfalls for the worst-k requests.
+  Trace-free files render unchanged. A ``dispatch_overhead`` event (the
+  ``train.py --dispatch-probe`` measured op-issue roofline) renders as
+  its own summary row.
 
 ``--baseline`` compares throughput against another run's JSONL or a
 bench-style JSON record (``{"value": ..., "unit": "samples/s"}``, or a
@@ -243,6 +255,14 @@ def build_report(records, source="", trace=None, slo_ms=None):
     serving = _serving_info(records, slo_ms)
     fleet = _fleet_info(records)
     static_analysis = _static_analysis_info(records)
+    tracing_info = _tracing_info(records, slo_ms)
+
+    dispatch_overhead = None
+    for r in records:
+        if r.get("kind") == "event" and r.get("name") == "dispatch_overhead":
+            dispatch_overhead = {
+                k: v for k, v in r.items() if k not in ("v", "ts", "kind", "name")
+            }
 
     return {
         "source": source,
@@ -286,6 +306,59 @@ def build_report(records, source="", trace=None, slo_ms=None):
         "serving": serving,
         "fleet": fleet,
         "static_analysis": static_analysis,
+        "tracing": tracing_info,
+        "dispatch_overhead": dispatch_overhead,
+    }
+
+
+def _tracing_info(records, slo_ms=None):
+    """Fold the schema-v10 ``trace`` records into the Tracing story;
+    None when the run recorded none (trace-free and pre-v10 files render
+    exactly as before). Chains are assembled (and worker clocks aligned)
+    by ``observability.tracing``; the report NAMES incomplete chains
+    rather than rendering half a story as whole."""
+    if not any(r.get("kind") == "trace" for r in records):
+        return None
+    from shallowspeed_tpu.observability import tracing
+
+    chains = tracing.assemble_chains(records)
+    problems = tracing.verify_terminal_chains(records, chains)
+    att = tracing.attribution(chains, slo_ms=slo_ms)
+    offsets = tracing.clock_offsets(records)
+    degraded = sorted(
+        {
+            s.get("replica_id")
+            for c in chains.values()
+            if c.alignment == "missing"
+            for s in c.spans
+            if s.get("clock") == "worker"
+        }
+    )
+    worst = []
+    if att:
+        worst = [
+            {
+                "trace_id": c.trace_id,
+                "latency_s": c.latency_s,
+                "verdict": c.verdict,
+                "lines": tracing.waterfall(c),
+            }
+            for c in att.pop("worst")
+        ]
+    return {
+        "spans": sum(
+            1
+            for r in records
+            if r.get("kind") == "trace" and r.get("name") != "clock_offset"
+        ),
+        "chains": len(chains),
+        "problems": problems,
+        "alignment": {
+            str(rid): off for rid, off in sorted(offsets.items(), key=lambda kv: str(kv[0]))
+        },
+        "alignment_missing_replicas": degraded,
+        "attribution": att,
+        "worst": worst,
     }
 
 
@@ -834,6 +907,20 @@ def _rows(report):
             )
             detail = f"{share} of comm hideable (model bound; {sync})"
         rows.append(("overlap efficiency", detail))
+    do = report.get("dispatch_overhead")
+    if do is not None:
+        share = do.get("dispatch_overhead")
+        if share is None:
+            detail = "unmeasurable — " + str(do.get("reason", "no op events"))
+        else:
+            detail = (
+                f">= {_fmt_num(share, pct=True)} of {do.get('program')} "
+                f"wall is host-side op issue (op busy "
+                f"{_fmt_time_s(do.get('device_busy_s'))} of "
+                f"{_fmt_time_s(do.get('host_wall_s'))} uninstrumented "
+                f"wall; measured lower bound, {do.get('op_source')})"
+            )
+        rows.append(("dispatch overhead", detail))
     sa = report.get("static_analysis")
     if sa is not None:
         if sa["findings"]:
@@ -1253,6 +1340,84 @@ def _fleet_lines(fl, md):
     return lines
 
 
+def _tracing_lines(tr, md):
+    """The Tracing section: chain completeness, clock alignment (offset ±
+    uncertainty per replica), aggregate + p99-conditional phase
+    attribution, SLO burn, and the worst-k request waterfalls
+    (docs/observability.md § Tracing)."""
+    if not tr:
+        return []
+    lines = ["## Tracing" if md else "tracing:"]
+    line = f"span chains: {tr['chains']} ({tr['spans']} spans)"
+    if tr["problems"]:
+        line += f" — {len(tr['problems'])} INCOMPLETE:"
+        lines.append(line)
+        for p in tr["problems"][:10]:
+            lines.append(f"  {p}")
+    else:
+        line += " — all terminal requests traced end to end"
+        lines.append(line)
+    if tr["alignment"]:
+        parts = []
+        for rid, off in tr["alignment"].items():
+            if not _finite(off.get("offset_s")):
+                parts.append(f"r{rid} unestimated")
+                continue
+            parts.append(
+                f"r{rid} {off['offset_s'] * 1e3:+.3f} ms "
+                f"(±{off['uncertainty_s'] * 1e3:.3f} ms)"
+            )
+        lines.append("clock alignment: " + ", ".join(parts))
+    if tr["alignment_missing_replicas"]:
+        lines.append(
+            "ALIGNMENT DEGRADED: no clock offset recorded for replica(s) "
+            + ", ".join(str(r) for r in tr["alignment_missing_replicas"])
+            + " — their worker spans are unmapped"
+        )
+    att = tr.get("attribution")
+    if att:
+
+        def fmt_phases(ph):
+            return ", ".join(
+                f"{name} {share * 100:.1f}%"
+                for name, share in sorted(
+                    ph.items(), key=lambda kv: -kv[1]
+                )
+            )
+
+        lines.append(
+            "phase attribution (mean): " + fmt_phases(att["phases_mean"])
+        )
+        lines.append(
+            f"phase attribution (p99-conditional, slowest "
+            f"{att['p99_chains']} >= {_fmt_time_s(att['p99_latency_s'])}): "
+            + fmt_phases(att["phases_p99"])
+            + (
+                f" — tail dominated by {att['p99_dominant_phase']}"
+                if att.get("p99_dominant_phase")
+                else ""
+            )
+        )
+        if att.get("slo_burn"):
+            lines.append(
+                f"SLO burn per phase (mean share of the deadline budget, "
+                f"{att['slo_chains']} tagged request(s)): "
+                + ", ".join(
+                    f"{name} {b * 100:.1f}%"
+                    for name, b in sorted(
+                        att["slo_burn"].items(), key=lambda kv: -kv[1]
+                    )
+                )
+            )
+    if tr["worst"]:
+        lines.append("slowest requests:")
+        for w in tr["worst"]:
+            for wl in w["lines"]:
+                lines.append("  " + wl)
+    lines.append("")
+    return lines
+
+
 def render(report, fmt, comparison=None):
     if fmt == "json":
         out = dict(report)
@@ -1282,6 +1447,7 @@ def render(report, fmt, comparison=None):
     lines.extend(_reliability_lines(report.get("reliability"), md))
     lines.extend(_serving_lines(report.get("serving"), md))
     lines.extend(_fleet_lines(report.get("fleet"), md))
+    lines.extend(_tracing_lines(report.get("tracing"), md))
     header = "## Span breakdown" if md else "span breakdown:"
     lines.append(header)
     if report["spans"]:

@@ -1,4 +1,5 @@
-"""Shared summary statistics: the ONE percentile definition.
+"""Shared summary statistics: the ONE percentile definition, and the ONE
+serving-window definition.
 
 Three consumers quote latency percentiles — the serving engine's
 ``stats()`` summary, the fleet's fleet-wide summary, and the report CLI's
@@ -17,6 +18,19 @@ equality rather than approximates it. ``None`` samples are ignored (the
 recorders use None for "not measured") and an empty sample set returns
 ``None``, never 0.0 — an unmeasured percentile must not read as a fast
 one.
+
+``ThroughputWindow`` is the same move for the serving-rate denominator:
+the engine and the fleet each kept a copy-pasted
+``_first_enqueue_t``/``_last_complete_t`` pair to bound the window their
+``achieved_rps``/``goodput_rps`` divide by. One drifting copy would
+silently re-define goodput between the engine summary and the fleet
+summary on the same traffic; both now fold through this helper. The
+semantics are exactly the old fields': the window opens at the EARLIEST
+timestamp ever noted via ``note_enqueue`` (the engine notes completed
+requests' enqueue times, the fleet notes admission times — each caller
+keeps its historical call sites) and closes at the LATEST
+``note_complete``; ``window_s`` is ``None`` until both ends exist — an
+unmeasured window must not read as an instant one.
 """
 
 import numpy as np
@@ -30,3 +44,37 @@ def percentile(values, q):
     if not vals:
         return None
     return float(np.percentile(np.asarray(vals, np.float64), q))
+
+
+class ThroughputWindow:
+    """First-enqueue → last-complete serving window (module docstring):
+    the one definition of the wall-clock denominator behind
+    ``achieved_rps``/``goodput_rps`` in the engine and fleet summaries."""
+
+    __slots__ = ("first_enqueue_t", "last_complete_t")
+
+    def __init__(self):
+        self.first_enqueue_t = None
+        self.last_complete_t = None
+
+    def reset(self):
+        self.first_enqueue_t = None
+        self.last_complete_t = None
+
+    def note_enqueue(self, t):
+        """Earliest noted enqueue wins (requests can complete out of
+        enqueue order, so every caller notes and the min is kept)."""
+        if self.first_enqueue_t is None or t < self.first_enqueue_t:
+            self.first_enqueue_t = t
+
+    def note_complete(self, t):
+        """Latest noted completion wins."""
+        if self.last_complete_t is None or t > self.last_complete_t:
+            self.last_complete_t = t
+
+    @property
+    def window_s(self):
+        """Window length in seconds; ``None`` until both ends exist."""
+        if self.first_enqueue_t is None or self.last_complete_t is None:
+            return None
+        return float(self.last_complete_t - self.first_enqueue_t)

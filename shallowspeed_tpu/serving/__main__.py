@@ -40,6 +40,14 @@ submitted), drain everything already queued to a terminal verdict, flush
 the metrics sink, and exit under the normal code contract — a preempted
 server loses nothing it accepted.
 
+With ``--metrics-out`` every request also leaves a schema-v10 span chain
+(``trace`` records: queue/pack/dispatch/verify/ack — and, in fleet mode,
+the cross-process fleet.queue/route/failover spans plus the per-replica
+clock-offset handshake records in the parent file): render the Tracing
+section with ``python -m shallowspeed_tpu.observability.report
+<metrics-out>*`` to see per-phase latency attribution and the worst-k
+request waterfalls (docs/observability.md § Tracing).
+
 Exit codes (aligned with train.py's documented contract):
   0  clean — including a signal-drained run whose accepted requests all
      served;
@@ -381,7 +389,10 @@ def main(argv=None):
         failures += mismatched
     if metrics is not None:
         metrics.close()
-        print(f"telemetry written: {metrics.path}")
+        print(
+            f"telemetry written: {metrics.path} (request + trace records; "
+            "the report CLI renders the Serving and Tracing sections)"
+        )
     if engine.degraded:
         print("serving: engine DEGRADED at exit (breaker open)", file=sys.stderr)
         return 3
@@ -536,7 +547,11 @@ def _fleet_main(args):
         )
     if metrics is not None:
         metrics.close()
-        print(f"telemetry written: {metrics.path} (+ .r* replica shards)")
+        print(
+            f"telemetry written: {metrics.path} (+ .r* replica shards; "
+            "pass the glob to the report CLI for the merged Fleet and "
+            "Tracing sections)"
+        )
     failures = (
         rec["dropped"] + rec["expired"] + rec["errors"] + rec["unhealthy"]
         + rec["parity_mismatches"]

@@ -460,6 +460,26 @@ def fleet_chaos_soak(
         stats = fleet.record_summary(offered_rps=rate)
     finally:
         fleet.stop()
+    # the distributed-tracing gate (docs/observability.md § Tracing):
+    # with a JSONL sink attached, re-read the parent + .r* shards and
+    # assert every terminal request left a complete, clock-aligned span
+    # chain — a SIGKILL that orphans a chain is a tracing bug even when
+    # no request was lost (make trace-smoke gates on these fields)
+    trace_chains = trace_problems = None
+    metrics_path = getattr(metrics, "path", None)
+    if metrics_path:
+        from shallowspeed_tpu.observability import tracing
+        from shallowspeed_tpu.observability.metrics import read_jsonl
+
+        metrics.flush()
+        try:
+            recs = read_jsonl(f"{metrics_path}*")
+        except (OSError, ValueError) as e:
+            trace_problems = [f"trace shards unreadable: {e}"[:200]]
+        else:
+            chains = tracing.assemble_chains(recs)
+            trace_chains = len(chains)
+            trace_problems = tracing.verify_terminal_chains(recs, chains)
     lost = [r.id for r in submitted if r.verdict == "queued"]
     verdicts = {}
     for r in submitted:
@@ -500,6 +520,11 @@ def fleet_chaos_soak(
         "verdicts": verdicts,
         "silently_lost": lost,  # MUST be [] — the no-silent-loss invariant
         "parity_mismatches": stats.get("parity_mismatches"),
+        # span-chain completeness over the merged shards (None without a
+        # JSONL sink); trace_problems MUST be [] — zero orphan/unclosed
+        # chains across the kill, the trace-smoke gate
+        "trace_chains": trace_chains,
+        "trace_problems": trace_problems,
         "killed_replica": victim,
         "kill_t_s": kill_t,
         # how much un-acked work the SIGKILL destroyed — 0 means the
@@ -847,6 +872,11 @@ def _fleet_main(args, metrics):
         failures.append(f"{len(record['silently_lost'])} request(s) LOST")
     if record["parity_mismatches"]:
         failures.append(f"{record['parity_mismatches']} parity MISMATCH(ES)")
+    if record["trace_problems"]:
+        failures.append(
+            f"{len(record['trace_problems'])} incomplete span chain(s): "
+            + "; ".join(record["trace_problems"][:3])
+        )
     if record["killed_replica"] is None:
         failures.append(
             "the SIGKILL never fired (stream ended before --kill-after)"

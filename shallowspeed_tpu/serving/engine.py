@@ -78,6 +78,25 @@ submitted request reaches exactly one TERMINAL verdict, never silence:
   anchors) injects ``die``/``slow``/``nan``/``error`` faults into the
   dispatch loop deterministically — ``bench_serving``'s chaos soak and
   ``make chaos-smoke`` drive it.
+
+Clock-domain contract (docs/observability.md § Tracing): every request
+timestamp this engine records — ``enqueue_t``/``dispatch_t``/
+``complete_t`` on the ``Request``, the queue-depth ring samples, the
+schema-v5 ``request`` record fields — is a value of THIS process's
+``engine.clock`` (``time.perf_counter`` unless injected), so durations
+are exact and timestamps from two engines are NOT comparable. Standalone,
+that process is the one the caller lives in ("parent" clock); inside a
+fleet worker it is the WORKER's clock, and only the fleet handshake's
+recorded per-replica offset estimate places these values on the parent
+timeline (``observability.tracing``).
+
+Tracing (schema v10): with a metrics recorder attached, every request
+leaves a span chain — ``worker.queue`` (admission → dispatch pop),
+``pack``, ``dispatch``, ``verify``, and (standalone engines only) the
+terminal ``ack`` — keyed by a ``trace_id`` minted at submit, or carried
+in from the fleet router with the parent span id so chains stay linked
+across the pipe. Spans are emitted CLOSED, at the request's completion:
+a killed process leaves exactly the spans it finished.
 """
 
 import time
@@ -93,7 +112,8 @@ from shallowspeed_tpu.checkpoint import (
     find_newer_good,
 )
 from shallowspeed_tpu.observability import NullMetrics
-from shallowspeed_tpu.observability.stats import percentile
+from shallowspeed_tpu.observability.stats import ThroughputWindow, percentile
+from shallowspeed_tpu.observability.tracing import Tracer
 from shallowspeed_tpu.serving import slots as serving_slots
 
 # terminal request verdicts — every submitted request ends on exactly one
@@ -116,6 +136,9 @@ class Request:
         "result",
         "verdict",
         "attempts",
+        "trace_id",
+        "trace_parent",
+        "last_span_id",
     )
 
     def __init__(self, req_id, x, slots, deadline_ms, enqueue_t):
@@ -131,6 +154,13 @@ class Request:
         # queued -> ok | dropped | expired | error | unhealthy (terminal)
         self.verdict = "queued"
         self.attempts = 0  # failed dispatch attempts consumed so far
+        # distributed-tracing context (schema v10): the chain id minted at
+        # submit (or shipped in from the fleet router), the incoming
+        # parent span id, and the last span THIS engine emitted — what a
+        # fleet worker ships back so the parent's ack links to it
+        self.trace_id = None
+        self.trace_parent = None
+        self.last_span_id = None
 
     @property
     def latency_s(self):
@@ -196,6 +226,7 @@ class ServingEngine:
         loaded_step=None,
         shed_on_submit=False,
         faults=None,
+        tracer=None,
     ):
         self._session = session
         self._slot_rows = session.slot_rows
@@ -232,6 +263,13 @@ class ServingEngine:
         self._loaded_step = loaded_step  # watcher freshness floor
         self._shed_on_submit = bool(shed_on_submit)
         self._faults = F.make_plan(faults)
+        # request tracing (module docstring): a standalone engine owns
+        # its requests end to end — it mints trace ids and emits the
+        # terminal ack itself; a fleet worker passes its own tracer
+        # (worker clock domain, no terminal ack — the parent owns that)
+        self._tracer = (
+            tracer if tracer is not None else Tracer(self._metrics, process="e")
+        )
         self._latency_floor = None  # lazy: inference_latency_bound seconds
         # sequential sessions dispatch only the OCCUPIED slots (one fixed
         # program per slot — no rung program to round up to), so the
@@ -255,8 +293,9 @@ class ServingEngine:
         # sample per completion — never the Request itself, whose payload
         # and result arrays belong to the caller
         self._samples = []
-        self._first_enqueue_t = None
-        self._last_complete_t = None
+        # the shared first-enqueue -> last-complete window definition
+        # (observability/stats.py — the fleet folds through the same one)
+        self._window = ThroughputWindow()
         self._dropped = 0
         self._expired = 0
         self._errors = 0
@@ -313,7 +352,7 @@ class ServingEngine:
             )
         return self._latency_floor
 
-    def submit(self, x, deadline_ms=None, arrival_t=None):
+    def submit(self, x, deadline_ms=None, arrival_t=None, trace=None):
         """Enqueue one request of ``(rows, in_dim)`` inputs; returns its
         ``Request``. ``arrival_t`` backdates the enqueue timestamp to the
         request's scheduled arrival (the open-loop driver uses it so
@@ -324,6 +363,12 @@ class ServingEngine:
         returned with verdict "dropped"; under ``shed_on_submit`` a
         deadline the analytical wait estimate provably cannot meet is
         refused with verdict "expired" before costing queue space.
+
+        ``trace``: incoming trace context from the fleet router —
+        ``{"trace_id": ..., "parent": <route span id>}`` — so this
+        engine's spans link into the request's cross-process chain;
+        without it a tracing-enabled standalone engine mints its own
+        trace id here.
 
         Timeline consistency: the queue-depth ring samples at the SAME
         timestamp the request's own timeline uses (the backdated
@@ -344,15 +389,26 @@ class ServingEngine:
         t = self.clock() if arrival_t is None else float(arrival_t)
         req = Request(self._next_id, x, n_slots, deadline_ms, t)
         self._next_id += 1
+        if trace is not None:
+            req.trace_id = trace.get("trace_id")
+            req.trace_parent = trace.get("parent")
+        elif self._tracer.enabled and self._tracer.terminal_ack:
+            # only the request's OWNER mints ids: a fleet WORKER
+            # (terminal_ack=False) traces solely under shipped context —
+            # a self-minted worker chain could never get its terminal ack
+            # and would read as incomplete
+            req.trace_id = self._tracer.new_trace(req.id)
         if self._degraded:
             req.verdict = "dropped"
             self._dropped += 1
             self._record_request(req, reason="degraded")
+            self._trace_ack(req, reason="degraded")
             return req
         if self._max_queue is not None and len(self._queue) >= self._max_queue:
             req.verdict = "dropped"
             self._dropped += 1
             self._record_request(req, reason="queue_full")
+            self._trace_ack(req, reason="queue_full")
             return req
         if (
             self._shed_on_submit
@@ -363,6 +419,7 @@ class ServingEngine:
             req.complete_t = self.clock()
             self._expired += 1
             self._record_request(req, reason="admission_estimate")
+            self._trace_ack(req, reason="admission_estimate")
             return req
         self._queue.append(req)
         self._record_depth(t)
@@ -433,6 +490,7 @@ class ServingEngine:
             if self._deadline_hopeless(head, t_d):
                 self._queue.popleft()
                 self._complete_terminal(head, "expired", t_d, reason="deadline")
+                self._trace_queue_only(head, t_d, reason="deadline")
                 done.append(head)
                 continue
             if batch and used + head.slots > self._max_slots:
@@ -453,6 +511,7 @@ class ServingEngine:
             ],
             axis=0,
         )
+        t_pack = self.clock()  # pack span boundary: slots packed + padded
         try:
             for f in pending_faults:
                 if f.fired:
@@ -482,6 +541,7 @@ class ServingEngine:
             done.extend(self._recover_failed_dispatch(batch, seq, e))
             self._record_depth(self.clock())
             return done
+        t_preds = self.clock()  # dispatch span boundary: rung program done
         t_c = self.clock()
         off = 0
         any_unhealthy = False
@@ -492,18 +552,18 @@ class ServingEngine:
             if not np.isfinite(result).all():
                 any_unhealthy = True
                 self._complete_terminal(r, "unhealthy", t_c)
+                self._trace_dispatch_chain(r, t_d, t_pack, t_preds, rung)
                 done.append(r)
                 continue
             r.result = result
             r.complete_t = t_c
             r.verdict = "ok"
             self._record_request(r)
+            self._trace_dispatch_chain(r, t_d, t_pack, t_preds, rung)
             done.append(r)
             self._samples.append((r.latency_s, r.queue_s, r.deadline_ms))
-            if self._first_enqueue_t is None or r.enqueue_t < self._first_enqueue_t:
-                self._first_enqueue_t = r.enqueue_t
-            if self._last_complete_t is None or t_c > self._last_complete_t:
-                self._last_complete_t = t_c
+            self._window.note_enqueue(r.enqueue_t)
+            self._window.note_complete(t_c)
             self._useful_rows += r.rows
             # recovery time: breaker opened, then a response served again
             if self._breaker_opened_t is not None and not self._degraded:
@@ -542,6 +602,9 @@ class ServingEngine:
             if self._retry.exhausted(r.attempts):
                 self._complete_terminal(
                     r, "error", t, reason=f"{type(exc).__name__}: {exc}"[:200]
+                )
+                self._trace_queue_only(
+                    r, t, reason=f"{type(exc).__name__}"[:80]
                 )
                 terminal.append(r)
             else:
@@ -779,9 +842,64 @@ class ServingEngine:
             slo_ok=req.slo_ok(self._slo_ms),
             attempts=req.attempts,
         )
+        if req.trace_id is not None:
+            # the v10 join key from this terminal verdict to its span chain
+            fields["trace_id"] = req.trace_id
         if reason is not None:
             fields["reason"] = reason
         self._metrics.request(req.verdict, **fields)
+
+    # -- tracing (schema v10; module docstring span taxonomy) ---------------
+
+    def _trace_dispatch_chain(self, req, t_d, t_pack, t_preds, rung):
+        """The dispatched request's worker-side chain: worker.queue ->
+        pack -> dispatch -> verify (+ the terminal ack when this engine
+        owns the request end to end). The verify span covers the
+        finiteness gate; a fleet worker's bitwise-parity re-predict adds
+        its own verify span after this one."""
+        if req.trace_id is None:
+            return
+        tr = self._tracer
+        wq = tr.span(
+            "worker.queue", req.trace_id, req.enqueue_t, t_d,
+            parent=req.trace_parent,
+        )
+        pk = tr.span("pack", req.trace_id, t_d, t_pack, parent=wq)
+        dp = tr.span(
+            "dispatch", req.trace_id, t_pack, t_preds, parent=pk,
+            rung=rung, slots=req.slots,
+        )
+        req.last_span_id = tr.span(
+            "verify", req.trace_id, t_preds, req.complete_t, parent=dp,
+            healthy=req.verdict != "unhealthy",
+        )
+        self._trace_ack(req)
+
+    def _trace_queue_only(self, req, t, reason=None):
+        """A request that terminated without a dispatch of its own (shed
+        at pack time, retry budget exhausted): its chain is the queue
+        wait plus the terminal ack."""
+        if req.trace_id is None:
+            return
+        req.last_span_id = self._tracer.span(
+            "worker.queue", req.trace_id, req.enqueue_t, t,
+            parent=req.trace_parent, reason=reason,
+        )
+        self._trace_ack(req)
+
+    def _trace_ack(self, req, reason=None):
+        """The terminal span — standalone engines only (``terminal_ack``);
+        a fleet worker ships ``last_span_id`` back instead and the parent
+        emits the one ack per request."""
+        if req.trace_id is None or not self._tracer.terminal_ack:
+            return
+        t = req.complete_t if req.complete_t is not None else self.clock()
+        self._tracer.span(
+            "ack", req.trace_id, t, t,
+            parent=req.last_span_id or req.trace_parent,
+            terminal=True, verdict=req.verdict,
+            deadline_ms=req.deadline_ms, reason=reason,
+        )
 
     def _record_health(self, name, **fields):
         self._metrics.serving_health(name, **fields)
@@ -806,9 +924,7 @@ class ServingEngine:
             slo_flags.append(
                 None if bound is None or lat is None else lat <= bound / 1000.0
             )
-        window = None
-        if self._samples:
-            window = float(self._last_complete_t - self._first_enqueue_t)
+        window = self._window.window_s
         padded_rows = self._slots_dispatched * self._slot_rows
         depths = [d for _, d in self._depths]
         met = sum(1 for ok in slo_flags if ok)
@@ -881,8 +997,7 @@ class ServingEngine:
         (degraded flag, consecutive-failure count, loaded step, dispatch
         sequence) — are unaffected."""
         self._samples = []
-        self._first_enqueue_t = None
-        self._last_complete_t = None
+        self._window.reset()
         self._depths.clear()
         self._dropped = 0
         self._expired = 0

@@ -64,6 +64,29 @@ latency covers fleet queueing, the pipe hop, worker queueing, dispatch
 and any failover re-placements — ``recovery_s`` is replica-loss to the
 next served response, ``scale_up_s`` is spawn to ready (ladder warmed).
 
+Clock-domain contract (docs/observability.md § Tracing): every
+``FleetRequest`` timestamp and every parent-side span is a PARENT-process
+``perf_counter`` value; each WORKER's engine records its own clock's
+values into its ``.r{replica_id}`` shard (``clock: "worker"`` on its
+trace records). The two domains share no origin — the heartbeat
+handshake therefore round-trips ``clock_probe`` messages per worker and
+records the best offset estimate WITH its uncertainty (a ``clock_offset``
+trace record: ``offset = tw - (t0 + t1)/2``, uncertainty = half the round
+trip), which is what lets ``observability.tracing`` place all shards on
+one parent timeline when joining a request's cross-process span chain.
+
+Tracing (schema v10): with a metrics recorder attached every admitted
+request leaves a cross-process chain — parent-side ``fleet.queue`` /
+``route`` / ``failover.requeue`` / terminal ``ack`` spans in the parent's
+JSONL, worker-side ``worker.queue``/``pack``/``dispatch``/``verify``
+spans in the serving replica's shard — linked by the trace context the
+router ships alongside the request and the ``last_span_id`` each response
+carries back. A replica SIGKILLed mid-dispatch leaves its partial chain;
+the ``failover.requeue`` span links it to the surviving replica's
+completion, so the report's Tracing section can attribute the tail
+latency a death costs (``make trace-smoke`` gates on zero orphan
+chains).
+
 The same "many independent programs, dispatched asynchronously from one
 host" shape is where the MPMD pipeline direction (arXiv 2412.14374) is
 headed; this module's process/IPC plumbing is deliberately generic
@@ -80,7 +103,8 @@ import numpy as np
 from shallowspeed_tpu import retry as R
 from shallowspeed_tpu.observability import NullMetrics
 from shallowspeed_tpu.observability.metrics import replica_shard_path
-from shallowspeed_tpu.observability.stats import percentile
+from shallowspeed_tpu.observability.stats import ThroughputWindow, percentile
+from shallowspeed_tpu.observability.tracing import Tracer
 from shallowspeed_tpu.serving.router import (
     FleetRequest,
     ReplicaInfo,
@@ -161,7 +185,9 @@ def _pin_worker_backend():
 def _response_msg(req, fleet_id, engine, parity_ok):
     """One engine-terminal request, serialized for the pipe. The engine's
     breaker state and queue depth piggyback on every response — a
-    response is a fresher heartbeat than the heartbeat."""
+    response is a fresher heartbeat than the heartbeat. ``last_span_id``
+    carries the worker's newest span back so the parent's terminal ``ack``
+    links into the worker-side chain."""
     return {
         "kind": "response",
         "id": fleet_id,
@@ -173,6 +199,7 @@ def _response_msg(req, fleet_id, engine, parity_ok):
         "parity_ok": parity_ok,
         "degraded": engine.degraded,
         "queue_depth": engine.queue_depth,
+        "last_span_id": req.last_span_id,
     }
 
 
@@ -196,7 +223,14 @@ def _worker_main(conn, config):
     - ``verify``: re-compute every "ok" response with a direct
       ``session.predict()`` and ship the bitwise verdict (``parity_ok``);
     - ``metrics_path``: this replica's own ``.r{id}`` JSONL shard;
-    - ``heartbeat_s``: heartbeat cadence.
+    - ``heartbeat_s``: heartbeat cadence;
+    - ``clock_offset_s``: TEST HOOK — shift this worker's engine clock by
+      a constant, so the clock-alignment handshake can be proven to
+      reconstruct correct cross-process span durations against an
+      artificially skewed clock domain (tests/test_tracing.py).
+
+    The worker answers parent ``clock_probe`` messages immediately with
+    its engine clock — the round-trip half of the alignment handshake.
 
     Exit paths: a ``stop``/``drain`` control message, parent death (pipe
     EOF — a fleet worker never outlives its fleet), or a fatal setup
@@ -221,7 +255,23 @@ def _worker_main(conn, config):
         )
         tap = _HealthTap(inner)
         session = TrainingSession(metrics=inner, **session_kwargs)
-        engine = ServingEngine(session, metrics=tap, **engine_kwargs)
+        # the worker's clock domain: engine timestamps, trace spans and
+        # clock-probe replies all read the SAME clock, so the handshake
+        # offset maps every one of them onto the parent timeline (the
+        # test hook skews it to prove the alignment recovers it)
+        skew = float(config.get("clock_offset_s") or 0.0)
+        if skew:
+            clock = lambda: time.perf_counter() + skew  # noqa: E731
+        else:
+            clock = time.perf_counter
+        tracer = Tracer(
+            inner, process=f"r{rid}", replica_id=rid,
+            clock_domain="worker", terminal_ack=False,
+        )
+        engine = ServingEngine(
+            session, metrics=tap, clock=clock, tracer=tracer,
+            **engine_kwargs,
+        )
         # pre-compile the whole rung ladder BEFORE announcing ready: a
         # replica that would pay XLA inside its first requests' latency
         # must not take traffic yet (the scale_up contract)
@@ -294,17 +344,31 @@ def _worker_main(conn, config):
                                 "parity_ok": None,
                                 "degraded": engine.degraded,
                                 "queue_depth": engine.queue_depth,
+                                "last_span_id": None,
                             }
                         )
                     else:
                         req = engine.submit(
-                            msg["x"], deadline_ms=msg.get("deadline_ms")
+                            msg["x"], deadline_ms=msg.get("deadline_ms"),
+                            trace=msg.get("trace"),
                         )
                         if req.verdict == "queued":
                             fleet_ids[req.id] = fid
                         else:  # refused at admission (degraded / shed)
                             if not send(_response_msg(req, fid, engine, None)):
                                 return
+                elif kind == "clock_probe":
+                    # the alignment handshake's worker half: answer NOW
+                    # with the engine clock — every poll-loop microsecond
+                    # before this reply widens the parent's uncertainty
+                    # bound, never skews the estimate past it
+                    send(
+                        {
+                            "kind": "clock_probe_reply",
+                            "t0": msg["t0"],
+                            "tw": engine.clock(),
+                        }
+                    )
                 elif kind == "reload":
                     try:
                         engine.watch_reload()
@@ -329,9 +393,19 @@ def _worker_main(conn, config):
                         continue
                     parity = None
                     if verify and r.verdict == "ok":
+                        tv0 = engine.clock()
                         parity = bool(
                             np.array_equal(r.result, session.predict(r.x))
                         )
+                        # the parity re-predict is the expensive half of
+                        # verification — its own span, chained after the
+                        # engine's finiteness-gate verify
+                        sid = tracer.span(
+                            "verify", r.trace_id, tv0, engine.clock(),
+                            parent=r.last_span_id, parity=parity,
+                        )
+                        if sid is not None:
+                            r.last_span_id = sid
                     if not send(_response_msg(r, fid, engine, parity)):
                         return
                 if not send(_heartbeat_msg(engine, tap)):
@@ -395,6 +469,12 @@ class ReplicaHandle:
         self.inflight = {}  # fleet request id -> FleetRequest (un-acked)
         self.dead = False
         self.fatal_error = None
+        # clock-alignment handshake state: the best (lowest-uncertainty)
+        # round-trip offset estimate so far, when we last probed, and how
+        # many probes this replica has answered (bounds the refinement)
+        self.clock_offset = None  # {"offset_s", "rtt_s", "uncertainty_s"}
+        self.last_probe_t = None
+        self.probes_answered = 0
 
     def send(self, msg):
         if self.dead:
@@ -504,6 +584,11 @@ class ServingFleet:
         self._degraded = False
         self._stall_t = None
         self._impair_t = None  # replica lost / quorum lost, awaiting an ok
+        # request tracing (schema v10): the parent mints every trace id,
+        # emits the parent-side spans (fleet.queue/route/failover.requeue/
+        # terminal ack) and records each worker's clock-offset estimate
+        self._tracer = Tracer(self._metrics, process="f")
+        self._probe_every_s = 2.0  # re-probe cadence piggybacking heartbeats
         # completions collected OUTSIDE step() (wait_ready pumps the
         # pipes too) are stashed and returned by the next step() — a
         # completed request must always reach a caller's hands
@@ -513,10 +598,10 @@ class ServingFleet:
         # degrade it for the length of an XLA warm-up
         self._deferred_target = set()
         # accounting (the engine's scalar-samples discipline: latencies
-        # only, payloads stay with the caller)
+        # only, payloads stay with the caller); the serving window folds
+        # through the same shared helper the engine uses
         self._samples = []  # (latency_s, queue_s, deadline_ms)
-        self._first_enqueue_t = None
-        self._last_complete_t = None
+        self._serve_window = ThroughputWindow()
         self._dropped = 0
         self._expired = 0
         self._errors = 0
@@ -748,14 +833,16 @@ class ServingFleet:
         t = self.clock() if arrival_t is None else float(arrival_t)
         req = FleetRequest(self._next_request_id, x, deadline_ms, t)
         self._next_request_id += 1
-        if self._first_enqueue_t is None or t < self._first_enqueue_t:
-            self._first_enqueue_t = t
+        if self._tracer.enabled:
+            req.trace_id = self._tracer.new_trace(req.id)
+        self._serve_window.note_enqueue(t)
         if self._degraded:
             self._complete(req, "dropped", reason="fleet_degraded")
             return req
         if not self._router.admit(req):
             self._complete(req, "dropped", reason="fleet_queue_full")
             return req
+        req.admitted = True
         self._record_depth(t)
         return req
 
@@ -830,6 +917,13 @@ class ServingFleet:
                 wall_s=wall,
                 loaded_step=info.loaded_step,
             )
+            # the alignment handshake: a burst of probes right at ready
+            # (the worker sits in its message loop, so all three answer
+            # back to back with tight round trips — the min-uncertainty
+            # fold keeps the best)
+            self._probe_clock(h, burst=3)
+        elif kind == "clock_probe_reply":
+            self._note_clock_reply(h, msg)
         elif kind == "heartbeat":
             was_degraded = info.degraded
             info.queue_depth = int(msg.get("queue_depth", 0))
@@ -848,6 +942,13 @@ class ServingFleet:
                 self._metrics.fleet_health(
                     "replica_recovered", replica_id=info.replica_id
                 )
+            # keep the clock estimate fresh: one probe per heartbeat
+            # window, piggybacking the traffic that already flows
+            if self._tracer.enabled and (
+                h.last_probe_t is None
+                or self.clock() - h.last_probe_t >= self._probe_every_s
+            ):
+                self._probe_clock(h)
         elif kind == "response":
             req = h.inflight.pop(msg["id"], None)
             if req is None:
@@ -858,6 +959,11 @@ class ServingFleet:
             verdict = msg["verdict"]
             info.note_verdict(verdict)
             req.worker_latency_s = msg.get("latency_s")
+            if msg.get("last_span_id") is not None:
+                # the worker's chain tail: the terminal ack (or, on a
+                # re-route, the NEXT route span) parents to it, so the
+                # failed attempt's spans stay linked into the chain
+                req.trace_tail = msg["last_span_id"]
             if verdict == "ok":
                 req.result = msg.get("result")
                 req.parity_ok = msg.get("parity_ok")
@@ -898,6 +1004,59 @@ class ServingFleet:
         elif kind == "fatal":
             h.fatal_error = msg.get("error")
 
+    def _probe_clock(self, h, burst=1):
+        """Send ``burst`` clock probes to one worker (module docstring:
+        the round-trip offset handshake). Replies fold through
+        ``_note_clock_reply``; probes on a broken pipe are dropped — the
+        death path owns that replica now. A metrics-disabled fleet sends
+        none: an estimate that can never be recorded is wasted IPC."""
+        if not self._tracer.enabled:
+            return
+        for _ in range(burst):
+            if not h.send({"kind": "clock_probe", "t0": self.clock()}):
+                return
+        h.last_probe_t = self.clock()
+
+    # refinement bounds: chase a sub-millisecond estimate with immediate
+    # follow-up probes (the worker answers from inside its message loop
+    # and the parent stamps t1 in the very pump that reads the reply, so
+    # chained round trips tighten fast), but never more than a fixed
+    # probe budget per replica — alignment must stay background noise
+    _PROBE_TARGET_UNCERTAINTY_S = 0.0005
+    _PROBE_BUDGET = 24
+
+    def _note_clock_reply(self, h, msg):
+        """One probe's round trip: offset = tw - (t0 + t1)/2, uncertainty
+        = rtt/2 (the true offset provably lies inside the bound — the
+        reply can sit anywhere between the two parent timestamps). Keep
+        and record only IMPROVED estimates, so the reader's last-wins
+        fold always holds the best, and the JSONL stays bounded; while
+        the bound is still loose (parent pump lag dominates the first
+        round trips), chase it with an immediate follow-up probe."""
+        t1 = self.clock()
+        t0 = float(msg["t0"])
+        rtt = t1 - t0
+        est = {
+            "offset_s": float(msg["tw"]) - 0.5 * (t0 + t1),
+            "rtt_s": rtt,
+            "uncertainty_s": 0.5 * rtt,
+        }
+        h.probes_answered += 1
+        best = h.clock_offset
+        if best is None or est["uncertainty_s"] < best["uncertainty_s"]:
+            h.clock_offset = est
+            self._tracer.clock_offset(
+                replica_id=h.info.replica_id,
+                offset_s=est["offset_s"],
+                rtt_s=est["rtt_s"],
+                uncertainty_s=est["uncertainty_s"],
+            )
+        if (
+            h.clock_offset["uncertainty_s"] > self._PROBE_TARGET_UNCERTAINTY_S
+            and h.probes_answered < self._PROBE_BUDGET
+        ):
+            self._probe_clock(h)
+
     def _on_replica_dead(self, h, done):
         """Death -> failover: the dead replica's un-acked in-flight
         requests re-enter the fleet queue HEAD in original submit order
@@ -929,8 +1088,20 @@ class ServingFleet:
             return
         self._failovers += 1
         requeue = []
+        t_detect = self.clock()
         for req in inflight:
             req.replica_id = None
+            if req.trace_id is not None:
+                # the failover.requeue span links the dead replica's
+                # partial chain (its tail is this request's last route
+                # span — or the worker's last shipped span) to whatever
+                # serves the request next
+                req.trace_tail = self._tracer.span(
+                    "failover.requeue", req.trace_id, t_detect, t_detect,
+                    parent=req.trace_tail,
+                    from_replica=info.replica_id,
+                    requeued=not self._retry.exhausted(req.attempts),
+                ) or req.trace_tail
             if self._retry.exhausted(req.attempts):
                 self._failover_exhausted += 1
                 self._complete(req, "error", reason="replica_died")
@@ -998,12 +1169,34 @@ class ServingFleet:
             req.route_t = now
             req.replica_id = target.replica_id
             req.replicas_tried.append(target.replica_id)
+            trace_ctx = None
+            if req.trace_id is not None:
+                if req.trace_root is None:
+                    # the chain root: fleet admission -> first placement
+                    req.trace_root = self._tracer.span(
+                        "fleet.queue", req.trace_id, req.enqueue_t, now,
+                        parent=None,
+                    )
+                    req.trace_tail = req.trace_root
+                # the route span closes BEFORE the pipe write; the
+                # transit to the worker's admission lands in the gap the
+                # reader charges to this phase
+                route_span = self._tracer.span(
+                    "route", req.trace_id, now, self.clock(),
+                    parent=req.trace_tail,
+                    to_replica=target.replica_id, attempt=req.attempts,
+                )
+                if route_span is not None:
+                    req.trace_tail = route_span
+                    trace_ctx = {"trace_id": req.trace_id,
+                                 "parent": route_span}
             if not h.send(
                 {
                     "kind": "request",
                     "id": req.id,
                     "x": req.x,
                     "deadline_ms": remaining,
+                    "trace": trace_ctx,
                 }
             ):
                 # pipe broke mid-send: put it back (the attempt was spent
@@ -1148,10 +1341,10 @@ class ServingFleet:
         req.verdict = verdict
         req.complete_t = t
         req.reason = reason
+        self._trace_ack(req, t, reason)
         if verdict == "ok":
             self._samples.append((req.latency_s, req.queue_s, req.deadline_ms))
-            if self._last_complete_t is None or t > self._last_complete_t:
-                self._last_complete_t = t
+            self._serve_window.note_complete(t)
             if self._impair_t is not None:
                 # recovery: replica lost (or quorum lost) -> next served
                 # response — the fleet mirror of the engine's
@@ -1183,7 +1376,28 @@ class ServingFleet:
                 deadline_ms=req.deadline_ms,
                 attempts=req.attempts,
                 reason=reason,
+                trace_id=req.trace_id,
             )
+
+    def _trace_ack(self, req, t, reason=None):
+        """The one terminal span per fleet request. A request that was
+        admitted but never routed (fleet_down, no_routable_replica,
+        fleet-deadline shed) still gets its fleet.queue root here, so its
+        chain tells the full story: it waited, then the fleet decided."""
+        if req.trace_id is None:
+            return
+        if req.trace_root is None and req.admitted:
+            req.trace_root = self._tracer.span(
+                "fleet.queue", req.trace_id, req.enqueue_t, t, parent=None,
+            )
+            req.trace_tail = req.trace_root
+        self._tracer.span(
+            "ack", req.trace_id, t, t,
+            parent=req.trace_tail or req.trace_root,
+            terminal=True, verdict=req.verdict,
+            deadline_ms=req.deadline_ms, reason=reason,
+            replica_id_served=req.replica_id,
+        )
 
     def _record_depth(self, t):
         depth = len(self._router.queue)
@@ -1210,9 +1424,7 @@ class ServingFleet:
             ok_n + self._dropped + self._expired + self._errors
             + self._unhealthy
         )
-        window = None
-        if self._samples and self._first_enqueue_t is not None:
-            window = float(self._last_complete_t - self._first_enqueue_t)
+        window = self._serve_window.window_s if self._samples else None
         infos = [h.info for h in self._replicas.values()]
         routing = {i.replica_id: i.routed for i in infos}
         return {

@@ -17,6 +17,18 @@ Two drive modes (the classic load-testing pair):
   ``concurrency`` outstanding requests, each completion immediately
   replaced — measures the engine's sustainable service rate with bounded
   queue depth.
+
+Clock-domain contract (docs/observability.md § Tracing): both drivers
+read ``engine.clock`` — the clock of the process that ADMITS requests —
+so every timestamp they produce (scheduled arrivals, the backdated
+``arrival_t``, deadline budgets) lives in that one clock domain. Driving
+a ``ServingFleet``, that is the PARENT process's ``perf_counter``: the
+fleet's request records and parent-side trace spans share it end to end,
+while each worker's ``.r*`` shard records its own clock's values, which
+only the fleet handshake's per-replica ``clock_offset`` estimate can
+place on this timeline. Never compare raw timestamps across the two
+domains — join them through ``observability.tracing``, which aligns (or
+refuses, when no offset was recorded) instead of guessing.
 """
 
 import os

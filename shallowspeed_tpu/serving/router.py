@@ -169,6 +169,10 @@ class FleetRequest:
         "replicas_tried",
         "parity_ok",
         "worker_latency_s",
+        "admitted",
+        "trace_id",
+        "trace_root",
+        "trace_tail",
     )
 
     def __init__(self, req_id, x, deadline_ms, enqueue_t):
@@ -187,6 +191,15 @@ class FleetRequest:
         self.replicas_tried = []
         self.parity_ok = None  # worker-side bitwise parity vs predict()
         self.worker_latency_s = None  # engine-side latency of the final try
+        self.admitted = False  # entered the fleet queue (vs refused at submit)
+        # distributed-tracing context (schema v10): the chain id minted at
+        # fleet submit, the root fleet.queue span (emitted at first
+        # placement), and the span the NEXT hop parents to — a route span
+        # after placement, the worker's last span after a response, a
+        # failover.requeue span after a replica death
+        self.trace_id = None
+        self.trace_root = None
+        self.trace_tail = None
 
     @property
     def latency_s(self):

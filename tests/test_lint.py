@@ -147,9 +147,11 @@ def test_cli_exit_codes_and_json_report(capsys):
 
 
 def test_cli_metrics_out_records_lint_verdict(tmp_path, capsys):
-    """--metrics-out appends the schema-v9 static_analysis record named
-    'lint' with the rule ids and per-rule finding counts."""
-    from shallowspeed_tpu.observability import read_jsonl
+    """--metrics-out appends the static_analysis record named 'lint'
+    with the rule ids and per-rule finding counts (stamped with the
+    CURRENT schema version — the pin itself lives with the newest
+    schema's test, per the bump convention)."""
+    from shallowspeed_tpu.observability import SCHEMA_VERSION, read_jsonl
 
     bad = str(FIXTURES / "bad" / "broad_except.py")
     out = tmp_path / "lint.jsonl"
@@ -158,7 +160,7 @@ def test_cli_metrics_out_records_lint_verdict(tmp_path, capsys):
     recs = [r for r in read_jsonl(out) if r["kind"] == "static_analysis"]
     assert len(recs) == 1
     r = recs[0]
-    assert r["name"] == "lint" and r["v"] == 9
+    assert r["name"] == "lint" and r["v"] == SCHEMA_VERSION
     assert r["findings"] == 1 and r["by_rule"] == {"BLE001": 1}
     assert r["passes"] == sorted(
         ("BLE001", "SSP002", "SSP003", "SSP004", "SSP005", "SSP006")

@@ -110,6 +110,7 @@ def test_null_metrics_hot_path_zero_net_allocation():
             m.serving("s")
             m.serving_health("b")  # ... and the v6 degradation hooks
             m.reload("r")
+            m.trace("t")  # ... and the v10 tracing hook
 
     burst(100)  # warm up caches (method cache, code objects)
     # background threads (XLA's pools) can allocate a handful of blocks at
@@ -849,16 +850,12 @@ def test_schema_v9_static_analysis(tmp_path):
     """Schema v9 (additive): the static_analysis kind (one verdict per
     analyzed program: pass list, per-pass stats, finding count) plus the
     SCHEMA_KINDS registry — round-trip with the version stamp, the v9
-    reader accepts v1-v8 files unchanged, a v10 file is refused, and
-    NullMetrics no-ops the new hook. Carries the version pin and the
-    one-ahead refusal (the newest-schema convention)."""
+    reader accepts v1-v8 files unchanged, and NullMetrics no-ops the
+    hook. (Version pin + one-ahead refusal live with the newest schema's
+    test — test_schema_v10_trace — per convention.)"""
     from shallowspeed_tpu.observability.metrics import SCHEMA_KINDS
 
-    assert SCHEMA_VERSION == 9
-    # the registry IS the docstring's kind list: every recorder hook has
-    # a registered kind, and the newest kind carries the newest version
     assert SCHEMA_KINDS["static_analysis"] == 9
-    assert max(SCHEMA_KINDS.values()) == SCHEMA_VERSION
     path = tmp_path / "v9.jsonl"
     with JsonlMetrics(path) as m:
         m.static_analysis(
@@ -880,10 +877,10 @@ def test_schema_v9_static_analysis(tmp_path):
     assert [r["kind"] for r in recs] == [
         "meta", "static_analysis", "static_analysis", "static_analysis",
     ]
-    assert all(r["v"] == 9 for r in recs)
+    assert all(r["v"] == SCHEMA_VERSION for r in recs)
     assert recs[1]["findings"] == 0 and recs[1]["send_recv"]["sends_fwd"] == 12
     assert "tick 3" in recs[2]["finding"]
-    # v1-v8 files load unchanged under the v9 reader
+    # v1-v8 files load unchanged under the current reader
     for v, rec in (
         (1, {"kind": "event", "name": "epoch", "epoch": 0, "loss": 0.5}),
         (3, {"kind": "xla_audit", "name": "epoch_program", "census": {}}),
@@ -892,12 +889,70 @@ def test_schema_v9_static_analysis(tmp_path):
         p = tmp_path / f"old-v{v}.jsonl"
         p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
         assert read_jsonl(p)[0]["kind"] == rec["kind"]
-    # one-directional refusal: a v10 file fails loudly
-    v10 = tmp_path / "v10.jsonl"
-    v10.write_text(json.dumps({"v": 10, "kind": "event"}) + "\n")
-    with pytest.raises(ValueError, match="newer"):
-        read_jsonl(v10)
     NullMetrics().static_analysis("epoch_program", findings=0)
+
+
+def test_schema_v10_trace(tmp_path):
+    """Schema v10 (additive): the ``trace`` kind — one closed span per
+    record with trace/span/parent ids, raw clock-domain endpoints and the
+    terminal flag, plus the ``clock_offset`` alignment records — round
+    trips with the version stamp (non-finite endpoint values survive the
+    strict-JSON sanitizer as strings), the v10 reader accepts v1-v9 files
+    unchanged, a v11 file is refused, and NullMetrics no-ops the new
+    hook. Carries the version pin and the one-ahead refusal (the
+    newest-schema convention)."""
+    from shallowspeed_tpu.observability.metrics import SCHEMA_KINDS
+
+    assert SCHEMA_VERSION == 10
+    # the registry IS the docstring's kind list: every recorder hook has
+    # a registered kind, and the newest kind carries the newest version
+    assert SCHEMA_KINDS["trace"] == 10
+    assert max(SCHEMA_KINDS.values()) == SCHEMA_VERSION
+    path = tmp_path / "v10.jsonl"
+    with JsonlMetrics(path) as m:
+        m.trace(
+            "worker.queue", trace_id="f-3", span_id="r0.1", parent_id="f.2",
+            t0=10.5, t1=10.9, clock="worker", replica_id=0, terminal=False,
+        )
+        m.trace(
+            "ack", trace_id="f-3", span_id="f.9", parent_id="r0.4",
+            t0=11.0, t1=11.0, clock="parent", replica_id=None,
+            terminal=True, verdict="ok",
+        )
+        m.trace(
+            "clock_offset", trace_id=None, span_id=None, parent_id=None,
+            t0=None, t1=None, clock="parent", replica_id=0,
+            offset_s=3.0001, rtt_s=0.0004, uncertainty_s=0.0002,
+        )
+        # a blown-up duration must survive as STRICT JSON (the sanitizer
+        # contract every schema bump re-proves on its new kind)
+        m.trace(
+            "dispatch", trace_id="f-4", span_id="r0.2", parent_id=None,
+            t0=1.0, t1=float("nan"), clock="worker", replica_id=0,
+            terminal=False,
+        )
+    recs = read_jsonl(path)
+    assert [r["kind"] for r in recs] == ["meta"] + ["trace"] * 4
+    assert all(r["v"] == SCHEMA_VERSION for r in recs)
+    assert recs[1]["trace_id"] == "f-3" and recs[1]["parent_id"] == "f.2"
+    assert recs[2]["terminal"] is True and recs[2]["verdict"] == "ok"
+    assert recs[3]["name"] == "clock_offset" and recs[3]["offset_s"] == 3.0001
+    assert recs[4]["t1"] == "NaN"  # sanitized, line stayed parseable
+    # v1-v9 files load unchanged under the v10 reader
+    for v, rec in (
+        (1, {"kind": "event", "name": "epoch", "epoch": 0, "loss": 0.5}),
+        (5, {"kind": "request", "name": "ok", "id": 1}),
+        (9, {"kind": "static_analysis", "name": "lint", "findings": 0}),
+    ):
+        p = tmp_path / f"trace-old-v{v}.jsonl"
+        p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
+        assert read_jsonl(p)[0]["kind"] == rec["kind"]
+    # one-directional refusal: a v11 file fails loudly
+    v11 = tmp_path / "v11.jsonl"
+    v11.write_text(json.dumps({"v": 11, "kind": "event"}) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        read_jsonl(v11)
+    NullMetrics().trace("worker.queue", trace_id="x")
 
 
 def test_replica_shard_suffix_and_fallback_read(tmp_path):
@@ -946,6 +1001,30 @@ def test_percentile_single_shared_definition():
     assert percentile([None, 3.0, None, 1.0], 50) == 2.0
     assert percentile([], 99) is None
     assert percentile([None, None], 99) is None
+
+
+def test_throughput_window_single_shared_definition():
+    """Satellite: the ONE first-enqueue -> last-complete window helper
+    (the engine's and fleet's previously copy-pasted
+    _first_enqueue_t/_last_complete_t bookkeeping). Min-enqueue /
+    max-complete whatever the call order, None until BOTH ends exist —
+    an unmeasured window must not read as an instant one — and reset
+    clears it for the bench sweep's per-rate boundary."""
+    from shallowspeed_tpu.observability import ThroughputWindow
+
+    w = ThroughputWindow()
+    assert w.window_s is None
+    w.note_enqueue(10.0)
+    assert w.window_s is None  # half a window is no window
+    w.note_complete(11.5)
+    assert w.window_s == 1.5
+    # out-of-order notes keep the extremes (completions finish out of
+    # enqueue order under continuous batching)
+    w.note_enqueue(9.0)
+    w.note_complete(11.0)
+    assert w.window_s == 2.5
+    w.reset()
+    assert w.window_s is None and w.first_enqueue_t is None
 
 
 def test_jsonl_multihost_shard_suffix_and_glob_read(tmp_path, monkeypatch):

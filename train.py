@@ -28,6 +28,7 @@ On a single-chip host, multi-device layouts run on emulated CPU devices:
 
 import argparse
 import contextlib
+import json
 import os
 import sys
 import time
@@ -204,6 +205,25 @@ def main():
         "--profile-dir",
         default=None,
         help="write a jax.profiler trace of one training epoch to this directory",
+    )
+    ap.add_argument(
+        "--dispatch-probe",
+        action="store_true",
+        help="after training, measure the op-issue roofline: dispatch "
+        "extra training epochs under the jax profiler and report the "
+        "share of host wall NOT covered by op execution "
+        "(docs/performance.md 'The measured dispatch-overhead share'). "
+        "The probe TRAINS the epochs it times (the epoch program donates "
+        "its state) — it runs after the final model hash is printed, so "
+        "the hash stays the training result",
+    )
+    ap.add_argument(
+        "--dispatch-probe-out",
+        default=None,
+        metavar="JSON",
+        help="also write the probe's measurement as a versioned bench "
+        "record (bench: dispatch_overhead) to this file; implies "
+        "--dispatch-probe",
     )
     ap.add_argument(
         "--metrics-out",
@@ -583,6 +603,63 @@ def main():
     if args.dp > 1:
         print("DP replicas in sync ✓")
     print("final model hash:", run.model_hash())
+    if args.dispatch_probe or args.dispatch_probe_out:
+        # the measured op-issue roofline (docs/performance.md): extra
+        # profiled epochs AFTER the hash print, so the hash above stays
+        # the training result the drivers compare
+        rec = run.measure_dispatch_overhead()
+        share = rec["dispatch_overhead"]
+        if share is None:
+            print(
+                "dispatch overhead: unmeasurable — "
+                + rec.get("reason", "no op events")
+            )
+        else:
+            print(
+                f"dispatch overhead: >= {share * 100:.1f}% of epoch wall "
+                f"is host-side op issue (op busy "
+                f"{rec['device_busy_s'] * 1e3:.1f} ms of "
+                f"{rec['host_wall_s'] * 1e3:.1f} ms uninstrumented wall "
+                f"over {rec['repeats']} epoch(s); {rec['op_events']} op "
+                f"events, source {rec['op_source']}, profiler inflation "
+                f"{rec['profiler_inflation']:.2f}x)"
+            )
+        if args.dispatch_probe_out:
+            bench_rec = {
+                "bench": "dispatch_overhead",
+                "bench_version": 1,
+                "config": {
+                    "dp": args.dp,
+                    "pp": args.pp,
+                    "tp": args.tp,
+                    "schedule": args.schedule,
+                    "global_batch_size": args.global_batch_size,
+                    "mubatches": args.mubatches,
+                    "backward_split": args.backward_split,
+                    "grad_bucket_bytes": args.grad_bucket_bytes,
+                    "platform": rec["platform"],
+                },
+                "value": share,
+                "unit": "fraction of epoch wall not covered by op execution",
+                **{
+                    k: rec[k]
+                    for k in (
+                        "program", "repeats", "host_wall_s",
+                        "host_wall_instrumented_s", "profiler_inflation",
+                        "device_busy_s", "device_comm_s",
+                        "device_compute_s", "op_events", "op_source",
+                        "dispatch_overhead_instrumented", "provenance",
+                    )
+                },
+            }
+            from shallowspeed_tpu.observability.metrics import json_safe
+
+            with open(args.dispatch_probe_out, "w", encoding="utf-8") as f:
+                f.write(
+                    json.dumps(json_safe(bench_rec), indent=2, allow_nan=False)
+                    + "\n"
+                )
+            print(f"dispatch-overhead record written: {args.dispatch_probe_out}")
     if metrics is not None:
         metrics.close()
         print(f"telemetry written: {metrics.path}")
