@@ -9,6 +9,7 @@ SHELL := /bin/bash
 .PHONY: test verify lint analyze-smoke metrics-smoke report-smoke \
         audit-smoke overlap-smoke split-smoke tp-smoke recovery-smoke \
         aot-smoke serve-smoke chaos-smoke fleet-smoke trace-smoke \
+        mpmd-smoke bench-mpmd \
         bench-serving bench-ckpt-aot data train train-mesh bench \
         bench-scaling schedules clean
 
@@ -459,6 +460,47 @@ trace-smoke:
 	    --format md > /tmp/tsmoke/train.report.md
 	grep -q "dispatch overhead" /tmp/tsmoke/train.report.md
 	@echo "trace-smoke OK: 2-replica kill-injected soak left a complete clock-aligned span chain for every terminal request, Tracing attribution + waterfalls rendered, measured dispatch-overhead record written"
+
+# MPMD runtime end-to-end (ROADMAP item 1, docs/performance.md "The MPMD
+# runtime"): gpipe-pp4 + pipedream-pp4 + interleaved-pp2xV2 epochs under
+# --runtime mpmd --audit — final weights HASH-EQUAL to the lockstep twin
+# on every layout, the deadlock proof consulted before dispatch
+# (static_analysis record, deadlock pass), every per-stage program's
+# census clean (xla_audit mpmd_stage_program records, zero mismatches,
+# no collective-permute), and the measured dispatch-probe row rendered
+# by the report CLI
+mpmd-smoke:
+	rm -rf /tmp/msmoke; mkdir -p /tmp/msmoke
+	python -c "import numpy as np; from pathlib import Path; d=Path('/tmp/msmoke/data'); d.mkdir(parents=True); rng=np.random.RandomState(0); [(np.save(d/('x_'+s+'.npy'), rng.rand(n,784).astype(np.float32)), np.save(d/('y_'+s+'.npy'), np.eye(10,dtype=np.float32)[rng.randint(0,10,n)])) for s,n in (('train',256),('val',96))]"
+	set -e; for lay in gpipe pipedream interleaved; do \
+	  if [ $$lay = interleaved ]; then \
+	    LFLAGS="--pp 2 --schedule interleaved --virtual-stages 2 --mubatches 4"; \
+	  else LFLAGS="--pp 4 --schedule $$lay --mubatches 4"; fi; \
+	  COMMON="--data-dir /tmp/msmoke/data --epochs 2 --global-batch-size 32 --no-eval"; \
+	  $(CPU_MESH) python train.py $$COMMON $$LFLAGS \
+	      > /tmp/msmoke/$$lay.lock.out; \
+	  if [ $$lay = gpipe ]; then PROBE="--dispatch-probe --dispatch-probe-out /tmp/msmoke/DISPATCH_MPMD.json"; \
+	  else PROBE=""; fi; \
+	  $(CPU_MESH) python train.py $$COMMON $$LFLAGS --runtime mpmd --audit \
+	      --metrics-out /tmp/msmoke/$$lay.mpmd.jsonl $$PROBE \
+	      > /tmp/msmoke/$$lay.mpmd.out; \
+	  lock_h=$$(grep -o 'final model hash: [0-9a-f]*' /tmp/msmoke/$$lay.lock.out); \
+	  mpmd_h=$$(grep -o 'final model hash: [0-9a-f]*' /tmp/msmoke/$$lay.mpmd.out); \
+	  test -n "$$lock_h" && test "$$lock_h" = "$$mpmd_h" \
+	      || { echo "$$lay: HASH MISMATCH mpmd [$$mpmd_h] vs lockstep [$$lock_h]"; exit 1; }; \
+	  echo "$$lay: mpmd hash == lockstep twin hash"; \
+	  python -c "import json,sys; lay='$$lay'; recs=[json.loads(l) for l in open('/tmp/msmoke/'+lay+'.mpmd.jsonl')]; sa=[r for r in recs if r.get('kind')=='static_analysis' and 'deadlock' in (r.get('passes') or [])]; assert sa and all(r.get('findings')==0 for r in sa), lay+': deadlock proof missing or found findings'; audits=[r for r in recs if r.get('kind')=='xla_audit' and r.get('name')=='mpmd_stage_program']; assert len(audits) >= 8, lay+': only %d stage-program audits' % len(audits); bad=[r for r in audits if r.get('census_ok') is not True]; assert not bad, lay+': census mismatches %r' % [b.get('mismatches') for b in bad][:3]; perm=[r for r in audits if (r.get('census') or {}).get('collective_permute',{}).get('count',0)]; assert not perm, lay+': a stage program lowered a collective-permute'; print(lay+': deadlock proof consulted, %d stage programs census-clean, zero relays in-program' % len(audits))"; \
+	done
+	python -c "import json; rec=json.load(open('/tmp/msmoke/DISPATCH_MPMD.json')); assert rec['bench']=='dispatch_overhead'; v=rec['value']; assert v is not None and 0.0 <= v < 1.0, 'unmeasured share %r' % v; assert rec.get('runtime')=='mpmd' and rec['op_events']>0; print('mpmd dispatch-overhead record: %.1f%% of epoch wall is host-side op issue (%d op events)' % (100*v, rec['op_events']))"
+	python -m shallowspeed_tpu.observability.report /tmp/msmoke/gpipe.mpmd.jsonl \
+	    --format md > /tmp/msmoke/gpipe.report.md
+	grep -q "dispatch overhead" /tmp/msmoke/gpipe.report.md
+	@echo "mpmd-smoke OK: three schedules hash-equal to lockstep twins under --runtime mpmd --audit, deadlock proof consulted, per-stage census clean, dispatch-probe row rendered"
+
+# the MPMD-vs-lockstep scoreboard (same-window epoch pair, dispatch-probe
+# pair, serving burst p99) — writes MPMD_r01.json on the flagship data
+bench-mpmd:
+	$(CPU_MESH) python scripts/bench_mpmd.py
 
 # the full offered-load sweep on the default layouts (see docs/serving.md)
 bench-serving:

@@ -120,6 +120,7 @@ class TrainingSession:
         aot_cache_dir=None,
         predict_slot_rows=None,
         predict_slot_ladder=None,
+        runtime="lockstep",
     ):
         # telemetry hook (observability package): None -> the zero-overhead
         # null backend. Everything the session emits — construction spans,
@@ -262,6 +263,64 @@ class TrainingSession:
                     "backward_split needs the XLA per-slot backward; the "
                     "fused pallas flag kernel has no split halves"
                 )
+        # pipeline runtime (docs/performance.md "The MPMD runtime"):
+        # "lockstep" is the historical ONE-SPMD-program executor (the
+        # correctness oracle); "mpmd" dispatches one compiled program per
+        # stage role asynchronously from the host with device-to-device
+        # relays (parallel/mpmd.py) — bitwise-identical weights, measured
+        # lower op-issue overhead. The MPMD feature envelope is enforced
+        # here: the knobs whose lockstep implementations live in the fused
+        # program's tail (zero1, bucketed sync, the cross-stage clip norm,
+        # the pallas tick backend, the per-step flight aux) stay
+        # lockstep-only until the per-stage update learns their math.
+        if runtime not in ("lockstep", "mpmd"):
+            raise ValueError(
+                f"runtime must be 'lockstep' or 'mpmd', got {runtime!r}"
+            )
+        self.runtime = runtime
+        self._mpmd = None  # the train runner, built with the tick program
+        self._mpmd_infer = None  # the streaming inference runner (lazy)
+        if runtime == "mpmd":
+            if self._sequential:
+                raise ValueError(
+                    "runtime='mpmd' dispatches one program per pipeline "
+                    "stage; the sequential path has no stages — use a mesh "
+                    "layout (dp/pp/tp > 1)"
+                )
+            if self._zero1:
+                raise ValueError(
+                    "runtime='mpmd' does not support zero1 yet: the ZeRO-1 "
+                    "reduce-scatter/all-gather update spans the whole flat "
+                    "param vector, not one stage — use runtime='lockstep'"
+                )
+            if grad_bucket_bytes:
+                raise ValueError(
+                    "runtime='mpmd' does not support grad_bucket_bytes: "
+                    "bucketed sync overlaps collectives inside the lockstep "
+                    "program's tail; the MPMD per-stage update is one psum "
+                    "per stage already — use runtime='lockstep'"
+                )
+            if clip_norm is not None:
+                raise ValueError(
+                    "runtime='mpmd' does not support clip_norm yet: the "
+                    "global norm spans every stage's gradient, which the "
+                    "per-stage update programs cannot see — use "
+                    "runtime='lockstep'"
+                )
+            if kernel_backend != "xla":
+                raise ValueError(
+                    "runtime='mpmd' uses the XLA per-slot stage functions; "
+                    "kernel_backend='pallas' is lockstep-only"
+                )
+            if record_steps:
+                raise ValueError(
+                    "runtime='mpmd' does not thread the per-step flight aux "
+                    "(loss/grad-norm/param-norm vectors ride the lockstep "
+                    "epoch scan); pass record_steps=False or use "
+                    "runtime='lockstep'"
+                )
+            record_steps = False
+
         self.epoch = 0
         # step cursor within the current epoch: 0 except after a mid-epoch
         # resume / between train_steps() chunks. global_step (property) is
@@ -356,9 +415,16 @@ class TrainingSession:
                 f"than one global batch of {self.B}"
             )
         Xb, Yb = self._train_ds.epoch_arrays()
-        with self._metrics.span("device_put"):
-            self._X = jnp.asarray(Xb.reshape(nb, self.B, Xb.shape[-1]))
-            self._Y = jnp.asarray(Yb.reshape(nb, self.B, Yb.shape[-1]))
+        if self.runtime == "mpmd":
+            # the MPMD host scheduler feeds per-microbatch device_puts to
+            # the endpoint stages' sub-meshes itself; the epoch arrays
+            # stay host-side (numpy slices are the step-chunk unit)
+            self._X = Xb.reshape(nb, self.B, Xb.shape[-1])
+            self._Y = Yb.reshape(nb, self.B, Yb.shape[-1])
+        else:
+            with self._metrics.span("device_put"):
+                self._X = jnp.asarray(Xb.reshape(nb, self.B, Xb.shape[-1]))
+                self._Y = jnp.asarray(Yb.reshape(nb, self.B, Yb.shape[-1]))
         self.batches_per_epoch = nb
 
         n_model_stages = pp * virtual_stages
@@ -651,15 +717,36 @@ class TrainingSession:
                 )
             else:
                 self._opt_state = opt.init(self._stacked)
-            self._epoch_fn = E.make_pipeline_epoch(
-                self.mesh, self.spec, prog, local_batch // mubatches, opt,
-                precision=self.precision, zero1=self._zero1,
-                unroll=scan_unroll, tick_unroll=tick_unroll,
-                clip_norm=clip_norm, kernel_backend=kernel_backend,
-                with_grad_norm=self._epoch_aux,
-                with_step_stats=self._step_aux,
-                grad_bucket_bytes=grad_bucket_bytes,
-            )
+            if self.runtime == "mpmd":
+                from shallowspeed_tpu.observability.tracing import Tracer
+                from shallowspeed_tpu.parallel import mpmd
+
+                # the MPMD runner's constructor IS the admission gate:
+                # analyze_program must prove the tick tables deadlock-free
+                # before any stage program can be built or dispatched
+                self._mpmd = mpmd.MpmdTrainRunner(
+                    self.mesh, self.spec, prog, local_batch // mubatches,
+                    opt, precision=self.precision,
+                    tracer=Tracer(self._metrics, process="m"),
+                )
+
+                def _mpmd_epoch(stacked, flags, opt_state, X, Y):
+                    return self._mpmd.run(
+                        stacked, flags, opt_state, X, Y,
+                        trace_id=f"mpmd-{self.global_step}",
+                    )
+
+                self._epoch_fn = _mpmd_epoch
+            else:
+                self._epoch_fn = E.make_pipeline_epoch(
+                    self.mesh, self.spec, prog, local_batch // mubatches, opt,
+                    precision=self.precision, zero1=self._zero1,
+                    unroll=scan_unroll, tick_unroll=tick_unroll,
+                    clip_norm=clip_norm, kernel_backend=kernel_backend,
+                    with_grad_norm=self._epoch_aux,
+                    with_step_stats=self._step_aux,
+                    grad_bucket_bytes=grad_bucket_bytes,
+                )
             self._prog = prog
             self._mubatch_local = local_batch // mubatches
             self._run_kwargs = dict(
@@ -888,7 +975,27 @@ class TrainingSession:
 
         ``audit=True`` also forces this compile (even metrics-less): the
         program audit needs the compiled object to verify the layout's
-        collective contract before the first dispatch."""
+        collective contract before the first dispatch.
+
+        On the MPMD runtime the "epoch program" is the per-stage program
+        set: the warm pass compiles (or AOT-loads) every planned stage
+        program, censuses each against its per-stage contract
+        (``mpmd.expected_stage_comms``) and proves it donation-free —
+        then swaps the dispatch path onto the resolved executables, so a
+        cache-warm MPMD start compiles zero stage programs."""
+        if self.runtime == "mpmd":
+            if self._epoch_compiled or not (
+                self._metrics.enabled or self._audit_strict
+                or self._aot is not None
+            ):
+                return
+            self._mpmd.warm(
+                self._stacked, self._flags, self._opt_state,
+                self._mpmd_resolve,
+            )
+            self._epoch_compiled = True
+            self._record_cost_model()
+            return
         if self._epoch_compiled or not (self._metrics.enabled or self._audit_strict):
             return
         if self._aot is not None:
@@ -921,6 +1028,37 @@ class TrainingSession:
         self._epoch_compiled = True
         self._record_cost_model()
 
+    def _mpmd_resolve(self, label, role, jit_fn, args, expected):
+        """The MPMD warm pass's per-stage-program hook: AOT-resolve (when
+        a cache is configured) or compile each stage program, census it
+        against its per-stage contract, and prove it donation-free
+        (``verify_dispatch_safety`` — every stage program IS a dispatch
+        path). Returns the executable the runner should dispatch, or
+        None to keep the plain jit wrapper (nothing to verify and no
+        cache to serve)."""
+        dedup = ("mpmd", label)
+        if self._aot is not None:
+            compiled, _ = self._aot_resolve(
+                label, "mpmd_stage_program", jit_fn, args,
+                expected=expected, dedup=dedup, dispatch=True,
+            )
+            return compiled
+        if not (self._metrics.enabled or self._audit_strict):
+            return None
+        if dedup in self._audit_done:
+            return None
+        with self._metrics.span("jit_compile"):
+            compiled = jit_fn.lower(*args).compile()
+        self._metrics.counter("jit_compiles")
+        self._record_audit(
+            compiled, "mpmd_stage_program", dedup=dedup, expected=expected
+        )
+        # every stage program is a dispatch path: donation would be a
+        # use-after-free against the next microbatch's read — proven
+        # absent from the compiled HLO, unlatched like the census
+        program_audit.verify_dispatch_safety(compiled, context=label)
+        return compiled
+
     def _refuse_pending_faults(self, entry):
         """Injections fire at step boundaries, which only ``train_steps``
         has — a whole-epoch or fused-run dispatch would sail straight past
@@ -945,7 +1083,10 @@ class TrainingSession:
         is length-independent; only the trip count changes). Full-epoch
         slices take the normal epoch path; chunked-only sessions never pay
         the full-epoch compile their dispatches would not use."""
-        if k1 - k0 == self.batches_per_epoch:
+        if k1 - k0 == self.batches_per_epoch or self.runtime == "mpmd":
+            # MPMD dispatches the same per-stage programs for any chunk
+            # length (the host loop owns the batch axis), so there is no
+            # distinct sliced program to audit
             self._ensure_epoch_compiled()
             return
         if not (self._metrics.enabled or self._audit_strict):
@@ -1237,15 +1378,16 @@ class TrainingSession:
         self._save_seq += 1
         rotate_dir = self._ckpt_dir if rotate else None
         t0 = time.perf_counter()
-        arrays, meta = build_snapshot(
-            self.params(),
-            self.spec,
-            epoch,
-            extra={"optimizer": self._opt_config},
-            opt_state=self.opt_state_logical(),
-            step_in_epoch=sie,
-            global_step=gs,
-        )
+        if not async_:
+            arrays, meta = build_snapshot(
+                self.params(),
+                self.spec,
+                epoch,
+                extra={"optimizer": self._opt_config},
+                opt_state=self.opt_state_logical(),
+                step_in_epoch=sie,
+                global_step=gs,
+            )
 
         def completion(result, on_path_wall, queue_depth=None):
             # runs inline (sync) or on the writer thread (async): update
@@ -1270,6 +1412,10 @@ class TrainingSession:
                     fields["async"] = True
                     fields["queue_depth"] = queue_depth
                     fields["queued_s"] = result["queued_s"]
+                    # the deferred logical-unstacking wall (off-path):
+                    # what the step path stopped paying (ROADMAP item 5
+                    # follow-on; CKPT_AOT_r01.json scoreboard)
+                    fields["unstack_s"] = result.get("unstack_s", 0.0)
                 else:
                     fields["async"] = False
                 self._metrics.checkpoint(reason, **fields)
@@ -1290,6 +1436,24 @@ class TrainingSession:
             )
             completion(result, time.perf_counter() - t0)
             return path
+        # async: the step path keeps ONLY the device->host readback (the
+        # consistency point) — the logical unstacking (params()/
+        # opt_state_logical's per-stage reshaping) and build_snapshot's
+        # flattening run on the writer thread via the deferred build
+        # (ROADMAP item 5 follow-on: it was the dominant on-path cost)
+        raw_params, raw_state = self._snapshot_raw()
+        spec, opt_cfg = self.spec, dict(self._opt_config)
+
+        def build():
+            params, opt_state = self._logical_from_raw(raw_params, raw_state)
+            return build_snapshot(
+                params, spec, epoch,
+                extra={"optimizer": opt_cfg},
+                opt_state=opt_state,
+                step_in_epoch=sie,
+                global_step=gs,
+            )
+
         if self._ckpt_writer is None:
             self._ckpt_writer = AsyncCheckpointWriter(
                 max_in_flight=self._ckpt_queue,
@@ -1311,9 +1475,9 @@ class TrainingSession:
             )
 
         self._ckpt_writer.submit(
-            path, arrays, meta, save_seq,
+            path, None, None, save_seq,
             rotate_dir=rotate_dir, rotate_keep=self._ckpt_keep,
-            trusted=trusted_now, on_complete=job_complete,
+            trusted=trusted_now, on_complete=job_complete, build=build,
         )
         wall_box["wall"] = time.perf_counter() - t0
         measured.set()
@@ -1453,6 +1617,13 @@ class TrainingSession:
         """
         if epochs <= 0:
             raise ValueError("epochs must be positive")
+        if self.runtime == "mpmd":
+            raise ValueError(
+                "train_run() is the fused ONE-on-device-program contract, "
+                "which the MPMD runtime (host-scheduled per-stage programs) "
+                "deliberately does not have — drive MPMD sessions with "
+                "train_epoch()/train_steps()"
+            )
         if self.step_in_epoch != 0:
             raise ValueError(
                 f"epoch {self.epoch} is mid-flight at step "
@@ -1539,6 +1710,12 @@ class TrainingSession:
         """
         if epochs <= 0:
             raise ValueError("epochs must be positive")
+        if self.runtime == "mpmd":
+            raise ValueError(
+                "warm_run() AOT-compiles the fused run program, which the "
+                "MPMD runtime does not dispatch — the per-stage programs "
+                "warm through the audit/AOT pass on the first epoch"
+            )
         if with_eval and self._vx is None:
             self._load_val()
         key = (with_eval, epochs)
@@ -1691,6 +1868,25 @@ class TrainingSession:
                     ],
                     axis=0,
                 )
+            elif self.runtime == "mpmd":
+                # MPMD streaming: each OCCUPIED slot is its own per-stage
+                # chain — slot k enters stage 0 while slot k-1 occupies
+                # stage 1 — so there is no rung program and therefore no
+                # rung round-up (the compile bound is one fwd program per
+                # stage, not one per ladder rung). Submit every slot
+                # before materializing any: the chains pipeline.
+                runner = self._mpmd_infer_runner()
+                params, fls = self._mpmd_infer_views()
+                xb = np.pad(chunk, ((0, m * S_rows - chunk.shape[0]), (0, 0)))
+                handles = [
+                    runner.submit(
+                        params, fls, xb[k * S_rows : (k + 1) * S_rows]
+                    )
+                    for k in range(m)
+                ]
+                preds = np.concatenate(
+                    [np.asarray(h) for h in handles], axis=0
+                )
             else:
                 rung = serving_slots.rung_for(m, self._slot_ladder)
                 xb = np.pad(chunk, ((0, rung * S_rows - chunk.shape[0]), (0, 0)))
@@ -1820,6 +2016,72 @@ class TrainingSession:
             self._predict_cache[n_slots] = step
         return step
 
+    def _mpmd_infer_runner(self):
+        """The streaming MPMD inference runner (mesh mpmd sessions): ONE
+        slot-shaped per-stage forward chain, admission-gated at build
+        (``analyze_program`` over the inference tick tables) and — when
+        metrics/audit/AOT are on — censused per stage program against
+        the forward-only contract before the first request."""
+        if self._mpmd_infer is None:
+            from shallowspeed_tpu.parallel import mpmd
+
+            prog = self._lower_inference_prog(1)
+            runner = mpmd.MpmdInferenceRunner(
+                self.mesh, self.spec, prog, self._slot_rows // self.dp,
+                precision=self.precision,
+            )
+            if self._metrics.enabled or self._audit_strict or self._aot:
+                runner.warm(self._stacked, self._flags, self._mpmd_resolve)
+            self._mpmd_infer = runner
+        return self._mpmd_infer
+
+    def _mpmd_infer_views(self):
+        """The streaming runner's per-stage param/flag views, cached per
+        LIVE weight arrays: rebuilding (and re-packing) per request would
+        tax every dispatch; a hot weight reload swaps ``self._stacked``
+        to a new object, which invalidates the cache by identity."""
+        cached = getattr(self, "_mpmd_infer_view_cache", None)
+        if (
+            cached is not None
+            and cached[0] is self._stacked  # kept alive by the cache
+            and cached[1] is self._flags
+        ):
+            return cached[2], cached[3]
+        runner = self._mpmd_infer_runner()
+        params, fls = runner.views(self._stacked, self._flags)
+        self._mpmd_infer_view_cache = (self._stacked, self._flags, params, fls)
+        return params, fls
+
+    def predict_async(self, x):
+        """MPMD streaming submit (mesh mpmd sessions): issue ONE request
+        of up to ``slot_rows`` rows through the per-stage chain and
+        return a zero-argument resolver. Nothing blocks at submit, so
+        consecutive requests pipeline across stages — request k enters
+        stage 0 while request k-1 occupies a later stage. This is the
+        measured tail-latency payoff next to the rung program's
+        makespan-quantized dispatch (MPMD_r01.json)."""
+        if self._sequential or self.runtime != "mpmd":
+            raise ValueError(
+                "predict_async streams through the MPMD per-stage chain — "
+                "construct the session with runtime='mpmd' (mesh layout)"
+            )
+        x = np.asarray(x, np.float32)
+        n, out_dim = x.shape[0], self.spec.out_dim
+        if n < 1 or n > self._slot_rows:
+            raise ValueError(
+                f"predict_async takes one slot (1..{self._slot_rows} rows); "
+                f"got {n} — larger requests go through predict()"
+            )
+        runner = self._mpmd_infer_runner()
+        params, fls = self._mpmd_infer_views()
+        xb = np.pad(x, ((0, self._slot_rows - n), (0, 0)))
+        handle = runner.submit(params, fls, xb)
+
+        def resolve():
+            return np.asarray(handle)[:n, :out_dim]
+
+        return resolve
+
     def inference_latency_bound(self):
         """Analytical latency floor for one request slot through this
         layout's inference program: the lockstep tick model's weighted
@@ -1936,6 +2198,7 @@ class TrainingSession:
         )
         record = {
             "program": label,
+            "runtime": self.runtime,
             "repeats": int(repeats),
             "host_wall_s": host_wall_s,
             "host_wall_instrumented_s": wall_instrumented_s,
@@ -1996,9 +2259,9 @@ class TrainingSession:
 
     def params(self):
         """Logical per-stage params (host numpy), layout-independent order."""
-        if self._sequential:
-            return jax.device_get(self._params)
-        return E.unstack_params(self._stacked, self.spec, order=self._order)
+        return self._logical_params_from_raw(
+            self._params if self._sequential else self._stacked
+        )
 
     def poison_weights(self):
         """Fault-injection hook (faults.py): NaN one element of this
@@ -2070,18 +2333,41 @@ class TrainingSession:
         if not self._sequential:
             utils.assert_dp_replicas_in_sync(self._stacked)
 
-    def opt_state_logical(self):
-        """Stateful-optimizer state in layout-independent logical form:
-        ``{"parts": {key: ragged_list mirroring params()}, "scalars":
-        {key: float}}`` per the optimizer's state_layout(); None for
-        stateless optimizers."""
-        if is_stateless(self._opt):
+    def _snapshot_raw(self):
+        """Stage 1 of a snapshot, the ONLY part that must stay on the
+        step path for consistency: the device->host readback of the live
+        params + optimizer state, in their RAW (stacked/flat) layout.
+        Returns immutable host copies safe to hand to the async writer
+        (the training loop keeps mutating the device arrays)."""
+        raw_params = jax.device_get(
+            self._params if self._sequential else self._stacked
+        )
+        raw_state = (
+            None if is_stateless(self._opt) else jax.device_get(self._opt_state)
+        )
+        return raw_params, raw_state
+
+    def _logical_params_from_raw(self, raw_params):
+        """Raw (stacked/sequential) param arrays -> the logical per-stage
+        list. Pure numpy on host arrays (``device_get`` is the identity
+        there), so under async saves it runs on the writer thread, OFF
+        the step path. The ONE implementation behind ``params()`` and
+        the async snapshot build — they cannot drift."""
+        if self._sequential:
+            return jax.device_get(raw_params)
+        return E.unstack_params(raw_params, self.spec, order=self._order)
+
+    def _logical_state_from_raw(self, raw_state):
+        """Raw optimizer-state arrays -> the layout-independent logical
+        form (``opt_state_logical()``'s output, same single-owner rule
+        as ``_logical_params_from_raw``). None stays None (stateless)."""
+        if raw_state is None:
             return None
         if self._zero1:
             return E.zero1_state_to_logical(
-                self._opt_state, self._opt, self.spec, self.mesh, order=self._order
+                raw_state, self._opt, self.spec, self.mesh, order=self._order
             )
-        parts, scalars = split_state(self._opt, self._opt_state)
+        parts, scalars = split_state(self._opt, raw_state)
         if self._sequential:
             parts = {k: jax.device_get(v) for k, v in parts.items()}
         else:
@@ -2091,6 +2377,23 @@ class TrainingSession:
             }
         scalars = {k: float(jax.device_get(v)) for k, v in scalars.items()}
         return {"parts": parts, "scalars": scalars}
+
+    def _logical_from_raw(self, raw_params, raw_state):
+        """Both halves of a raw snapshot in logical form (the async
+        writer-thread build)."""
+        return (
+            self._logical_params_from_raw(raw_params),
+            self._logical_state_from_raw(raw_state),
+        )
+
+    def opt_state_logical(self):
+        """Stateful-optimizer state in layout-independent logical form:
+        ``{"parts": {key: ragged_list mirroring params()}, "scalars":
+        {key: float}}`` per the optimizer's state_layout(); None for
+        stateless optimizers."""
+        if is_stateless(self._opt):
+            return None
+        return self._logical_state_from_raw(self._opt_state)
 
     def save(self, path):
         save_checkpoint(

@@ -725,7 +725,7 @@ class AsyncCheckpointWriter:
         raise first
 
     def submit(self, path, arrays, meta, save_seq, rotate_dir=None,
-               rotate_keep=None, trusted=(), on_complete=None):
+               rotate_keep=None, trusted=(), on_complete=None, build=None):
         """Enqueue one snapshot (stage-1 output) for background
         verify+write+rotate; blocks while the in-flight window is full.
         ``save_seq`` is the session's save sequence number — the fault
@@ -736,15 +736,29 @@ class AsyncCheckpointWriter:
         thread keeps mutating.
         ``on_complete`` rides WITH the job (falling back to the writer's
         default), so a record callback can never be applied to the wrong
-        in-flight snapshot."""
+        in-flight snapshot.
+
+        ``build`` (instead of ``arrays``/``meta``): a zero-argument
+        callable returning ``(arrays, meta)``, run ON THE WRITER THREAD
+        before the save stages — the deferred logical-unstacking hook.
+        The step path then carries only the raw device->host readback
+        (which must stay on-path for consistency); the host-side
+        reshaping of params/opt-state into the layout-independent
+        snapshot form happens off-path, and its wall is reported as
+        ``unstack_s`` in the completion dict. The callable must capture
+        IMMUTABLE copies only (the training loop keeps mutating session
+        state while the writer drains)."""
         self._raise_pending()
         if self._closed:
             raise ValueError("writer is closed")
+        if (build is None) == (arrays is None):
+            raise ValueError("submit takes arrays+meta or build, not both")
         self._queue.put(
             {
                 "path": Path(path),
                 "arrays": arrays,
                 "meta": meta,
+                "build": build,
                 "save_seq": int(save_seq),
                 "rotate_dir": rotate_dir,
                 "rotate_keep": rotate_keep,
@@ -792,8 +806,17 @@ class AsyncCheckpointWriter:
 
     def _process(self, job):
         t0 = time.perf_counter()
+        arrays, meta = job["arrays"], job["meta"]
+        unstack_s = 0.0
+        if job.get("build") is not None:
+            # deferred logical unstacking (off the step path): the raw
+            # device->host snapshot becomes the layout-independent
+            # arrays+meta here, overlapped with training dispatches
+            tb = time.perf_counter()
+            arrays, meta = job["build"]()
+            unstack_s = time.perf_counter() - tb
         result = run_save_stages(
-            job["path"], job["arrays"], job["meta"],
+            job["path"], arrays, meta,
             faults=self._faults, save_seq=job["save_seq"],
             rotate_dir=job["rotate_dir"], rotate_keep=job["rotate_keep"],
             # the job's submit-time tuple may predate an in-flight save
@@ -804,6 +827,7 @@ class AsyncCheckpointWriter:
         if result["trusted"]:
             self._recent_trusted.append(str(job["path"]))
         result["queued_s"] = t0 - job["enqueue_t"]
+        result["unstack_s"] = unstack_s
         callback = job.get("on_complete") or self._on_complete
         if callback is not None:
             callback(result)

@@ -78,7 +78,14 @@ from collections import defaultdict
 from shallowspeed_tpu.observability.stats import percentile
 
 # the typed span alphabet (module docstring); "clock_offset" records ride
-# the same kind but are alignment metadata, not spans
+# the same kind but are alignment metadata, not spans. The two
+# ``stage.*`` names are the MPMD runtime's training-side spans
+# (parallel/mpmd.py): ``stage.dispatch`` is one stage program's host
+# issue window (fields: stage/op/mb), ``stage.relay`` one
+# device-to-device activation transfer (fields: stage/to_stage/
+# direction/mb) — emitted for the first batch of each epoch dispatch so
+# the Tracing attribution can show where MPMD wall goes vs lockstep
+# without flooding the stream.
 SPAN_NAMES = (
     "fleet.queue",
     "route",
@@ -88,6 +95,8 @@ SPAN_NAMES = (
     "verify",
     "failover.requeue",
     "ack",
+    "stage.dispatch",
+    "stage.relay",
 )
 
 # gap charging: the idle time between two consecutive spans belongs to

@@ -323,6 +323,20 @@ def main():
         "reference; 'default' = let the MXU use fast (bf16-input) passes",
     )
     ap.add_argument(
+        "--runtime",
+        choices=["lockstep", "mpmd"],
+        default="lockstep",
+        help="pipeline runtime (mesh layouts): 'lockstep' runs the whole "
+        "lattice as ONE SPMD program (tick scan, ppermute relays — the "
+        "correctness oracle); 'mpmd' compiles one program per stage role "
+        "and dispatches them asynchronously from the host with device-to-"
+        "device relays (arXiv 2412.14374) — bitwise-identical weights, "
+        "no noop-tick dispatches (the measured op-issue roofline, "
+        "docs/performance.md). mpmd drives the epoch loop (no "
+        "--fused-run) and excludes --zero1/--grad-bucket-bytes/"
+        "--clip-norm/--kernel-backend pallas for now",
+    )
+    ap.add_argument(
         "--kernel-backend",
         choices=["xla", "pallas"],
         default="xla",
@@ -358,6 +372,17 @@ def main():
         )
     if args.keep < 1:
         ap.error("--keep must be >= 1")
+    if args.runtime == "mpmd" and args.fused_run:
+        ap.error(
+            "--runtime mpmd schedules per-stage programs from the host; "
+            "the fused ONE-dispatch run is a lockstep contract — drop "
+            "--fused-run (the epoch loop dispatches MPMD)"
+        )
+    if args.runtime == "mpmd" and (args.dp, args.pp, args.tp) == (1, 1, 1):
+        ap.error(
+            "--runtime mpmd needs a mesh layout (dp/pp/tp > 1): the "
+            "sequential path has no pipeline stages to decompose"
+        )
     # "plan is active" mirrors faults.FaultPlan.parse: any non-empty
     # comma-separated part is an injection (checked without importing the
     # package — argparse time stays jax-free)
@@ -412,6 +437,7 @@ def main():
             checkpoint_keep=args.keep,
             async_checkpoint=args.async_checkpoint,
             aot_cache_dir=args.aot_cache,
+            runtime=args.runtime,
         )
     except CheckpointError as e:
         # unrecoverable checkpoint state: the named file (or every snapshot
@@ -461,6 +487,8 @@ def main():
                 note += f", step {run.step_in_epoch}"
         else:  # --resume auto on an empty checkpoint dir
             note = " no resumable checkpoint found — fresh start"
+    if args.runtime == "mpmd":
+        layout += ", mpmd runtime"
     print(
         f"devices={jax.devices()} layout: DP={args.dp} x PP={args.pp} x "
         f"TP={args.tp} ({layout}) batches/epoch={run.batches_per_epoch}" + note
@@ -644,7 +672,7 @@ def main():
                 **{
                     k: rec[k]
                     for k in (
-                        "program", "repeats", "host_wall_s",
+                        "program", "runtime", "repeats", "host_wall_s",
                         "host_wall_instrumented_s", "profiler_inflation",
                         "device_busy_s", "device_comm_s",
                         "device_compute_s", "op_events", "op_source",
