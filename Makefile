@@ -8,7 +8,7 @@ SHELL := /bin/bash
 
 .PHONY: test verify lint analyze-smoke metrics-smoke report-smoke \
         audit-smoke overlap-smoke split-smoke tp-smoke recovery-smoke \
-        aot-smoke serve-smoke chaos-smoke fleet-smoke trace-smoke \
+        aot-smoke serve-smoke chaos-smoke alerts-smoke fleet-smoke trace-smoke \
         mpmd-smoke bench-mpmd \
         bench-serving bench-ckpt-aot data train train-mesh bench \
         bench-scaling schedules clean
@@ -373,6 +373,67 @@ chaos-smoke:
 	  grep -q "availability" /tmp/chaos/$$lay.report.md; \
 	done
 	@echo "chaos-smoke OK: die/slow/nan/error + hot reload survived on dp2 and gpipe-pp4 — zero lost, bitwise parity, breaker recovered, zero recompiles, Degradation rendered"
+
+# live-telemetry end-to-end (docs/observability.md "Live telemetry &
+# alerting"): train a short dp2 run that leaves step checkpoints, then
+# soak its step-8 snapshot under the seeded chaos schedule WITH a live
+# background watcher tailing the metrics file as it is written. Asserts
+# the injected breaker trip fires the breaker_open alert rule and that
+# the SAME rule resolves after the breaker-triggered hot reload recovers
+# (firing strictly before resolved in the stream); that rollup records
+# stream alongside; that the live watcher's final --follow snapshot
+# equals the --once snapshot over the finished file BYTE FOR BYTE (the
+# determinism contract: windows close on record ts, never wall clock);
+# that a chaos-free twin soak fires ZERO alerts (no false positives)
+# while still emitting rollups + the sweep summary record; that --once
+# on a missing run exits 1; and that the report CLI renders the Alerts
+# section with a clean false-alert verdict. Exit 0.
+alerts-smoke:
+	rm -rf /tmp/alerts; mkdir -p /tmp/alerts
+	python -c "import numpy as np; from pathlib import Path; d=Path('/tmp/alerts/data'); d.mkdir(parents=True); rng=np.random.RandomState(0); [(np.save(d/('x_'+s+'.npy'), rng.rand(n,784).astype(np.float32)), np.save(d/('y_'+s+'.npy'), np.eye(10,dtype=np.float32)[rng.randint(0,10,n)])) for s,n in (('train',256),('val',96))]"
+	$(CPU_MESH) python train.py --data-dir /tmp/alerts/data --epochs 2 \
+	    --global-batch-size 32 --no-eval --dp 2 --mubatches 2 \
+	    --checkpoint-dir /tmp/alerts/ck --checkpoint-every-steps 8 \
+	    > /tmp/alerts/train.out
+	test -f /tmp/alerts/ck/step-00000008.npz \
+	    || { echo "no step-8 checkpoint to serve"; exit 1; }
+	set -e; \
+	python -m shallowspeed_tpu.observability.watch \
+	    '/tmp/alerts/chaos.jsonl*' --follow --format json \
+	    --interval 0.2 --idle-exit 30 --max-wall 600 \
+	    > /tmp/alerts/follow.json & WATCH=$$!; \
+	$(CPU_MESH) python -m shallowspeed_tpu.serving.bench_serving --dp 2 \
+	    --data-dir /tmp/alerts/data --global-batch-size 32 \
+	    --checkpoint /tmp/alerts/ck/step-00000008.npz \
+	    --chaos "error@dispatch=2,slow@dispatch=3:ms=20,die@dispatch=4,nan@dispatch=6" \
+	    --reload-dir /tmp/alerts/ck --reload-at 5 --breaker 2 \
+	    --retry-budget 2 --max-slots 2 --requests 60 --rates 300 \
+	    --slo-ms 2000 --seed 0 \
+	    --chaos-out /tmp/alerts/chaos.json \
+	    --metrics-out /tmp/alerts/chaos.jsonl; \
+	wait $$WATCH
+	python -c "from shallowspeed_tpu.observability.metrics import read_jsonl; recs=read_jsonl('/tmp/alerts/chaos.jsonl'); alerts=[r for r in recs if r['kind']=='alert']; br=[(a['state'],a['t']) for a in alerts if a['name']=='breaker_open']; assert br, 'breaker tripped but no breaker_open alert fired: '+str([(a['name'],a['state']) for a in alerts]); states=[s for s,_ in br]; assert states[0]=='firing' and 'resolved' in states, 'breaker_open never resolved after hot reload: '+str(br); assert states.index('firing')<states.index('resolved'); rolls=[r for r in recs if r['kind']=='rollup']; assert rolls, 'no rollup records streamed'; assert any(r['name']=='serving' for r in rolls); print('chaos soak: %d alert transitions (%s), %d rollup windows' % (len(alerts), ','.join(sorted({a['name'] for a in alerts})), len(rolls)))"
+	python -m shallowspeed_tpu.observability.watch '/tmp/alerts/chaos.jsonl*' \
+	    --once --format json > /tmp/alerts/once.json
+	cmp /tmp/alerts/follow.json /tmp/alerts/once.json \
+	    || { echo "--follow and --once snapshots diverge"; exit 1; }
+	$(CPU_MESH) python -m shallowspeed_tpu.serving.bench_serving --dp 2 \
+	    --data-dir /tmp/alerts/data --global-batch-size 32 \
+	    --checkpoint /tmp/alerts/ck/step-00000008.npz \
+	    --requests 60 --rates 300 --slo-ms 2000 --seed 0 \
+	    --out /tmp/alerts/clean_bench.json \
+	    --metrics-out /tmp/alerts/clean.jsonl
+	python -c "from shallowspeed_tpu.observability.metrics import read_jsonl; recs=read_jsonl('/tmp/alerts/clean.jsonl'); alerts=[r for r in recs if r['kind']=='alert']; assert alerts==[], 'clean twin fired FALSE alerts: '+str([(a['name'],a['state']) for a in alerts]); rolls=[r for r in recs if r['kind']=='rollup']; assert rolls, 'clean twin emitted no rollups'; sweeps=[r for r in recs if r['kind']=='serving' and r['name']=='sweep']; assert sweeps and 'knee_rps' in sweeps[0], 'no sweep summary record'; print('clean twin: 0 alerts, %d rollup windows, sweep knee=%s' % (len(rolls), sweeps[0]['knee_rps']))"
+	python -m shallowspeed_tpu.observability.watch /tmp/alerts/clean.jsonl \
+	    --once --format json > /tmp/alerts/clean_watch.json
+	python -c "import json; s=json.load(open('/tmp/alerts/clean_watch.json')); assert s['alerts']['fired']==0 and s['alerts']['active']==[], s['alerts']; assert s['records']>0 and s['malformed']==0"
+	! python -m shallowspeed_tpu.observability.watch \
+	    /tmp/alerts/nonexistent.jsonl --once --format json > /dev/null 2>&1
+	python -m shallowspeed_tpu.observability.report /tmp/alerts/chaos.jsonl \
+	    --format md --slo-ms 2000 > /tmp/alerts/report.md
+	grep -q "## Alerts" /tmp/alerts/report.md
+	grep -q "every fired rule is backed by fault evidence" /tmp/alerts/report.md
+	@echo "alerts-smoke OK: breaker_open fired and resolved under live watch, clean twin fired zero alerts, --follow == --once byte-for-byte, Alerts section rendered with clean false-alert verdict"
 
 # serving-fleet end-to-end (docs/serving.md "Fleet", docs/robustness.md
 # "Fleet failover"): train a short run that leaves step checkpoints, then

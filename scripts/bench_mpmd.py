@@ -122,12 +122,13 @@ def bench_dispatch_probes(data_dir, work, repeats, probe_samples=8192):
                 "host_wall_s", "device_busy_s", "device_comm_s",
                 "device_compute_s", "op_events", "op_source",
                 "profiler_inflation", "repeats", "runtime",
+                # the machine-checked validity guard (the record computes
+                # its own saturation verdict now — PR 16)
+                "events_per_batch", "window_valid",
+                "window_invalid_reason",
             )
         }
         row["batches_per_epoch"] = s.batches_per_epoch
-        row["events_per_batch"] = rec["op_events"] / (
-            repeats * s.batches_per_epoch
-        )
         out[rt] = row
     lock = out["lockstep"]["dispatch_overhead"]
     mp = out["mpmd"]["dispatch_overhead"]
@@ -155,12 +156,10 @@ def bench_full_epoch_probes(sessions, repeats):
             for k in (
                 "dispatch_overhead", "host_wall_s", "device_busy_s",
                 "device_comm_s", "op_events", "profiler_inflation",
-                "runtime",
+                "runtime", "events_per_batch", "window_valid",
+                "window_invalid_reason",
             )
         }
-        out[rt]["events_per_batch"] = rec["op_events"] / (
-            repeats * s.batches_per_epoch
-        )
     out["caveat"] = (
         "multi-second instrumented windows: the profiler buffer drops op "
         "events (compare events_per_batch against the bounded-window "

@@ -48,6 +48,10 @@ from shallowspeed_tpu.data import Dataset, default_data_dir
 from shallowspeed_tpu.observability import NullMetrics, costmodel, program_audit
 from shallowspeed_tpu.observability.flight import FlightRecorder
 from shallowspeed_tpu.observability.health import HealthError, make_monitor
+from shallowspeed_tpu.observability.slo import (
+    LiveTelemetry,
+    default_training_rules,
+)
 from shallowspeed_tpu.optimizer import (
     is_stateless,
     join_state,
@@ -128,6 +132,14 @@ class TrainingSession:
         # records, MFU gauges, pipeline program stats — flows through this
         # one recorder (docs/observability.md).
         self._metrics = metrics if metrics is not None else NullMetrics()
+        # live telemetry (schema v11, docs/observability.md § Live
+        # telemetry & alerting): per-step loss/throughput/MFU rollup
+        # windows plus the trainer rule set (health-event alerts, the
+        # checkpoint-overhead fraction vs its budget). Fed only inside
+        # metrics-enabled blocks — a NullMetrics session pays nothing.
+        self._telemetry = LiveTelemetry(
+            "train", metrics=self._metrics, rules=default_training_rules()
+        )
         # compiled-program audit (observability/program_audit.py): with a
         # metrics recorder attached, the jit-time collective census +
         # memory analysis is ALWAYS recorded (schema-v3 xla_audit record).
@@ -1187,7 +1199,20 @@ class TrainingSession:
             findings = self._health.check_epoch(
                 epoch_index, losses, gns, pns, first_step=first
             )
+            self._note_health_findings(findings)
             self._health.dispatch(findings, self._metrics)
+
+    def _note_health_findings(self, findings):
+        """Feed health findings to the alert rules BEFORE the policy
+        dispatch: under ``halt`` the dispatch raises, and the
+        ``training_health`` alert transition must already be in the
+        stream when it does — the fleet surface watching many runs
+        learns of the blow-up from the alert, not the stack trace."""
+        if not findings:
+            return
+        t = time.perf_counter()
+        for f in findings:
+            self._telemetry.note_health(t, f["check"])
 
     @property
     def global_step(self):
@@ -1310,6 +1335,10 @@ class TrainingSession:
                     record["mfu"] = mfu
                 self._metrics.event("epoch", **record)
                 self._metrics.counter("epochs_trained")
+                self._telemetry.note_step(
+                    time.perf_counter(), loss=epoch_loss, step_s=ew,
+                    throughput=sps, mfu=mfu,
+                )
             self.epoch += 1
             self.step_in_epoch = 0
             self._epoch_loss_sum = 0.0
@@ -1321,9 +1350,9 @@ class TrainingSession:
             if self._step_aux:
                 self._record_flight(epoch_index, aux)
             elif self._health is not None:
-                self._health.dispatch(
-                    self._health.check_epoch(epoch_index, [loss]), self._metrics
-                )
+                findings = self._health.check_epoch(epoch_index, [loss])
+                self._note_health_findings(findings)
+                self._health.dispatch(findings, self._metrics)
         except HealthError:
             self._flush_halt_checkpoint()
             raise
@@ -1434,7 +1463,10 @@ class TrainingSession:
                 rotate_dir=rotate_dir, rotate_keep=self._ckpt_keep,
                 trusted=trusted_now,
             )
-            completion(result, time.perf_counter() - t0)
+            wall = time.perf_counter() - t0
+            completion(result, wall)
+            if self._metrics.enabled:
+                self._telemetry.note_checkpoint(time.perf_counter(), wall)
             return path
         # async: the step path keeps ONLY the device->host readback (the
         # consistency point) — the logical unstacking (params()/
@@ -1481,6 +1513,13 @@ class TrainingSession:
         )
         wall_box["wall"] = time.perf_counter() - t0
         measured.set()
+        if self._metrics.enabled:
+            # the ON-PATH wall only (snapshot + enqueue) — the overhead
+            # fraction budgets what the step path pays, and this thread
+            # owns the telemetry state (the writer thread must not)
+            self._telemetry.note_checkpoint(
+                time.perf_counter(), wall_box["wall"]
+            )
         return path
 
     def drain_checkpoints(self):
@@ -1500,6 +1539,9 @@ class TrainingSession:
         if self._ckpt_writer is not None:
             writer, self._ckpt_writer = self._ckpt_writer, None
             writer.close()
+        # close the trailing partial rollup window before the flush, so
+        # the last training records are on disk with everything else
+        self._telemetry.flush()
         self._metrics.flush()
 
     def _flush_halt_checkpoint(self):
@@ -1584,6 +1626,10 @@ class TrainingSession:
                 self._metrics.observe("epoch.seconds", wall)
             self._metrics.counter("epochs_trained")
             self._metrics.counter("samples_trained", samples)
+            self._telemetry.note_step(
+                time.perf_counter(), loss=loss, step_s=wall,
+                throughput=sps, mfu=mfu,
+            )
         self._epoch_dispatched = True
         self.epoch += 1
         # flight recording + health checks LAST: session state is already
@@ -1596,9 +1642,9 @@ class TrainingSession:
                 # no per-step aux (kernel paths can't thread it — gradients
                 # never leave VMEM — or record_steps=False opted out): fall
                 # back to epoch-granular loss checks
-                self._health.dispatch(
-                    self._health.check_epoch(epoch_index, [loss]), self._metrics
-                )
+                findings = self._health.check_epoch(epoch_index, [loss])
+                self._note_health_findings(findings)
+                self._health.dispatch(findings, self._metrics)
         except HealthError:
             self._flush_halt_checkpoint()
             raise
@@ -1689,6 +1735,10 @@ class TrainingSession:
                 if mfu is not None:
                     record["mfu"] = mfu
                 self._metrics.event("epoch", **record)
+                self._telemetry.note_step(
+                    time.perf_counter(), loss=loss, step_s=wall / epochs,
+                    throughput=sps, mfu=mfu,
+                )
             self._metrics.observe("run.seconds", wall)
             self._metrics.counter("epochs_trained", epochs)
             self._metrics.counter("samples_trained", epochs * samples)
@@ -1698,6 +1748,7 @@ class TrainingSession:
             findings = self._health.check_run(
                 start, losses, None if gns is None else [float(v) for v in gns]
             )
+            self._note_health_findings(findings)
             self._health.dispatch(findings, self._metrics)
         return losses, accs_f
 
@@ -2140,7 +2191,20 @@ class TrainingSession:
 
         A trace with no attributable op events yields
         ``dispatch_overhead: None`` with the reason — never a fabricated
-        0."""
+        0.
+
+        VALIDITY GUARD (the DISPATCH_r01 caveat from
+        ``scripts/bench_mpmd.py``, machine-checked): a long instrumented
+        window can saturate the profiler's trace buffer — op events drop
+        out of the tail, the busy union undercounts, and the "overhead"
+        share inflates. The record therefore carries ``events_per_batch``
+        (op events per dispatched batch — epoch programs normalize by
+        ``repeats x batches_per_epoch``, rung probes by ``repeats``) and
+        a ``window_valid`` flag: ``False``, with
+        ``window_invalid_reason``, when the instrumented window exceeds
+        the profiler budget or the trace attributed no ops at all. The
+        report CLI renders the flag on its dispatch row; consumers must
+        not quote an invalid window's share as a measurement."""
         import tempfile
 
         from shallowspeed_tpu.observability import trace_stats
@@ -2196,6 +2260,28 @@ class TrainingSession:
         share = trace_stats.dispatch_overhead_share(
             busy["busy_union_s"], host_wall_s
         )
+        # the validity guard (docstring): flag windows whose evidence
+        # can't be trusted — never fabricate, never silently quote
+        window_budget_s = 5.0  # past this the trace buffer may saturate
+        batches = repeats * (
+            self.batches_per_epoch if program == "epoch" else 1
+        )
+        events_per_batch = (
+            busy["op_events"] / batches if batches else None
+        )
+        window_valid = True
+        window_invalid_reason = None
+        if not busy["op_events"]:
+            window_valid = False
+            window_invalid_reason = "trace holds no attributable op events"
+        elif wall_instrumented_s > window_budget_s:
+            window_valid = False
+            window_invalid_reason = (
+                f"instrumented window {wall_instrumented_s:.2f}s exceeds "
+                f"the {window_budget_s:g}s profiler budget — the trace "
+                f"buffer may have saturated (undercounted ops inflate "
+                f"the overhead share)"
+            )
         record = {
             "program": label,
             "runtime": self.runtime,
@@ -2210,6 +2296,9 @@ class TrainingSession:
             "device_compute_s": busy["compute_union_s"],
             "op_events": busy["op_events"],
             "op_source": busy["source"],
+            "events_per_batch": events_per_batch,
+            "window_valid": window_valid,
+            "window_invalid_reason": window_invalid_reason,
             # the headline: profiled op busy over the UNPROFILED wall — a
             # conservative lower bound (docstring); the in-window share
             # rides beside it

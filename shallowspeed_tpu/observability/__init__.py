@@ -44,6 +44,24 @@ evidence trail instead of prose:
                    for terminal requests), and the phase-attribution /
                    waterfall analysis behind the report's Tracing
                    section;
+- ``rollup``       streaming rollups (schema-v11 ``rollup`` records):
+                   tumbling-window online counters / gauges / EWMA rates
+                   and the mergeable log-bucketed ``QuantileSketch``
+                   (documented relative-error bound vs ``stats``'s
+                   percentile), closed purely on record timestamps with
+                   a bounded ring, plus the ``.r*``/``.p*`` shard merge
+                   that re-aligns windows via the tracing clock offsets;
+- ``slo``          SLO alerting (schema-v11 ``alert`` records):
+                   multi-window multi-burn-rate rules, event-triggered
+                   breaker/health rules, the firing→resolved lifecycle,
+                   the ``AlertSink`` hook (ROADMAP item 4's autoscaler
+                   contract) and ``LiveTelemetry`` — the rollup+rules
+                   sensor the engine, fleet and training session own;
+- ``watch``        the live dashboard CLI
+                   (``python -m shallowspeed_tpu.observability.watch``):
+                   tails live JSONL shards (``--follow``) or reads
+                   finished runs (``--once``), rendering current-window
+                   throughput / p50 / p99 / queue depth / alert state;
 - ``costmodel``    analytical MLP FLOPs + ``Compiled.cost_analysis()``
                    cross-check + MFU accounting (``model_flops``,
                    ``achieved_flops_per_sec``, ``mfu`` gauges per layout);
@@ -82,24 +100,46 @@ from shallowspeed_tpu.observability.metrics import (
     replica_shard_path,
 )
 from shallowspeed_tpu.observability.program_audit import AuditMismatchError
+from shallowspeed_tpu.observability.rollup import (
+    QuantileSketch,
+    RollupBuilder,
+    merge_rollup_records,
+)
+from shallowspeed_tpu.observability.slo import (
+    AlertSink,
+    BurnRateRule,
+    EventRule,
+    LiveTelemetry,
+    SloEvaluator,
+    ThresholdRule,
+)
 from shallowspeed_tpu.observability.spans import Span, capture, span
 from shallowspeed_tpu.observability.stats import ThroughputWindow, percentile
 from shallowspeed_tpu.observability.tracing import TraceError, Tracer
 
 __all__ = [
     "SCHEMA_VERSION",
+    "AlertSink",
     "AuditMismatchError",
+    "BurnRateRule",
+    "EventRule",
     "FlightRecorder",
     "HealthError",
     "HealthMonitor",
     "JsonlMetrics",
+    "LiveTelemetry",
     "MetricsRecorder",
     "NullMetrics",
+    "QuantileSketch",
+    "RollupBuilder",
+    "SloEvaluator",
     "Span",
+    "ThresholdRule",
     "ThroughputWindow",
     "TraceError",
     "Tracer",
     "capture",
+    "merge_rollup_records",
     "percentile",
     "read_jsonl",
     "replica_shard_path",

@@ -88,6 +88,18 @@ Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
      "span_id": ..., "parent_id": ...|null, "t0": ..., "t1": ...,
      "clock": "parent"|"worker", "replica_id": r|null,
      "terminal": bool, **fields}                                    [v10+]
+    {"v": 11, "ts": ..., "kind": "rollup",   "name": <source:
+     "serving"|"fleet"|"train"|...>, "window_start": ...,
+     "window_end": ..., "window_s": ..., "seq": i, "counters":
+     {metric: total}, "rates": {metric: {"rate": ..., "ewma": ...}},
+     "gauges": {metric: last}, "quantiles": {metric: {"count": n,
+     "sum": ..., "min": ..., "max": ..., "p50": ..., "p90": ...,
+     "p99": ...}}, "sketches": {metric: <QuantileSketch.to_dict()>},
+     "late": n, "replica_id": r|null}                               [v11+]
+    {"v": 11, "ts": ..., "kind": "alert",    "name": <rule>,
+     "state": "firing"|"resolved", "severity": "page"|"ticket",
+     "t": ..., "value": ..., "threshold": ..., "burn_fast": ...,
+     "burn_slow": ..., "reason": ..., "replica_id": r|null}         [v11+]
 
 Schema compatibility rules (SCHEMA_VERSION history):
 
@@ -192,6 +204,24 @@ Schema compatibility rules (SCHEMA_VERSION history):
   accepts v1–v9 files unchanged and the strict refusal stays
   one-directional (a v11 file is refused).
 
+- v11 ADDITIVE: the ``rollup`` (one CLOSED tumbling telemetry window,
+  observability/rollup.py, docs/observability.md § Live telemetry:
+  named by the emitting source — ``serving``/``fleet``/``train`` —
+  carrying the window bounds in the emitter's record-timestamp domain,
+  per-metric counter totals, per-window + EWMA rates, last-value
+  gauges, quantile summaries AND the full mergeable ``QuantileSketch``
+  state so shard rollups can be re-merged exactly, the late-sample
+  count, and the emitting ``replica_id`` — the existing shard join
+  key) and ``alert`` (one SLO alert lifecycle TRANSITION,
+  observability/slo.py: named by the rule, carrying ``state``
+  ``firing``/``resolved``, severity, the observed value vs threshold,
+  the fast/slow burn rates for burn-rate rules, and the human
+  ``reason``) kinds — the sensor-and-alarm evidence stream behind
+  ``observability.watch``, the report CLI's Alerts section and
+  ROADMAP item 4's autoscaler. No existing kind or field changed
+  meaning; the v11 reader accepts v1–v10 files unchanged and the
+  strict refusal stays one-directional (a v12 file is refused).
+
 The contract for future bumps: additive kinds/fields bump the version and
 must keep old records readable; any change to an EXISTING kind's meaning
 requires a new kind name instead. Consumers must ignore unknown fields on
@@ -223,7 +253,7 @@ import time
 
 from shallowspeed_tpu.observability.spans import Span
 
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 SCHEMA_NAME = "shallowspeed_tpu.metrics"
 
 # The schema table: every record kind this schema version can write,
@@ -258,6 +288,8 @@ SCHEMA_KINDS = {
     "aot_cache": 8,
     "static_analysis": 9,
     "trace": 10,
+    "rollup": 11,
+    "alert": 11,
 }
 
 
@@ -342,6 +374,12 @@ class NullMetrics:
         pass
 
     def trace(self, name, **fields):
+        pass
+
+    def rollup(self, name, **fields):
+        pass
+
+    def alert(self, name, **fields):
         pass
 
     def flush(self):
@@ -452,6 +490,12 @@ class MetricsRecorder:
 
     def trace(self, name, **fields):
         self._emit({"kind": "trace", "name": name, **fields})
+
+    def rollup(self, name, **fields):
+        self._emit({"kind": "rollup", "name": name, **fields})
+
+    def alert(self, name, **fields):
+        self._emit({"kind": "alert", "name": name, **fields})
 
     # -- recorder-internal hooks --------------------------------------------
 

@@ -72,7 +72,17 @@ human or a bench gate actually asks of a run:
   phase, and per-request text waterfalls for the worst-k requests.
   Trace-free files render unchanged. A ``dispatch_overhead`` event (the
   ``train.py --dispatch-probe`` measured op-issue roofline) renders as
-  its own summary row.
+  its own summary row, flagged ``WINDOW INVALID`` when the probe's
+  machine-checked validity guard refused the window (saturated trace
+  buffer / no op events — the share must not be quoted clean);
+- an ALERTS section (schema-v11 ``rollup``/``alert`` records,
+  docs/observability.md § Live telemetry & alerting): the SLO alert
+  firing→resolved timeline with peak burn rates and the still-firing
+  set at end of stream, a FALSE-ALERT verdict (every fired rule is
+  checked against the fault evidence that would justify it — chaos runs
+  must alert, clean runs must not, and an unbacked firing is named),
+  and rollup-backed trend sparklines (per-window throughput, p99
+  latency, training loss). Pre-v11 files render unchanged.
 
 ``--baseline`` compares throughput against another run's JSONL or a
 bench-style JSON record (``{"value": ..., "unit": "samples/s"}``, or a
@@ -256,6 +266,8 @@ def build_report(records, source="", trace=None, slo_ms=None):
     fleet = _fleet_info(records)
     static_analysis = _static_analysis_info(records)
     tracing_info = _tracing_info(records, slo_ms)
+    alerts = _alerts_info(records)
+    rollups = _rollups_info(records)
 
     dispatch_overhead = None
     for r in records:
@@ -307,8 +319,143 @@ def build_report(records, source="", trace=None, slo_ms=None):
         "fleet": fleet,
         "static_analysis": static_analysis,
         "tracing": tracing_info,
+        "alerts": alerts,
+        "rollups": rollups,
         "dispatch_overhead": dispatch_overhead,
     }
+
+
+# the fault evidence that JUSTIFIES each alert rule's firing: an alert
+# with none of its evidence kinds anywhere in the stream is a FALSE
+# alert (the alerts-smoke clean-twin contract — chaos runs must alert,
+# clean runs must not, and a firing nobody can trace to a fault is
+# named, never glossed). predicate(record) -> the record is evidence.
+_ALERT_EVIDENCE = {
+    "breaker_open": lambda r: (
+        r.get("kind") == "serving_health" and r.get("name") == "breaker_open"
+    ),
+    "fleet_degraded": lambda r: (
+        r.get("kind") == "fleet_health" and r.get("name") == "fleet_degraded"
+    ),
+    "error_burn": lambda r: (
+        r.get("kind") == "request" and r.get("name") in ("error", "unhealthy")
+    ),
+    "p99_slo": lambda r: r.get("kind") == "request",
+    "knee_proximity": lambda r: r.get("kind") == "request",
+    "training_health": lambda r: r.get("kind") == "health",
+    "checkpoint_overhead": lambda r: r.get("kind") == "checkpoint",
+}
+
+
+def _alerts_info(records):
+    """Fold the schema-v11 ``alert`` records into the Alerts story; None
+    when the run recorded none (pre-v11 files render exactly as
+    before). The firing→resolved timeline, the still-firing set at end
+    of stream (per rule + replica), the peak burn rates seen at any
+    transition, and the false-alert verdict: every fired rule is checked
+    against the fault evidence that would justify it."""
+    alerts = [r for r in records if r.get("kind") == "alert"]
+    if not alerts:
+        return None
+    timeline = []
+    active = {}  # (rule, replica_id) -> last transition record
+    fired = resolved = 0
+    peak_fast = peak_slow = None
+    for r in alerts:
+        state = r.get("state")
+        if state == "firing":
+            fired += 1
+        elif state == "resolved":
+            resolved += 1
+        for key, peak in (("burn_fast", "fast"), ("burn_slow", "slow")):
+            v = r.get(key)
+            if _finite(v):
+                if peak == "fast":
+                    peak_fast = v if peak_fast is None else max(peak_fast, v)
+                else:
+                    peak_slow = v if peak_slow is None else max(peak_slow, v)
+        entry = {
+            "rule": r.get("name"),
+            "state": state,
+            "severity": r.get("severity"),
+            "t": r.get("t"),
+            "value": r.get("value"),
+            "threshold": r.get("threshold"),
+            "reason": r.get("reason"),
+            "replica_id": r.get("replica_id"),
+        }
+        timeline.append(entry)
+        k = (entry["rule"], entry["replica_id"])
+        if state == "firing":
+            active[k] = entry
+        else:
+            active.pop(k, None)
+    false_alerts = []
+    for rule in sorted({e["rule"] for e in timeline if e["state"] == "firing"}):
+        evidence = _ALERT_EVIDENCE.get(rule)
+        if evidence is not None and not any(evidence(r) for r in records):
+            false_alerts.append(rule)
+    return {
+        "transitions": len(timeline),
+        "fired": fired,
+        "resolved": resolved,
+        "timeline": timeline,
+        "still_firing": sorted(
+            f"{rule}" + (f" (r{rid})" if rid is not None else "")
+            for rule, rid in active
+        ),
+        "peak_burn_fast": peak_fast,
+        "peak_burn_slow": peak_slow,
+        "false_alerts": false_alerts,
+    }
+
+
+def _rollups_info(records):
+    """Fold the schema-v11 ``rollup`` records into per-source trend
+    series; None when the run recorded none. Sources are keyed
+    ``name`` or ``name (rN)`` for replica-tagged shards; each carries
+    the per-window terminal/step rate and p99 latency — the evidence
+    behind the trend sparklines."""
+    rollups = [r for r in records if r.get("kind") == "rollup"]
+    if not rollups:
+        return None
+    by_source = {}
+    for r in rollups:
+        rid = r.get("replica_id")
+        key = r.get("name", "?") + (f" (r{rid})" if rid is not None else "")
+        by_source.setdefault(key, []).append(r)
+    sources = {}
+    for key, recs in sorted(by_source.items()):
+        recs = sorted(
+            recs, key=lambda r: (r.get("window_start") or 0, r.get("seq") or 0)
+        )
+        rates = []
+        p99s = []
+        losses = []
+        for r in recs:
+            rr = r.get("rates") or {}
+            rate = (rr.get("terminal") or {}).get("rate")
+            if rate is None:
+                rate = (rr.get("steps") or {}).get("rate")
+            rates.append(rate if _finite(rate) else 0.0)
+            p99 = ((r.get("quantiles") or {}).get("latency_s") or {}).get(
+                "p99"
+            )
+            if _finite(p99):
+                p99s.append(p99)
+            loss = ((r.get("gauges") or {}).get("loss") or {}).get("last")
+            if _finite(loss):
+                losses.append(loss)
+        sources[key] = {
+            "windows": len(recs),
+            "window_s": recs[-1].get("window_s"),
+            "late": sum(int(r.get("late") or 0) for r in recs),
+            "rate_trend": rates,
+            "p99_latency_s": (max(p99s) if p99s else None),
+            "p99_trend": p99s or None,
+            "loss_trend": losses or None,
+        }
+    return {"windows": len(rollups), "sources": sources}
 
 
 def _tracing_info(records, slo_ms=None):
@@ -920,6 +1067,12 @@ def _rows(report):
                 f"{_fmt_time_s(do.get('host_wall_s'))} uninstrumented "
                 f"wall; measured lower bound, {do.get('op_source')})"
             )
+        if do.get("window_valid") is False:
+            # the machine-checked probe-validity guard (api.py): an
+            # invalid window's share is flagged, never quoted clean
+            detail += "  [WINDOW INVALID: " + str(
+                do.get("window_invalid_reason") or "unknown"
+            ) + "]"
         rows.append(("dispatch overhead", detail))
     sa = report.get("static_analysis")
     if sa is not None:
@@ -1418,6 +1571,80 @@ def _tracing_lines(tr, md):
     return lines
 
 
+def _alerts_lines(alerts, rollups, md):
+    """Render the Alerts section (schema v11): the firing→resolved
+    timeline, peak burn rates, the false-alert verdict, and the
+    rollup-backed trend sparklines. Runs with neither alerts nor
+    rollups render nothing — pre-v11 files are untouched."""
+    if alerts is None and rollups is None:
+        return []
+    lines = ["## Alerts" if md else "alerts:"]
+    if alerts is None:
+        lines.append("no alert transitions recorded")
+    else:
+        lines.append(
+            f"{alerts['fired']} fired / {alerts['resolved']} resolved "
+            f"({alerts['transitions']} transition(s))"
+            + (
+                "; STILL FIRING at end of stream: "
+                + ", ".join(alerts["still_firing"])
+                if alerts["still_firing"]
+                else "; all resolved"
+            )
+        )
+        for e in alerts["timeline"]:
+            where = f" (r{e['replica_id']})" if e["replica_id"] is not None else ""
+            t = f"t={e['t']:.3f}s " if _finite(e.get("t")) else ""
+            lines.append(
+                f"- {t}{e['rule']}{where} {e['state'].upper()} "
+                f"[{e['severity']}]: {e.get('reason') or ''}"
+            )
+        if alerts["peak_burn_slow"] is not None:
+            lines.append(
+                f"peak burn rate: {alerts['peak_burn_slow']:.2f}x budget "
+                f"(long window), {alerts['peak_burn_fast']:.2f}x (short) "
+                "at the recorded transitions"
+            )
+        if alerts["false_alerts"]:
+            lines.append(
+                "FALSE ALERT(S): "
+                + ", ".join(alerts["false_alerts"])
+                + " fired with no supporting fault evidence in the stream"
+            )
+        else:
+            lines.append(
+                "false-alert check: every fired rule is backed by fault "
+                "evidence in the stream"
+            )
+    if rollups is not None:
+        lines.append(
+            f"rollups: {rollups['windows']} window(s) across "
+            f"{len(rollups['sources'])} source(s)"
+        )
+        for key, src in rollups["sources"].items():
+            detail = (
+                f"- {key}: {src['windows']} x {src['window_s']:g}s windows"
+            )
+            if src["late"]:
+                detail += f", {src['late']} late sample(s)"
+            lines.append(detail)
+            if any(v for v in src["rate_trend"]):
+                lines.append(
+                    f"    rate     {sparkline(src['rate_trend'])}"
+                )
+            if src.get("p99_trend"):
+                lines.append(
+                    f"    p99      {sparkline(src['p99_trend'])}  "
+                    f"(max {_fmt_time_s(src['p99_latency_s'])})"
+                )
+            if src.get("loss_trend"):
+                lines.append(
+                    f"    loss     {sparkline(src['loss_trend'])}"
+                )
+    lines.append("")
+    return lines
+
+
 def render(report, fmt, comparison=None):
     if fmt == "json":
         out = dict(report)
@@ -1448,6 +1675,9 @@ def render(report, fmt, comparison=None):
     lines.extend(_serving_lines(report.get("serving"), md))
     lines.extend(_fleet_lines(report.get("fleet"), md))
     lines.extend(_tracing_lines(report.get("tracing"), md))
+    lines.extend(
+        _alerts_lines(report.get("alerts"), report.get("rollups"), md)
+    )
     header = "## Span breakdown" if md else "span breakdown:"
     lines.append(header)
     if report["spans"]:

@@ -48,6 +48,16 @@ section with ``python -m shallowspeed_tpu.observability.report
 <metrics-out>*`` to see per-phase latency attribution and the worst-k
 request waterfalls (docs/observability.md § Tracing).
 
+The stream also carries the live telemetry (schema v11): tumbling
+``rollup`` windows and SLO ``alert`` transitions from the engine — or,
+in fleet mode, from the parent AND each replica's ``.r*`` shard. Tail a
+running server with ``python -m shallowspeed_tpu.observability.watch
+<metrics-out> --follow``, or render a finished run with ``--once``.
+``--knee-rps`` arms the knee-proximity alert rule with the measured
+saturation knee from a ``bench_serving`` sweep record (the rule stays
+off without it — measured evidence only, docs/observability.md § Live
+telemetry & alerting).
+
 Exit codes (aligned with train.py's documented contract):
   0  clean — including a signal-drained run whose accepted requests all
      served;
@@ -133,6 +143,13 @@ def main(argv=None):
         "--rows", default="1,2,3,4,8", help="request row-count choices"
     )
     ap.add_argument("--slo-ms", type=float, default=None)
+    ap.add_argument(
+        "--knee-rps",
+        type=float,
+        default=None,
+        help="measured saturation knee (bench_serving sweep record's "
+        "knee_rps) — arms the knee-proximity alert rule; absent = rule off",
+    )
     ap.add_argument(
         "--deadline-ms",
         type=float,
@@ -288,6 +305,7 @@ def main(argv=None):
         retry=args.retry_budget,
         breaker_threshold=args.breaker,
         faults=args.faults,
+        knee_rps=args.knee_rps,
     )
     payloads = request_payloads(
         args.requests,
@@ -447,6 +465,7 @@ def _fleet_main(args):
             retry=args.retry_budget,
             breaker_threshold=args.breaker,
             faults=args.faults,
+            knee_rps=args.knee_rps,
         ),
         "verify": args.verify,
     }
@@ -459,6 +478,7 @@ def _fleet_main(args):
         retry=args.fleet_retry,
         metrics=metrics,
         seed=args.seed,
+        knee_rps=args.knee_rps,
     )
     print(
         f"fleet: {args.fleet} replicas x (DP={args.dp} x PP={args.pp} x "

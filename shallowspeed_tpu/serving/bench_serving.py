@@ -138,7 +138,8 @@ def sweep(
         rec = engine.record_summary(offered_rps=rate)
         rows.append({k: rec.get(k) for k in SWEEP_ROW_FIELDS})
     bound = session.inference_latency_bound()
-    return {
+    knee_rps = find_knee(rows, slo_ms)
+    record = {
         "bench": "serving",
         "bench_version": BENCH_VERSION,
         "config": {
@@ -157,8 +158,22 @@ def sweep(
         "latency_bound_ticks": bound["ticks"],
         "latency_bound_source": bound["peak_source"],
         "sweep": rows,
-        "knee_rps": find_knee(rows, slo_ms),
+        "knee_rps": knee_rps,
     }
+    if metrics is not None:
+        # the sweep summary in the metrics stream too (schema v11): the
+        # measured knee lands beside the run it came from, so the
+        # knee-proximity alert rule can be armed from the record —
+        # never from a hand-copied constant (slo.default_serving_rules)
+        metrics.serving(
+            "sweep",
+            knee_rps=knee_rps,
+            rates=[r.get("offered_rps") for r in rows],
+            slo_ms=slo_ms,
+            requests_per_rate=n_requests,
+            latency_bound_s=bound["seconds"],
+        )
+    return record
 
 
 def chaos_soak(

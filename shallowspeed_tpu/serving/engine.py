@@ -97,6 +97,17 @@ terminal ``ack`` — keyed by a ``trace_id`` minted at submit, or carried
 in from the fleet router with the parent span id so chains stay linked
 across the pipe. Spans are emitted CLOSED, at the request's completion:
 a killed process leaves exactly the spans it finished.
+
+Live telemetry (schema v11, docs/observability.md § Live telemetry &
+alerting): the engine owns a ``slo.LiveTelemetry`` sensor — every
+terminal verdict, queue-depth sample and health event feeds tumbling
+rollup windows (closed on ENGINE-CLOCK timestamps, emitted as
+``rollup`` records) and the SLO rule set (``breaker_open`` event rule,
+error burn rate, p99-vs-SLO and knee-proximity threshold rules when
+the evidence exists), whose firing→resolved transitions emit ``alert``
+records and call any attached ``AlertSink``. ``status()`` is the live
+snapshot surface ``observability.watch`` and ROADMAP item 4's
+autoscaler read.
 """
 
 import time
@@ -112,6 +123,7 @@ from shallowspeed_tpu.checkpoint import (
     find_newer_good,
 )
 from shallowspeed_tpu.observability import NullMetrics
+from shallowspeed_tpu.observability.slo import LiveTelemetry
 from shallowspeed_tpu.observability.stats import ThroughputWindow, percentile
 from shallowspeed_tpu.observability.tracing import Tracer
 from shallowspeed_tpu.serving import slots as serving_slots
@@ -209,6 +221,14 @@ class ServingEngine:
     backpressure; ``faults`` is a chaos plan (spec string / FaultPlan;
     only ``@dispatch=`` anchors are consulted here — defaults to the
     ``SHALLOWSPEED_FAULTS`` environment plan, like the session).
+
+    Live telemetry (module docstring): ``telemetry_window_s`` sets the
+    tumbling rollup width; ``knee_rps`` (a MEASURED ``bench_serving``
+    sweep result) arms the knee-proximity alert rule; ``alert_rules``
+    overrides the default rule set (``[]`` disables alerting);
+    ``alert_sinks`` is the ``slo.AlertSink`` consumer list;
+    ``replica_id`` tags this engine's rollup/alert records inside a
+    fleet worker (the shard join key).
     """
 
     def __init__(
@@ -227,6 +247,11 @@ class ServingEngine:
         shed_on_submit=False,
         faults=None,
         tracer=None,
+        telemetry_window_s=1.0,
+        knee_rps=None,
+        alert_rules=None,
+        alert_sinks=(),
+        replica_id=None,
     ):
         self._session = session
         self._slot_rows = session.slot_rows
@@ -269,6 +294,21 @@ class ServingEngine:
         # (worker clock domain, no terminal ack — the parent owns that)
         self._tracer = (
             tracer if tracer is not None else Tracer(self._metrics, process="e")
+        )
+        # live telemetry (module docstring): rollup windows + SLO rules,
+        # fed from the terminal-verdict/queue/health call sites below.
+        # knee_rps comes from a measured bench_serving sweep record (the
+        # knee-proximity rule refuses hand-copied constants by absence);
+        # alert_rules=None builds the default serving set, [] disables.
+        self._telemetry = LiveTelemetry(
+            "serving",
+            metrics=self._metrics,
+            window_s=telemetry_window_s,
+            rules=alert_rules,
+            sinks=alert_sinks,
+            replica_id=replica_id,
+            slo_ms=slo_ms,
+            knee_rps=knee_rps,
         )
         self._latency_floor = None  # lazy: inference_latency_bound seconds
         # sequential sessions dispatch only the OCCUPIED slots (one fixed
@@ -341,6 +381,7 @@ class ServingEngine:
     def _record_depth(self, t):
         self._depths.append((t, len(self._queue)))
         self._metrics.gauge("serving.queue_depth", len(self._queue))
+        self._telemetry.note_queue_depth(t, len(self._queue))
 
     def _floor_s(self):
         """The analytical per-dispatch latency floor (lazy — one
@@ -422,6 +463,7 @@ class ServingEngine:
             self._trace_ack(req, reason="admission_estimate")
             return req
         self._queue.append(req)
+        self._telemetry.note_admit(t)
         self._record_depth(t)
         return req
 
@@ -848,6 +890,12 @@ class ServingEngine:
         if reason is not None:
             fields["reason"] = reason
         self._metrics.request(req.verdict, **fields)
+        # one telemetry sample per terminal verdict — this is the single
+        # choke point every terminal path (ok, shed, drop, error) crosses
+        t = req.complete_t if req.complete_t is not None else req.enqueue_t
+        self._telemetry.note_request(
+            t, req.verdict, latency_s=req.latency_s, queue_s=req.queue_s
+        )
 
     # -- tracing (schema v10; module docstring span taxonomy) ---------------
 
@@ -903,8 +951,29 @@ class ServingEngine:
 
     def _record_health(self, name, **fields):
         self._metrics.serving_health(name, **fields)
+        self._telemetry.note_health(self.clock(), name, **fields)
 
     # -- accounting ---------------------------------------------------------
+
+    def status(self):
+        """The LIVE snapshot surface (module docstring): operational
+        state + the current/last rollup window + active alerts — cheap,
+        JSON-able, and callable mid-traffic (everything here is the
+        engine's own single-threaded state). This is what
+        ``observability.watch`` renders and what ROADMAP item 4's
+        autoscaler polls between ``AlertSink`` edges."""
+        return {
+            "queue_depth": len(self._queue),
+            "degraded": self._degraded,
+            "dispatch_seq": self._dispatch_seq,
+            "dispatches": self._dispatches,
+            "consecutive_failures": self._consecutive_failures,
+            "breaker_trips": self._breaker_trips,
+            "reloads": self._reloads,
+            "loaded_step": self._loaded_step,
+            "alerts_active": self._telemetry.evaluator.active(),
+            "telemetry": self._telemetry.snapshot(),
+        }
 
     def stats(self):
         """Aggregate accounting over everything served since the last
@@ -979,7 +1048,11 @@ class ServingEngine:
     def record_summary(self, offered_rps=None, name="summary"):
         """Emit (and return) the ``serving`` summary record: ``stats()``
         plus the offered load and the analytical latency floor
-        (``costmodel.serving_latency_bound`` — ticks x per-tick cost)."""
+        (``costmodel.serving_latency_bound`` — ticks x per-tick cost).
+        The live-telemetry window still open at summary time is flushed
+        first, so the trailing partial ``rollup`` record lands before
+        the summary it feeds."""
+        self._telemetry.flush()
         rec = self.stats()
         rec["offered_rps"] = offered_rps
         rec["slot_rows"] = self._slot_rows

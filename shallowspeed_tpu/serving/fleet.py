@@ -87,6 +87,19 @@ completion, so the report's Tracing section can attribute the tail
 latency a death costs (``make trace-smoke`` gates on zero orphan
 chains).
 
+Live telemetry (schema v11, docs/observability.md § Live telemetry &
+alerting): the parent owns a fleet-level ``slo.LiveTelemetry`` sensor —
+every fleet-terminal verdict, router queue-depth sample and
+``fleet_degraded``/``fleet_recovered`` edge feeds tumbling ``rollup``
+windows (closed on PARENT-CLOCK timestamps) and the SLO rule set,
+whose firing→resolved transitions emit ``alert`` records and call any
+attached ``AlertSink`` (ROADMAP item 4's autoscaler hook). Each WORKER
+engine runs its own sensor tagged with its ``replica_id`` into its
+``.r*`` shard, so ``observability.watch`` tails the whole fleet from
+the shard glob and ``rollup.merge_rollup_records`` re-aligns the
+per-replica windows through the clock offsets above. ``status()`` is
+the live snapshot surface the watch CLI and the autoscaler poll.
+
 The same "many independent programs, dispatched asynchronously from one
 host" shape is where the MPMD pipeline direction (arXiv 2412.14374) is
 headed; this module's process/IPC plumbing is deliberately generic
@@ -103,6 +116,7 @@ import numpy as np
 from shallowspeed_tpu import retry as R
 from shallowspeed_tpu.observability import NullMetrics
 from shallowspeed_tpu.observability.metrics import replica_shard_path
+from shallowspeed_tpu.observability.slo import LiveTelemetry
 from shallowspeed_tpu.observability.stats import ThroughputWindow, percentile
 from shallowspeed_tpu.observability.tracing import Tracer
 from shallowspeed_tpu.serving.router import (
@@ -268,6 +282,9 @@ def _worker_main(conn, config):
             inner, process=f"r{rid}", replica_id=rid,
             clock_domain="worker", terminal_ack=False,
         )
+        # the worker's sensor tags every rollup/alert record with this
+        # replica's id — the join key the shard merge aligns windows by
+        engine_kwargs.setdefault("replica_id", rid)
         engine = ServingEngine(
             session, metrics=tap, clock=clock, tracer=tracer,
             **engine_kwargs,
@@ -533,6 +550,14 @@ class ServingFleet:
     complete as ``"error"``/``no_routable_replica`` — ``drain()`` is
     bounded by construction, like the engine's. A fleet with NO live
     replica fails its queue immediately (``fleet_down``).
+
+    ``telemetry_window_s`` / ``knee_rps`` / ``alert_rules`` /
+    ``alert_sinks`` configure the fleet-level live-telemetry sensor
+    (module docstring). ``alert_rules=None`` builds the default serving
+    set (``slo.default_serving_rules`` — its ``fleet_degraded`` event
+    rule is the deterministic alerting gate at this level), ``[]``
+    disables alerting while keeping the rollup windows; ``knee_rps``
+    must come from a measured ``bench_serving`` sweep record.
     """
 
     def __init__(
@@ -550,6 +575,10 @@ class ServingFleet:
         spawn_timeout_s=300.0,
         seed=0,
         clock=time.perf_counter,
+        telemetry_window_s=1.0,
+        knee_rps=None,
+        alert_rules=None,
+        alert_sinks=(),
     ):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
@@ -589,6 +618,19 @@ class ServingFleet:
         # terminal ack) and records each worker's clock-offset estimate
         self._tracer = Tracer(self._metrics, process="f")
         self._probe_every_s = 2.0  # re-probe cadence piggybacking heartbeats
+        # live telemetry (module docstring): the fleet-level sensor.
+        # Windows close on parent-clock timestamps; worker engines run
+        # their own replica-tagged sensors into the .r* shards. No
+        # replica_id here — the parent's records are the fleet-wide view.
+        self._telemetry = LiveTelemetry(
+            "fleet",
+            metrics=self._metrics,
+            window_s=telemetry_window_s,
+            rules=alert_rules,
+            sinks=alert_sinks,
+            slo_ms=slo_ms,
+            knee_rps=knee_rps,
+        )
         # completions collected OUTSIDE step() (wait_ready pumps the
         # pipes too) are stashed and returned by the next step() — a
         # completed request must always reach a caller's hands
@@ -843,6 +885,7 @@ class ServingFleet:
             self._complete(req, "dropped", reason="fleet_queue_full")
             return req
         req.admitted = True
+        self._telemetry.note_admit(t)
         self._record_depth(t)
         return req
 
@@ -938,9 +981,17 @@ class ServingFleet:
                     replica_id=info.replica_id,
                     last_health=info.last_health,
                 )
+                self._telemetry.note_health(
+                    self.clock(), "replica_degraded",
+                    replica_id=info.replica_id,
+                )
             elif was_degraded and not info.degraded:
                 self._metrics.fleet_health(
                     "replica_recovered", replica_id=info.replica_id
+                )
+                self._telemetry.note_health(
+                    self.clock(), "replica_recovered",
+                    replica_id=info.replica_id,
                 )
             # keep the clock estimate fresh: one probe per heartbeat
             # window, piggybacking the traffic that already flows
@@ -1082,6 +1133,9 @@ class ServingFleet:
             inflight=len(inflight),
             error=h.fatal_error,
         )
+        self._telemetry.note_health(
+            self.clock(), "replica_dead", replica_id=info.replica_id
+        )
         if was_working and self._impair_t is None:
             self._impair_t = self.clock()
         if not inflight:
@@ -1132,6 +1186,10 @@ class ServingFleet:
                 target=self._target,
                 quorum=quorum(self._target),
             )
+            self._telemetry.note_health(
+                self.clock(), "fleet_degraded",
+                healthy=healthy, target=self._target,
+            )
             self._metrics.flush()
         elif not degraded_now and self._degraded:
             self._degraded = False
@@ -1140,6 +1198,10 @@ class ServingFleet:
                 replica_id=None,
                 healthy=healthy,
                 target=self._target,
+            )
+            self._telemetry.note_health(
+                self.clock(), "fleet_recovered",
+                healthy=healthy, target=self._target,
             )
 
     def _route(self, done):
@@ -1342,6 +1404,11 @@ class ServingFleet:
         req.complete_t = t
         req.reason = reason
         self._trace_ack(req, t, reason)
+        # one telemetry sample per fleet-terminal verdict — every path
+        # (ok, shed, drop, failover-exhausted) crosses this choke point
+        self._telemetry.note_request(
+            t, verdict, latency_s=req.latency_s, queue_s=req.queue_s
+        )
         if verdict == "ok":
             self._samples.append((req.latency_s, req.queue_s, req.deadline_ms))
             self._serve_window.note_complete(t)
@@ -1405,6 +1472,37 @@ class ServingFleet:
         self._depth_sum += depth
         self._depth_n += 1
         self._metrics.gauge("fleet.queue_depth", depth)
+        self._telemetry.note_queue_depth(t, depth)
+
+    def status(self):
+        """The LIVE snapshot surface (module docstring): operational
+        state + per-replica heartbeat view + the current/last rollup
+        window + active alerts — cheap, JSON-able, callable
+        mid-traffic (everything here is parent-process state; no pipe
+        round trips). The fleet mirror of ``ServingEngine.status()``:
+        what ``observability.watch`` renders and what ROADMAP item 4's
+        autoscaler polls between ``AlertSink`` edges."""
+        infos = [h.info for h in self._replicas.values()]
+        return {
+            "queue_depth": len(self._router.queue),
+            "inflight": self.inflight,
+            "degraded": self._degraded,
+            "replicas_target": self._target,
+            "replicas_ready": self.n_ready,
+            "replicas_dead": self._replicas_dead,
+            "per_replica": {
+                i.replica_id: {
+                    "state": i.state,
+                    "queue_depth": i.queue_depth,
+                    "degraded": i.degraded,
+                    "inflight": i.inflight,
+                    "last_health": i.last_health,
+                }
+                for i in infos
+            },
+            "alerts_active": self._telemetry.evaluator.active(),
+            "telemetry": self._telemetry.snapshot(),
+        }
 
     def stats(self):
         """Fleet-wide aggregate: the engine's summary fields measured on
@@ -1475,7 +1573,11 @@ class ServingFleet:
         """Emit (and return) the fleet's evidence pair: the schema-v7
         ``fleet`` summary (per-replica detail, routing skew, failover +
         scale accounting) plus a fleet-wide ``serving`` summary so the
-        report's Serving section reads the fleet like one big engine."""
+        report's Serving section reads the fleet like one big engine.
+        The live-telemetry window still open at summary time is flushed
+        first, so the trailing partial ``rollup`` record lands before
+        the summary it feeds."""
+        self._telemetry.flush()
         rec = self.stats()
         rec["offered_rps"] = offered_rps
         self._metrics.fleet("summary", **rec)

@@ -111,6 +111,8 @@ def test_null_metrics_hot_path_zero_net_allocation():
             m.serving_health("b")  # ... and the v6 degradation hooks
             m.reload("r")
             m.trace("t")  # ... and the v10 tracing hook
+            m.rollup("w")  # ... and the v11 live-telemetry hooks
+            m.alert("a")
 
     burst(100)  # warm up caches (method cache, code objects)
     # background threads (XLA's pools) can allocate a handful of blocks at
@@ -897,17 +899,13 @@ def test_schema_v10_trace(tmp_path):
     record with trace/span/parent ids, raw clock-domain endpoints and the
     terminal flag, plus the ``clock_offset`` alignment records — round
     trips with the version stamp (non-finite endpoint values survive the
-    strict-JSON sanitizer as strings), the v10 reader accepts v1-v9 files
-    unchanged, a v11 file is refused, and NullMetrics no-ops the new
-    hook. Carries the version pin and the one-ahead refusal (the
-    newest-schema convention)."""
+    strict-JSON sanitizer as strings), the v10+ reader accepts v1-v9
+    files unchanged, and NullMetrics no-ops the hook. (The version pin
+    and one-ahead refusal moved to the v11 test — the newest-schema
+    convention.)"""
     from shallowspeed_tpu.observability.metrics import SCHEMA_KINDS
 
-    assert SCHEMA_VERSION == 10
-    # the registry IS the docstring's kind list: every recorder hook has
-    # a registered kind, and the newest kind carries the newest version
     assert SCHEMA_KINDS["trace"] == 10
-    assert max(SCHEMA_KINDS.values()) == SCHEMA_VERSION
     path = tmp_path / "v10.jsonl"
     with JsonlMetrics(path) as m:
         m.trace(
@@ -947,12 +945,62 @@ def test_schema_v10_trace(tmp_path):
         p = tmp_path / f"trace-old-v{v}.jsonl"
         p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
         assert read_jsonl(p)[0]["kind"] == rec["kind"]
-    # one-directional refusal: a v11 file fails loudly
-    v11 = tmp_path / "v11.jsonl"
-    v11.write_text(json.dumps({"v": 11, "kind": "event"}) + "\n")
-    with pytest.raises(ValueError, match="newer"):
-        read_jsonl(v11)
     NullMetrics().trace("worker.queue", trace_id="x")
+
+
+def test_schema_v11_rollup_alert(tmp_path):
+    """Schema v11 (additive): the ``rollup`` (closed tumbling-window
+    summary) and ``alert`` (firing/resolved transition) kinds round trip
+    with the version stamp, the v11 reader accepts v1-v10 files
+    unchanged, a v12 file is refused, and NullMetrics no-ops both new
+    hooks. Carries the version pin and the one-ahead refusal (the
+    newest-schema convention)."""
+    from shallowspeed_tpu.observability.metrics import SCHEMA_KINDS
+
+    assert SCHEMA_VERSION == 11
+    # the registry IS the docstring's kind list: every recorder hook has
+    # a registered kind, and the newest kinds carry the newest version
+    assert SCHEMA_KINDS["rollup"] == 11
+    assert SCHEMA_KINDS["alert"] == 11
+    assert max(SCHEMA_KINDS.values()) == SCHEMA_VERSION
+    path = tmp_path / "v11.jsonl"
+    with JsonlMetrics(path) as m:
+        m.rollup(
+            "serving", window_start=12.0, window_end=13.0, window_s=1.0,
+            seq=0, counters={"ok": 41, "terminal": 42}, late=0,
+            rates={"terminal": {"rate": 42.0, "ewma": 40.1}},
+            gauges={"queue_depth": {"last": 3, "min": 0, "max": 7}},
+            quantiles={"latency_s": {"p50": 0.004, "p99": 0.02}},
+            replica_id=None,
+        )
+        m.alert(
+            "breaker_open", rule="breaker_open", state="firing",
+            severity="page", t=12.75, value="breaker_open",
+            threshold=None, burn_fast=None, burn_slow=None,
+            reason="health event 'breaker_open'", replica_id=0,
+        )
+    recs = read_jsonl(path)
+    assert [r["kind"] for r in recs] == ["meta", "rollup", "alert"]
+    assert all(r["v"] == SCHEMA_VERSION for r in recs)
+    assert recs[1]["counters"]["terminal"] == 42
+    assert recs[1]["quantiles"]["latency_s"]["p99"] == 0.02
+    assert recs[2]["state"] == "firing" and recs[2]["replica_id"] == 0
+    # v1-v10 files load unchanged under the v11 reader
+    for v, rec in (
+        (1, {"kind": "event", "name": "epoch", "epoch": 0, "loss": 0.5}),
+        (5, {"kind": "request", "name": "ok", "id": 1}),
+        (10, {"kind": "trace", "name": "ack", "trace_id": "f-1"}),
+    ):
+        p = tmp_path / f"rollup-old-v{v}.jsonl"
+        p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
+        assert read_jsonl(p)[0]["kind"] == rec["kind"]
+    # one-directional refusal: a v12 file fails loudly
+    v12 = tmp_path / "v12.jsonl"
+    v12.write_text(json.dumps({"v": 12, "kind": "event"}) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        read_jsonl(v12)
+    NullMetrics().rollup("serving", counters={})
+    NullMetrics().alert("breaker_open", state="firing")
 
 
 def test_replica_shard_suffix_and_fallback_read(tmp_path):

@@ -652,6 +652,13 @@ def main():
                 f"events, source {rec['op_source']}, profiler inflation "
                 f"{rec['profiler_inflation']:.2f}x)"
             )
+        if not rec["window_valid"]:
+            # the machine-checked DISPATCH_r01 caveat: an invalid probe
+            # window's share must never be quoted as a measurement
+            print(
+                "dispatch-probe window INVALID: "
+                + (rec["window_invalid_reason"] or "unknown")
+            )
         if args.dispatch_probe_out:
             bench_rec = {
                 "bench": "dispatch_overhead",
@@ -676,6 +683,8 @@ def main():
                         "host_wall_instrumented_s", "profiler_inflation",
                         "device_busy_s", "device_comm_s",
                         "device_compute_s", "op_events", "op_source",
+                        "events_per_batch", "window_valid",
+                        "window_invalid_reason",
                         "dispatch_overhead_instrumented", "provenance",
                     )
                 },
