@@ -8,6 +8,7 @@ SHELL := /bin/bash
 
 .PHONY: test verify lint analyze-smoke metrics-smoke report-smoke \
         audit-smoke overlap-smoke split-smoke tp-smoke recovery-smoke \
+        diverge-smoke \
         aot-smoke serve-smoke chaos-smoke alerts-smoke fleet-smoke trace-smoke \
         mpmd-smoke bench-mpmd \
         bench-serving bench-ckpt-aot data train train-mesh bench \
@@ -270,6 +271,70 @@ recovery-smoke:
 	  grep -q "async checkpointing: " /tmp/rsmoke/async.report.md; \
 	  grep -q "recovery: resumed from" /tmp/rsmoke/async.report.md
 	@echo "recovery-smoke OK: kill-at-step-11 + resume auto is bitwise identical to the uninterrupted twin on dp2 and gpipe-pp4 (plus SIGKILL-mid-async-save), Reliability section rendered"
+
+# Numerics-provenance end-to-end (docs/numerics.md "Divergence
+# debugging"): on dp2 and gpipe-pp4, train twin runs with --digests and
+# assert the divergence CLI exits 0 (streams bitwise-equal), then inject
+# a deterministic single-bit param flip (SHALLOWSPEED_FAULTS flip@step=11
+# — finite, invisible to loss/health) and assert the CLI exits 2 naming
+# EXACTLY (step 11, layer 0, W), that --bisect restores the last agreeing
+# per-step snapshot, replays ONE step with the flip re-armed, and
+# reproduces the same attribution with ULP evidence, and that the report
+# CLI renders the Divergence section. Exit 0.
+diverge-smoke:
+	rm -rf /tmp/dsmoke; mkdir -p /tmp/dsmoke
+	python -c "import numpy as np; from pathlib import Path; d=Path('/tmp/dsmoke/data'); d.mkdir(parents=True); rng=np.random.RandomState(0); [(np.save(d/('x_'+s+'.npy'), rng.rand(n,784).astype(np.float32)), np.save(d/('y_'+s+'.npy'), np.eye(10,dtype=np.float32)[rng.randint(0,10,n)])) for s,n in (('train',256),('val',96))]"
+	set -e; for lay in dp2 pp4; do \
+	  if [ $$lay = dp2 ]; then LFLAGS="--dp 2 --mubatches 2"; \
+	  else LFLAGS="--pp 4 --schedule gpipe --mubatches 4"; fi; \
+	  COMMON="--data-dir /tmp/dsmoke/data --epochs 2 --global-batch-size 32 --no-eval --digests --checkpoint-every-steps 1 --keep 20"; \
+	  $(CPU_MESH) python train.py $$COMMON $$LFLAGS \
+	      --checkpoint-dir /tmp/dsmoke/ck_$${lay}_a \
+	      --metrics-out /tmp/dsmoke/$$lay.a.jsonl > /tmp/dsmoke/$$lay.a.out; \
+	  $(CPU_MESH) python train.py $$COMMON $$LFLAGS \
+	      --checkpoint-dir /tmp/dsmoke/ck_$${lay}_b \
+	      --metrics-out /tmp/dsmoke/$$lay.b.jsonl > /tmp/dsmoke/$$lay.b.out; \
+	  python -m shallowspeed_tpu.observability.divergence \
+	      /tmp/dsmoke/$$lay.a.jsonl /tmp/dsmoke/$$lay.b.jsonl \
+	      > /tmp/dsmoke/$$lay.twin.cmp; \
+	  grep -q "IDENTICAL" /tmp/dsmoke/$$lay.twin.cmp \
+	      || { echo "$$lay: twin streams not identical"; exit 1; }; \
+	  echo "$$lay: twin digest streams bitwise-equal (exit 0)"; \
+	  $(CPU_MESH) env SHALLOWSPEED_FAULTS="flip@step=11" \
+	      python train.py $$COMMON $$LFLAGS \
+	      --checkpoint-dir /tmp/dsmoke/ck_$${lay}_f \
+	      --metrics-out /tmp/dsmoke/$$lay.f.jsonl > /tmp/dsmoke/$$lay.f.out; \
+	  rc=0; python -m shallowspeed_tpu.observability.divergence \
+	      /tmp/dsmoke/$$lay.a.jsonl /tmp/dsmoke/$$lay.f.jsonl \
+	      > /tmp/dsmoke/$$lay.flip.cmp || rc=$$?; \
+	  test $$rc -eq 2 \
+	      || { echo "$$lay: flip compare exit $$rc, wanted 2"; exit 1; }; \
+	  grep -q "first divergence: step 11 layer 0 tensor W" \
+	      /tmp/dsmoke/$$lay.flip.cmp \
+	      || { echo "$$lay: flip not attributed to (step 11, layer 0, W)"; \
+	           cat /tmp/dsmoke/$$lay.flip.cmp; exit 1; }; \
+	  echo "$$lay: injected flip named at exactly (step 11, layer 0, W) (exit 2)"; \
+	  rc=0; $(CPU_MESH) python -m shallowspeed_tpu.observability.divergence \
+	      /tmp/dsmoke/$$lay.a.jsonl /tmp/dsmoke/$$lay.f.jsonl \
+	      --bisect /tmp/dsmoke/ck_$${lay}_a /tmp/dsmoke/ck_$${lay}_f \
+	      > /tmp/dsmoke/$$lay.bisect.out || rc=$$?; \
+	  test $$rc -eq 2 \
+	      || { echo "$$lay: bisect exit $$rc, wanted 2"; exit 1; }; \
+	  grep -q "divergence is INSIDE step 11" /tmp/dsmoke/$$lay.bisect.out \
+	      || { echo "$$lay: bisect did not isolate step 11"; \
+	           cat /tmp/dsmoke/$$lay.bisect.out; exit 1; }; \
+	  grep -q "replay attribution MATCHES" /tmp/dsmoke/$$lay.bisect.out \
+	      || { echo "$$lay: replay attribution mismatch"; \
+	           cat /tmp/dsmoke/$$lay.bisect.out; exit 1; }; \
+	  grep -q "max ulp 1" /tmp/dsmoke/$$lay.bisect.out \
+	      || { echo "$$lay: expected a 1-ulp flip in the replay diff"; exit 1; }; \
+	  echo "$$lay: bisect replay reproduced the flip (1 ulp at layer 0 W)"; \
+	  python -m shallowspeed_tpu.observability.report \
+	      /tmp/dsmoke/$$lay.f.jsonl --format md > /tmp/dsmoke/$$lay.report.md; \
+	  grep -q "## Divergence" /tmp/dsmoke/$$lay.report.md \
+	      || { echo "$$lay: report missing Divergence section"; exit 1; }; \
+	done
+	@echo "diverge-smoke OK: twin streams identical (exit 0), flip@step=11 named at (step 11, layer 0, W) (exit 2), bisect replay reproduces the 1-ulp flip, Divergence section rendered, on dp2 and gpipe-pp4"
 
 # AOT executable cache end-to-end (docs/performance.md): cold-compile a
 # dp2 rung ladder into the cache, RESTART the process and assert every

@@ -81,6 +81,7 @@ def bench_sequential(nb, reps, sizes=SIZES):
 def _pipeline_epoch_setup(
     dp, pp, sched_name, nb, virtual=1, sizes=SIZES, zero1=False,
     optimizer=None, grad_bucket_bytes=0, backward_split=False, tp=1,
+    digests=False,
 ):
     """Build one mesh config's epoch fn + initial state + data: the shared
     setup behind the plain timing rows and the same-window pairs. Returns
@@ -105,7 +106,7 @@ def _pipeline_epoch_setup(
     opt = make_optimizer(optimizer, 2e-4) if optimizer else SGD(LR)
     epoch = E.make_pipeline_epoch(
         mesh, spec, prog, B // dp // M, opt, zero1=zero1,
-        grad_bucket_bytes=grad_bucket_bytes,
+        grad_bucket_bytes=grad_bucket_bytes, with_digests=digests,
     )
     st = E.zero1_init_state(opt, spec, mesh) if zero1 else opt.init(stacked)
     X, Y = _data(nb, np.random.RandomState(0))
@@ -189,6 +190,58 @@ def bench_sync_pair(name, cfg, nb):
                 "zero1": zero1,
                 "same_window": True,
                 "vs_anchor": round(sps / anchor_sps, 4),
+            }
+        )
+    return records
+
+
+# digests-off vs digests-on pairs: same-window via the interleaved-trial
+# slope protocol. The digest aux (per-layer uint32 checksums + norms as
+# extra scan ys, one psum over the pipeline axes — docs/numerics.md
+# § Divergence debugging) is designed to be cheap next to the matmuls;
+# this pair MEASURES that claim instead of asserting it. Records carry
+# `digests` so a multichip capture of these rows is self-describing.
+DIGEST_PAIRS = [
+    ("dp2-digests", dict(dp=2, pp=1, sched="gpipe")),
+    ("pp4-gpipe-digests", dict(dp=1, pp=4, sched="gpipe")),
+]
+
+
+def bench_digest_pair(name, cfg, nb):
+    """One digests-off-vs-on pair, same-window: returns a list of record
+    dicts (one per mode) carrying the digests flag + vs_off ratio — the
+    measured on-path overhead of the numerics-provenance aux."""
+    from bench import make_run_k, slope_epoch_seconds_many
+
+    dp, pp = cfg["dp"], cfg["pp"]
+    modes = {f"{name}-off": False, f"{name}-on": True}
+    run_ks = {}
+    for label, dig in modes.items():
+        _, epoch, stacked, flags, st, Xj, Yj = _pipeline_epoch_setup(
+            dp, pp, cfg["sched"], nb, digests=dig
+        )
+
+        # the digests leg returns a 4th output (the digest aux) — the
+        # timed loop still carries it to the host boundary, which is the
+        # honest cost, but bench's run_k unpacks 3
+        def epoch_fn(p, s, X, Y, _epoch=epoch, _flags=flags):
+            out = _epoch(p, _flags, s, X, Y)
+            return out[0], out[1], out[2]
+
+        run_ks[label] = make_run_k(epoch_fn, stacked, st, Xj, Yj)
+    slopes = slope_epoch_seconds_many(run_ks, k1=1, k2=3, trials=2, min_delta_s=0)
+    off_sps = nb * B / slopes[f"{name}-off"]
+    records = []
+    for label, dig in modes.items():
+        sps = nb * B / slopes[label]
+        records.append(
+            {
+                "config": label,
+                "devices": dp * pp,
+                "samples_per_sec": round(sps, 1),
+                "digests": dig,
+                "same_window": True,
+                "vs_off": round(sps / off_sps, 4),
             }
         )
     return records
@@ -403,6 +456,16 @@ def main():
             print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
             continue
         for rec in bench_split_pair(name, cfg, args.batches):
+            print(json.dumps(rec))
+
+    # the digests-off-vs-on pairs (same-window per pair): the measured
+    # on-path overhead of the numerics-provenance aux
+    for name, cfg in DIGEST_PAIRS:
+        need = cfg["dp"] * cfg["pp"]
+        if need > n_dev:
+            print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
+            continue
+        for rec in bench_digest_pair(name, cfg, args.batches):
             print(json.dumps(rec))
 
     # the sequential-vs-tensor-parallel pairs (same-window per pair)

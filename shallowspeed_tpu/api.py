@@ -115,6 +115,7 @@ class TrainingSession:
         metrics=None,
         health=None,
         record_steps=None,
+        digests=False,
         audit=False,
         checkpoint_dir=None,
         checkpoint_keep=3,
@@ -332,6 +333,12 @@ class TrainingSession:
                     "runtime='lockstep'"
                 )
             record_steps = False
+            if digests:
+                raise ValueError(
+                    "runtime='mpmd' does not thread the per-step digest aux "
+                    "(the per-layer checksum grids ride the lockstep epoch "
+                    "scan); pass digests=False or use runtime='lockstep'"
+                )
 
         self.epoch = 0
         # step cursor within the current epoch: 0 except after a mid-epoch
@@ -611,6 +618,38 @@ class TrainingSession:
                 "record_steps is unavailable on the kernel paths: the "
                 "gradient never leaves the Pallas kernel's VMEM"
             )
+        # numerics-provenance aux (docs/numerics.md "Divergence
+        # debugging"): per-step per-layer digest grids (uint32 bitcast
+        # checksums + block norms) out of the SAME fused epoch program,
+        # emitted as schema-v12 ``digest`` records. Opt-in only — the
+        # default keeps today's programs byte-identical.
+        if digests and kernel_path:
+            raise ValueError(
+                "digests is unavailable on the kernel paths: params/grads "
+                "never leave the Pallas kernel's VMEM, so the per-layer "
+                "digest aux cannot be threaded out"
+            )
+        self._digests = bool(digests)
+        if self._digests and self._metrics.enabled:
+            # replay provenance for the bisect CLI (observability/
+            # divergence.py --bisect): everything needed to reconstruct a
+            # numerically identical session and re-arm its injections —
+            # ``die`` faults are stripped at replay time, step faults
+            # (nan/flip) must fire again or the divergence won't reproduce
+            self._metrics.event(
+                "digest_config",
+                sizes=list(sizes), dp=dp, pp=pp, tp=self.tp,
+                schedule=schedule, global_batch_size=global_batch_size,
+                mubatches=mubatches, lr=lr, precision=precision,
+                optimizer=optimizer, momentum=momentum,
+                virtual_stages=virtual_stages, zero1=zero1,
+                grad_bucket_bytes=grad_bucket_bytes,
+                backward_split=backward_split, scan_unroll=scan_unroll,
+                tick_unroll=tick_unroll, weight_decay=weight_decay,
+                clip_norm=clip_norm, fuse_mubatches=fuse_mubatches,
+                data_dir=None if data_dir is None else str(data_dir),
+                faults=",".join(repr(f) for f in self._faults.faults),
+            )
         self._step_aux = bool(record_steps) and not kernel_path
         self.flight = FlightRecorder() if self._step_aux else None
         if self.flight is not None:
@@ -646,6 +685,7 @@ class TrainingSession:
                 epoch_kernel=epoch_kernel or run_kernel,
                 with_grad_norm=self._epoch_aux,
                 with_step_stats=self._step_aux,
+                with_digests=self._digests,
             )
             self._predict = trainer.make_predict(self.spec, precision=self.precision)
             self._run_kwargs = dict(
@@ -757,6 +797,7 @@ class TrainingSession:
                     clip_norm=clip_norm, kernel_backend=kernel_backend,
                     with_grad_norm=self._epoch_aux,
                     with_step_stats=self._step_aux,
+                    with_digests=self._digests,
                     grad_bucket_bytes=grad_bucket_bytes,
                 )
             self._prog = prog
@@ -1202,6 +1243,49 @@ class TrainingSession:
             self._note_health_findings(findings)
             self._health.dispatch(findings, self._metrics)
 
+    def _record_digests(self, epoch_index, first_step, dig):
+        """Host side of the numerics-provenance stream: read the fused
+        per-step digest aux back (same single post-dispatch readback as
+        the flight recorder) and emit one schema-v12 ``digest`` record per
+        optimizer step, with the per-GLOBAL-layer checksum/norm lists in
+        logical layer order on every layout (the mesh aux's (S, L) grids
+        are indexed through the stacked-row permutation)."""
+        host = {k: np.asarray(v) for k, v in dig.items()}
+        rows = self._digest_layer_index()
+        mesh = host["crc_w"].ndim == 3  # (nb, S, L) vs sequential (nb, L)
+        nb = host["crc_w"].shape[0]
+        for i in range(nb):
+            fields = {}
+            for k, a in host.items():
+                col = a[i]
+                vals = [col[r, l] for r, l in rows] if mesh else list(col)
+                cast = int if k.startswith("crc") else float
+                fields[k] = [cast(v) for v in vals]
+            self._metrics.digest(
+                "train",
+                step=first_step + i,
+                epoch=epoch_index,
+                layers=len(rows),
+                **fields,
+            )
+
+    def _digest_layer_index(self):
+        """Per-global-layer (row, col) addresses into the digest aux's
+        (S, L) grids, in logical layer order: stage s's layer l lives at
+        row ``row_of[s]`` (the stacked-row permutation — identity unless
+        virtual stages interleave) and column l. Sequential aux is already
+        (L_total,) in logical order; the addresses still enumerate it."""
+        idx = getattr(self, "_digest_rows", None)
+        if idx is None:
+            order = self._order or range(self.spec.n_stages)
+            row_of = {s: r for r, s in enumerate(order)}
+            idx = self._digest_rows = [
+                (row_of[s], l)
+                for s in range(self.spec.n_stages)
+                for l in range(self.spec.stages[s].n_linears)
+            ]
+        return idx
+
     def _note_health_findings(self, findings):
         """Feed health findings to the alert rules BEFORE the policy
         dispatch: under ``halt`` the dispatch raises, and the
@@ -1281,6 +1365,9 @@ class TrainingSession:
                 elif fault.kind == "nan":
                     fault.fired = True
                     self.poison_weights()
+                elif fault.kind == "flip":
+                    fault.fired = True
+                    self.flip_weights()
                 fault = self._faults.first_in(g0, g0 + (k1 - k0))
             if fault is not None:
                 k1 = k0 + (fault.step - g0)  # fault lands on a boundary
@@ -1296,7 +1383,13 @@ class TrainingSession:
                 self._stacked, self._opt_state, mean_loss = out[0], out[1], out[2]
             loss = float(mean_loss)  # forces device completion
         wall = time.perf_counter() - t0
-        aux = out[3] if (self._epoch_aux or self._step_aux) else None
+        aux = (
+            out[3]
+            if (self._epoch_aux or self._step_aux or self._digests)
+            else None
+        )
+        if self._digests and self._metrics.enabled:
+            self._record_digests(epoch_index, g0, aux["digests"])
         self._epoch_dispatched = True
         steps = k1 - k0
         self.step_in_epoch = k1
@@ -1599,7 +1692,16 @@ class TrainingSession:
             else:
                 self._stacked, self._opt_state, mean_loss = out[0], out[1], out[2]
             loss = float(mean_loss)  # forces device completion
-        aux = out[3] if (self._epoch_aux or self._step_aux) else None
+        aux = (
+            out[3]
+            if (self._epoch_aux or self._step_aux or self._digests)
+            else None
+        )
+        if self._digests and self._metrics.enabled:
+            self._record_digests(
+                epoch_index, epoch_index * self.batches_per_epoch,
+                aux["digests"],
+            )
         if self._metrics.enabled:
             wall = time.perf_counter() - t0
             samples = self.batches_per_epoch * self.B
@@ -1677,6 +1779,12 @@ class TrainingSession:
                 f"train_steps() before a fused train_run()"
             )
         self._refuse_pending_faults("train_run")
+        if self._digests:
+            raise ValueError(
+                "digests ride the epoch/step scan aux, which the fused "
+                "multi-epoch run program does not thread — drive digest "
+                "sessions with train_epoch()/train_steps()"
+            )
         if with_eval and self._vx is None:
             self._load_val()
         if self._metrics.enabled or self._audit_strict:
@@ -2362,6 +2470,18 @@ class TrainingSession:
             self._params = F.poison_nan(self._params)
         else:
             self._stacked = F.poison_nan(self._stacked)
+
+    def flip_weights(self):
+        """Fault-injection hook (faults.py): XOR the lowest mantissa bit
+        of one element of this session's live weights — the training
+        ``flip@step=N`` injection. The result stays finite, so nothing in
+        the loss/health stream moves; only the per-layer digest stream
+        (``digests=True``) can name the (step, layer) it happened at —
+        exactly what ``make diverge-smoke`` verifies."""
+        if self._sequential:
+            self._params = F.poison_bitflip(self._params)
+        else:
+            self._stacked = F.poison_bitflip(self._stacked)
 
     def load_weights(self, path, verified=None):
         """HOT-swap this session's weights from a checkpoint, between

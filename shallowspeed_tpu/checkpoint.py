@@ -624,6 +624,29 @@ def find_latest_good(ckpt_dir, require_finite=True, with_arrays=False):
     return None, None, skipped
 
 
+def find_step_at_or_before(ckpt_dir, step, require_finite=True):
+    """Bisect-replay discovery (observability/divergence.py --bisect):
+    the NEWEST verifying step snapshot with ``global_step <= step``.
+    Returns ``(found_step, path, meta, skipped)`` — ``skipped`` lists
+    ``(path, cause)`` for every candidate in range that failed
+    verification — or ``(None, None, None, skipped)`` when nothing at or
+    before ``step`` verifies. The digest at step N covers the params
+    AFTER step N's update (= the ``step-(N+1)`` snapshot's contents), so
+    the replayer restores at-or-before the last AGREEING step and trains
+    forward to the first divergent one."""
+    skipped = []
+    for s, p in reversed(list_step_checkpoints(ckpt_dir)):
+        if s > step:
+            continue
+        try:
+            meta = verify_checkpoint(p, require_finite=require_finite)
+        except CheckpointError as e:
+            skipped.append((p, e.cause))
+            continue
+        return s, p, meta, skipped
+    return None, None, None, skipped
+
+
 # ---------------------------------------------------------------------------
 # the async checkpoint writer
 # ---------------------------------------------------------------------------

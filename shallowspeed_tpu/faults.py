@@ -16,6 +16,7 @@ step (``kind@step=N[:mode=...]``), a SERVING dispatch
     SHALLOWSPEED_FAULTS="die@step=7:mode=sigkill"     # hard kill at step 7
     SHALLOWSPEED_FAULTS="die@step=7"                  # raise InjectedFault
     SHALLOWSPEED_FAULTS="nan@step=3"                  # NaN into the gradients
+    SHALLOWSPEED_FAULTS="flip@step=3"                 # single-bit param flip
     SHALLOWSPEED_FAULTS="die@step=9,nan@step=3"       # compose
     SHALLOWSPEED_FAULTS="error@dispatch=4"            # raise INSIDE dispatch 4
     SHALLOWSPEED_FAULTS="slow@dispatch=6:ms=50"       # stall dispatch 6 50 ms
@@ -54,6 +55,13 @@ from inside a jitted program — an instrumented run executes the same XLA):
             (serving) come out NaN — the deterministic blow-up the
             numerics health monitor / the serving health gate exists to
             catch.
+- ``flip``  (step only) XOR the LOWEST mantissa bit of exactly one
+            parameter element (flat index 0 of the first weight leaf —
+            the same deterministic anchor ``nan`` poisons) right before
+            step N: the silent single-bit corruption that stays finite,
+            evades the health monitor, and only the per-layer digest
+            stream (``--digests`` + observability/divergence) can
+            attribute to its exact (step, layer, tensor).
 - ``slow``  (dispatch/save) sleep ``ms`` inside dispatch N — the latency
             spike that drives deadline shedding — or inside save N's
             write window (after the temp write, before the rename), so
@@ -83,7 +91,7 @@ import signal
 import numpy as np
 
 ENV_VAR = "SHALLOWSPEED_FAULTS"
-KINDS = ("die", "nan")  # step-triggered (training) kinds
+KINDS = ("die", "nan", "flip")  # step-triggered (training) kinds
 SERVING_KINDS = ("die", "nan", "slow", "error")  # dispatch-triggered kinds
 SAVE_KINDS = ("die", "slow", "corrupt")  # save-triggered (writer) kinds
 DIE_MODES = ("exc", "sigkill")
@@ -309,6 +317,37 @@ def poison_nan(params):
     out = jax.tree.map(poison, params)
     if not poisoned[0]:
         raise ValueError("no array leaf to poison in params")
+    return out
+
+
+def poison_bitflip(params):
+    """The ``flip`` injection body: return ``params`` with the LOWEST
+    mantissa bit of flat element 0 of the first weight leaf XOR-flipped —
+    the same deterministic anchor ``poison_nan`` uses (global layer 0's W
+    on every layout: both the sequential stage list and the stacked slot
+    dict visit that block first), so the divergence CLI's attribution can
+    be asserted against a known (step, layer, tensor). A 1-ulp flip stays
+    finite, which is the point: nothing but the digest stream sees it."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    flipped = [False]
+
+    def flip(x):
+        if flipped[0] or not hasattr(x, "shape") or x.ndim < 1 or x.size == 0:
+            return x
+        flipped[0] = True
+        flat = jnp.ravel(jnp.asarray(x))
+        bits = lax.bitcast_convert_type(
+            flat[0].astype(jnp.float32), jnp.uint32
+        ) ^ jnp.uint32(1)
+        new0 = lax.bitcast_convert_type(bits, jnp.float32)
+        return flat.at[0].set(new0.astype(flat.dtype)).reshape(x.shape)
+
+    out = jax.tree.map(flip, params)
+    if not flipped[0]:
+        raise ValueError("no array leaf to bit-flip in params")
     return out
 
 

@@ -100,6 +100,10 @@ Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
      "state": "firing"|"resolved", "severity": "page"|"ticket",
      "t": ..., "value": ..., "threshold": ..., "burn_fast": ...,
      "burn_slow": ..., "reason": ..., "replica_id": r|null}         [v11+]
+    {"v": 12, "ts": ..., "kind": "digest",   "name": <source: "train">,
+     "step": <global step>, "epoch": ..., "layers": n,
+     "crc_w": [uint32 ...], "crc_b": [...], "pnorm_w": [float ...],
+     "pnorm_b": [...], "gnorm_w": [...], "gnorm_b": [...]}          [v12+]
 
 Schema compatibility rules (SCHEMA_VERSION history):
 
@@ -222,6 +226,22 @@ Schema compatibility rules (SCHEMA_VERSION history):
   meaning; the v11 reader accepts v1–v10 files unchanged and the
   strict refusal stays one-directional (a v12 file is refused).
 
+- v12 ADDITIVE: the ``digest`` kind (one per optimizer step, named by
+  the emitting source — ``train`` — carrying ``step`` (the 0-based
+  GLOBAL step index), ``epoch``, ``layers`` and parallel
+  per-global-layer lists: ``crc_w``/``crc_b`` — the uint32 wrap-around
+  sums of each logical (W, b) block's POST-update float32 bytes
+  reinterpreted as uint32 words, computed in-program as fused scan aux
+  and psum'd over the mesh so the value is layout-independent — plus
+  ``pnorm_w``/``pnorm_b`` (post-update per-block L2 norms) and
+  ``gnorm_w``/``gnorm_b`` (post-sync, PRE-clip per-block gradient L2
+  norms)) — the numerics-provenance stream behind
+  ``observability.divergence`` (first-divergence attribution and
+  checkpoint-bisect replay) and the report CLI's Divergence section.
+  No existing kind or field changed meaning; the v12 reader accepts
+  v1–v11 files unchanged and the strict refusal stays one-directional
+  (a v13 file is refused).
+
 The contract for future bumps: additive kinds/fields bump the version and
 must keep old records readable; any change to an EXISTING kind's meaning
 requires a new kind name instead. Consumers must ignore unknown fields on
@@ -253,7 +273,7 @@ import time
 
 from shallowspeed_tpu.observability.spans import Span
 
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 SCHEMA_NAME = "shallowspeed_tpu.metrics"
 
 # The schema table: every record kind this schema version can write,
@@ -290,6 +310,7 @@ SCHEMA_KINDS = {
     "trace": 10,
     "rollup": 11,
     "alert": 11,
+    "digest": 12,
 }
 
 
@@ -380,6 +401,9 @@ class NullMetrics:
         pass
 
     def alert(self, name, **fields):
+        pass
+
+    def digest(self, name, **fields):
         pass
 
     def flush(self):
@@ -496,6 +520,9 @@ class MetricsRecorder:
 
     def alert(self, name, **fields):
         self._emit({"kind": "alert", "name": name, **fields})
+
+    def digest(self, name, **fields):
+        self._emit({"kind": "digest", "name": name, **fields})
 
     # -- recorder-internal hooks --------------------------------------------
 

@@ -268,6 +268,7 @@ def build_report(records, source="", trace=None, slo_ms=None):
     tracing_info = _tracing_info(records, slo_ms)
     alerts = _alerts_info(records)
     rollups = _rollups_info(records)
+    divergence = _divergence_info(records)
 
     dispatch_overhead = None
     for r in records:
@@ -321,6 +322,7 @@ def build_report(records, source="", trace=None, slo_ms=None):
         "tracing": tracing_info,
         "alerts": alerts,
         "rollups": rollups,
+        "divergence": divergence,
         "dispatch_overhead": dispatch_overhead,
     }
 
@@ -1645,6 +1647,49 @@ def _alerts_lines(alerts, rollups, md):
     return lines
 
 
+def _divergence_info(records):
+    """Fold the schema-v12 ``digest`` stream (numerics provenance,
+    observability/divergence.py): how many per-step per-layer digest rows
+    this run recorded and over which step window — the evidence that a
+    first-divergence comparison against a twin run is possible. None when
+    the run recorded no digests (section omitted)."""
+    digs = [r for r in records if r.get("kind") == "digest"]
+    if not digs:
+        return None
+    steps = sorted(int(r.get("step", 0)) for r in digs)
+    flips = [
+        r for r in records
+        if r.get("kind") == "event" and r.get("name") == "digest_config"
+        and r.get("faults")
+    ]
+    return {
+        "records": len(digs),
+        "layers": max(int(r.get("layers", 0)) for r in digs),
+        "first_step": steps[0],
+        "last_step": steps[-1],
+        "faults": flips[0]["faults"] if flips else None,
+    }
+
+
+def _divergence_lines(info, md):
+    if not info:
+        return []
+    lines = ["## Divergence" if md else "divergence:"]
+    lines.append(
+        f"- digest rows: {info['records']} steps "
+        f"({info['first_step']}..{info['last_step']}) x "
+        f"{info['layers']} layers (per-layer crc + param/grad norms)"
+    )
+    if info.get("faults"):
+        lines.append(f"- fault plan recorded for replay: {info['faults']}")
+    lines.append(
+        "- compare twin runs: python -m "
+        "shallowspeed_tpu.observability.divergence A.jsonl B.jsonl"
+    )
+    lines.append("")
+    return lines
+
+
 def render(report, fmt, comparison=None):
     if fmt == "json":
         out = dict(report)
@@ -1678,6 +1723,7 @@ def render(report, fmt, comparison=None):
     lines.extend(
         _alerts_lines(report.get("alerts"), report.get("rollups"), md)
     )
+    lines.extend(_divergence_lines(report.get("divergence"), md))
     header = "## Span breakdown" if md else "span breakdown:"
     lines.append(header)
     if report["spans"]:

@@ -851,6 +851,7 @@ def make_pipeline_step(
     kernel_backend="xla",
     with_grad_norm=False,
     with_step_stats=False,
+    with_digests=False,
     grad_bucket_bytes=0,
 ):
     """Build the jitted SPMD step executing one TickProgram over the mesh.
@@ -898,6 +899,19 @@ def make_pipeline_step(
     stacked norm IS the logical norm, psum'd over ``pp``). Together with
     the per-step loss these are the scalars the numerics health monitor
     checks on host after each epoch's single readback.
+
+    ``with_digests`` (training only): the numerics-provenance aux — one
+    EXTRA trailing output, a dict of layout-independent ``(S, L)`` grids
+    (stacked-row x layer-slot): ``crc_w``/``crc_b`` are the per-block
+    uint32 wrap-around checksums of the POST-update float32 param bits
+    (bitcast, so bit-identical runs match bit for bit; psum on uint32
+    wraps mod 2^32, and padding is exactly +0.0 = 0x00000000, so the
+    psum'd stacked checksum EQUALS the logical per-layer checksum —
+    ``utils.block_checksum``); ``pnorm_w``/``pnorm_b`` are post-update
+    per-block L2 norms and ``gnorm_w``/``gnorm_b`` the post-sync
+    PRE-clip per-block grad norms. Each device scatters its local rows
+    into the grid and one psum over the param-sharded axes replicates
+    the full matrix — pure data flow, no host callbacks.
 
     Inference:
         step(stacked, flags, x) -> preds (global_eval_batch, out_width) P('dp')
@@ -959,9 +973,10 @@ def make_pipeline_step(
     training = prog.is_training
     if training and opt is None:
         raise ValueError("training program needs an optimizer")
-    if (with_grad_norm or with_step_stats) and not training:
+    if (with_grad_norm or with_step_stats or with_digests) and not training:
         raise ValueError(
-            "with_grad_norm/with_step_stats apply to training programs only"
+            "with_grad_norm/with_step_stats/with_digests apply to training "
+            "programs only"
         )
     if with_step_stats:
         with_grad_norm = True  # step stats carry the grad norm per step
@@ -991,6 +1006,76 @@ def make_pipeline_step(
         if z1_stateful:
             _zero1_check_state(opt, z1_csz)
             z1_layout = opt.state_layout()
+
+    if with_digests:
+        # the digest-grid builders (see the docstring): per-slot columns of
+        # per-chunk reductions, scattered at this device's pp row block and
+        # psum'd over the axes the params are sharded across, so EVERY
+        # device returns the same (S, L) matrix. uint32 checksums wrap mod
+        # 2^32 under psum — the same wrap the host reference
+        # (utils.block_checksum) computes, so stacked == logical exactly.
+        def _digest_scatter(col_fn, slot_vals, dtype, axes):
+            grid = jnp.zeros((S_, L), dtype)
+            r0 = lax.axis_index("pp") * V
+            for sl, a in enumerate(slot_vals):
+                col = col_fn(a.astype(jnp.float32))
+                grid = lax.dynamic_update_slice(
+                    grid, col.reshape(V, 1).astype(dtype), (r0, sl)
+                )
+            return lax.psum(grid, axes)
+
+        def _crc_col(a32):
+            return jnp.sum(
+                lax.bitcast_convert_type(a32, jnp.uint32).reshape(V, -1),
+                axis=1,
+                dtype=jnp.uint32,
+            )
+
+        def _sq_col(a32):
+            return jnp.sum((a32 * a32).reshape(V, -1), axis=1)
+
+        def _digest_grids(new_p, gsq_w, gsq_b):
+            """The step's digest dict from the post-update local params +
+            the pre-computed post-sync grad squared-sum grids."""
+            return {
+                "crc_w": _digest_scatter(
+                    _crc_col, new_p["W"], jnp.uint32, pp_axes
+                ),
+                "crc_b": _digest_scatter(
+                    _crc_col, new_p["b"], jnp.uint32, pp_axes
+                ),
+                "pnorm_w": jnp.sqrt(
+                    _digest_scatter(_sq_col, new_p["W"], jnp.float32, pp_axes)
+                ),
+                "pnorm_b": jnp.sqrt(
+                    _digest_scatter(_sq_col, new_p["b"], jnp.float32, pp_axes)
+                ),
+                "gnorm_w": jnp.sqrt(gsq_w),
+                "gnorm_b": jnp.sqrt(gsq_b),
+            }
+
+        if zero1:
+            # under ZeRO-1 the post-sync gradient lives as this replica's
+            # flat (csz,) chunk, so the per-(chunk, slot) squared sums come
+            # from a STATIC segment-id map over the padded flat layout
+            # (W slots then b slots, chunk-major inside each slot; padding
+            # lands in a trash segment) — sliced at this replica's offset
+            # and segment-summed, then scattered + psum'd like the rest
+            _seg_np = np.concatenate(
+                [
+                    np.repeat(np.arange(sl * V, (sl + 1) * V), o * i)
+                    for sl, (o, i) in enumerate(w_dims)
+                ]
+                + [
+                    np.repeat(np.arange((L + sl) * V, (L + sl + 1) * V), w)
+                    for sl, w in enumerate(b_widths)
+                ]
+            )
+            _pad_n = z1_csz * mesh.shape["dp"] - z1_flat
+            z1_seg_ids = jnp.asarray(
+                np.concatenate([_seg_np, np.full(_pad_n, 2 * L * V)]),
+                jnp.int32,
+            )
 
     # tick tables as device constants, scanned over their leading (T) axis
     tab_dict = dict(
@@ -1286,6 +1371,34 @@ def make_pipeline_step(
                 # sharded axis, so the pre-clip global norm is one
                 # cross-axis reduction
                 gnorm = jnp.sqrt(lax.psum(jnp.sum(gsh * gsh), z1_axes))
+            if with_digests:
+                # per-(chunk, slot) grad squared sums from this replica's
+                # flat chunk: static segment ids sliced at the chunk
+                # offset, one psum over EVERY sharded axis (dp chunks +
+                # pp rows + tp shards are all disjoint)
+                ids = lax.dynamic_slice(
+                    z1_seg_ids, (lax.axis_index("dp") * csz,), (csz,)
+                )
+                seg = jax.ops.segment_sum(
+                    gsh * gsh, ids, num_segments=2 * L * V + 1
+                )[: 2 * L * V]
+                r0 = lax.axis_index("pp") * V
+                dgsq_w = lax.psum(
+                    lax.dynamic_update_slice(
+                        jnp.zeros((S_, L), jnp.float32),
+                        seg[: L * V].reshape(L, V).T,
+                        (r0, 0),
+                    ),
+                    z1_axes,
+                )
+                dgsq_b = lax.psum(
+                    lax.dynamic_update_slice(
+                        jnp.zeros((S_, L), jnp.float32),
+                        seg[L * V :].reshape(L, V).T,
+                        (r0, 0),
+                    ),
+                    z1_axes,
+                )
             if clip_norm is not None:
                 from shallowspeed_tpu.optimizer import clip_tree
 
@@ -1337,6 +1450,8 @@ def make_pipeline_step(
                 # post-update param norm: padded entries are exactly zero,
                 # so the pp-psum'd stacked norm IS the logical norm
                 outs += (gnorm_of(new_stacked, lambda sq: lax.psum(sq, pp_axes)),)
+            if with_digests:
+                outs += (_digest_grids(new_stacked, dgsq_w, dgsq_b),)
             return outs
 
         # the BackwardGradAllReduce anchor, in one of two bitwise-identical
@@ -1361,6 +1476,11 @@ def make_pipeline_step(
             # each pp device holds its stages' full (dp-summed) gradient;
             # padded entries are exactly zero so this IS the logical norm
             gnorm = global_norm(grads, lambda sq: lax.psum(sq, pp_axes))
+        if with_digests:
+            # post-sync PRE-clip per-block grad squared sums (the clip
+            # below reassigns ``grads``)
+            dgsq_w = _digest_scatter(_sq_col, grads["W"], jnp.float32, pp_axes)
+            dgsq_b = _digest_scatter(_sq_col, grads["b"], jnp.float32, pp_axes)
         if clip_norm is not None:
             from shallowspeed_tpu.optimizer import clip_tree
 
@@ -1376,6 +1496,8 @@ def make_pipeline_step(
             from shallowspeed_tpu.optimizer import global_norm as gnorm_of
 
             outs += (gnorm_of(new_local, lambda sq: lax.psum(sq, pp_axes)),)
+        if with_digests:
+            outs += (_digest_grids(new_local, dgsq_w, dgsq_b),)
         return outs
 
     pp = P("pp")
@@ -1444,6 +1566,17 @@ def make_pipeline_step(
             out_specs = out_specs + (P(),)  # replicated pre-clip grad norm
         if with_step_stats:
             out_specs = out_specs + (P(),)  # replicated post-update param norm
+        if with_digests:
+            # the psum'd digest grids are replicated (S, L) matrices
+            out_specs = out_specs + (
+                {
+                    k: P()
+                    for k in (
+                        "crc_w", "crc_b", "pnorm_w", "pnorm_b",
+                        "gnorm_w", "gnorm_b",
+                    )
+                },
+            )
         smapped = shard_map(
             per_device,
             mesh=mesh,
@@ -1487,6 +1620,7 @@ def make_pipeline_epoch(
     kernel_backend="xla",
     with_grad_norm=False,
     with_step_stats=False,
+    with_digests=False,
     grad_bucket_bytes=0,
 ):
     """Scan the pipeline train step over all batches of an epoch: one XLA
@@ -1502,22 +1636,31 @@ def make_pipeline_epoch(
     ``with_step_stats`` adds per-step ``step_loss``/``step_grad_norm``/
     ``step_param_norm`` vectors to that aux (both mirror
     trainer.make_train_epoch's aux, so TrainingSession records the same
-    scalars on every layout); ``grad_bucket_bytes`` selects the gradient-
+    scalars on every layout); ``with_digests`` adds the per-step stacked
+    digest grids under the aux's ``"digests"`` key (each leaf
+    ``(num_batches, S, L)`` — see make_pipeline_step's digest contract);
+    ``grad_bucket_bytes`` selects the gradient-
     sync mode (0 = anchor collective, >0 = byte-bucketed — see
     make_pipeline_step)."""
     step = make_pipeline_step(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
         tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
         kernel_backend=kernel_backend, with_grad_norm=with_grad_norm,
-        with_step_stats=with_step_stats, grad_bucket_bytes=grad_bucket_bytes,
+        with_step_stats=with_step_stats, with_digests=with_digests,
+        grad_bucket_bytes=grad_bucket_bytes,
     )
     return jax.jit(
-        _make_pipeline_epoch_core(step, unroll, with_grad_norm, with_step_stats),
+        _make_pipeline_epoch_core(
+            step, unroll, with_grad_norm, with_step_stats, with_digests
+        ),
         donate_argnums=(0, 2),
     )
 
 
-def _make_pipeline_epoch_core(step, unroll, with_grad_norm=False, with_step_stats=False):
+def _make_pipeline_epoch_core(
+    step, unroll, with_grad_norm=False, with_step_stats=False,
+    with_digests=False,
+):
     """The one batch-scan epoch body shared by make_pipeline_epoch and
     make_pipeline_run: ``core(stacked, flags, opt_state, X, Y) ->
     (stacked, opt_state, mean_loss)`` — plus an aux dict when instrumented
@@ -1535,9 +1678,12 @@ def _make_pipeline_epoch_core(step, unroll, with_grad_norm=False, with_step_stat
             stacked, opt_state, loss = out[0], out[1], out[2]
             gn = out[3] if track_gn else jnp.zeros(())
             carry = (stacked, opt_state, loss_sum + loss, gn_sum + gn)
+            ys = ()
             if with_step_stats:
-                return carry, (loss, gn, out[4])
-            return carry, None
+                ys += (loss, gn, out[4])
+            if with_digests:
+                ys += (out[-1],)  # the digest dict rides last (see step)
+            return carry, (ys if ys else None)
 
         (stacked, opt_state, loss_sum, gn_sum), ys = lax.scan(
             body,
@@ -1546,13 +1692,17 @@ def _make_pipeline_epoch_core(step, unroll, with_grad_norm=False, with_step_stat
             unroll=unroll,
         )
         nb = X.shape[0]
-        if not (with_grad_norm or with_step_stats):
+        if not (with_grad_norm or with_step_stats or with_digests):
             return stacked, opt_state, loss_sum / nb
         aux = {}
         if with_grad_norm:
             aux["grad_norm"] = gn_sum / nb
         if with_step_stats:
-            aux["step_loss"], aux["step_grad_norm"], aux["step_param_norm"] = ys
+            aux["step_loss"], aux["step_grad_norm"], aux["step_param_norm"] = (
+                ys[0], ys[1], ys[2]
+            )
+        if with_digests:
+            aux["digests"] = ys[-1]
         return stacked, opt_state, loss_sum / nb, aux
 
     return epoch_core

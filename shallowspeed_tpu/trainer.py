@@ -31,9 +31,41 @@ from shallowspeed_tpu import ops
 from shallowspeed_tpu.model import ModelSpec, model_backward, model_forward
 
 
+def _digest_aux(params, grads):
+    """The sequential per-layer digest vectors (numerics provenance): for
+    every logical (W, b) block, the uint32 wrap-around checksum of the
+    POST-update float32 bits (bitcast, never a float sum — bit-identical
+    runs produce bit-identical checksums), the post-update param L2 norm,
+    and the post-sync PRE-clip grad L2 norm. Same block definition and
+    order as ``utils.iter_param_blocks`` (global layer order), so the
+    stream joins against the host digests and ``model_hash``'s blocks.
+    Ordinary data flow inside the fused step — no host callbacks."""
+    cw, cb, pw, pb, gw, gb = [], [], [], [], [], []
+    for stage_p, stage_g in zip(params, grads):
+        for lay_p, lay_g in zip(stage_p, stage_g):
+            for key, crcs, pns, gns in (
+                ("W", cw, pw, gw), ("b", cb, pb, gb),
+            ):
+                p32 = lay_p[key].astype(jnp.float32)
+                crcs.append(
+                    jnp.sum(
+                        lax.bitcast_convert_type(p32, jnp.uint32),
+                        dtype=jnp.uint32,
+                    )
+                )
+                pns.append(jnp.sqrt(jnp.sum(p32 * p32)))
+                g32 = lay_g[key].astype(jnp.float32)
+                gns.append(jnp.sqrt(jnp.sum(g32 * g32)))
+    return {
+        "crc_w": jnp.stack(cw), "crc_b": jnp.stack(cb),
+        "pnorm_w": jnp.stack(pw), "pnorm_b": jnp.stack(pb),
+        "gnorm_w": jnp.stack(gw), "gnorm_b": jnp.stack(gb),
+    }
+
+
 def _make_batch_step(
     spec: ModelSpec, opt, precision, fuse_mubatches=False, clip_norm=None,
-    megakernel=False, with_grad_norm=False,
+    megakernel=False, with_grad_norm=False, with_digests=False,
 ):
     """The shared per-batch body: microbatch gradient accumulation + optimizer
     apply. Used by both the per-batch step and the epoch scan.
@@ -63,10 +95,10 @@ def _make_batch_step(
     roofline) and one op per batch is the shortest possible serial chain.
     """
     if megakernel:
-        if with_grad_norm:
+        if with_grad_norm or with_digests:
             raise ValueError(
-                "with_grad_norm is unavailable on the kernel paths: the "
-                "gradient never leaves the Pallas kernel's VMEM"
+                "with_grad_norm/with_digests are unavailable on the kernel "
+                "paths: the gradient never leaves the Pallas kernel's VMEM"
             )
         sspec = _validate_megakernel(spec, opt, fuse_mubatches)
 
@@ -89,15 +121,25 @@ def _make_batch_step(
         return clip_tree(grads, clip_norm)
 
     def finish(params, opt_state, grads, loss):
-        """Shared tail: (optional) pre-clip norm aux, clip, apply."""
+        """Shared tail: (optional) pre-clip norm aux, clip, apply. With
+        ``with_digests`` the per-layer digest dict of the NEW params (and
+        the pre-clip grads) rides as the LAST output."""
         if with_grad_norm:
             from shallowspeed_tpu.optimizer import global_norm
 
             gnorm = global_norm(grads)
-            params, opt_state = opt.apply(params, clipped(grads), opt_state)
-            return params, opt_state, loss, gnorm
-        params, opt_state = opt.apply(params, clipped(grads), opt_state)
-        return params, opt_state, loss
+            new_params, opt_state = opt.apply(
+                params, clipped(grads), opt_state
+            )
+            outs = (new_params, opt_state, loss, gnorm)
+        else:
+            new_params, opt_state = opt.apply(
+                params, clipped(grads), opt_state
+            )
+            outs = (new_params, opt_state, loss)
+        if with_digests:
+            outs += (_digest_aux(new_params, grads),)
+        return outs
 
     def batch_step(params, opt_state, xb, yb):
         """Returns (params, opt_state, batch_loss) — the loss is the global-
@@ -289,6 +331,7 @@ def make_train_epoch(
     epoch_kernel=False,
     with_grad_norm=False,
     with_step_stats=False,
+    with_digests=False,
 ):
     """Whole-epoch scan: ``epoch(params, opt_state, X, Y) -> (params,
     opt_state, mean_loss)`` with X: (num_batches, M, mubatch, in_dim). One
@@ -313,15 +356,20 @@ def make_train_epoch(
     ``step_grad_norm`` (pre-clip) / ``step_param_norm`` (post-update), as
     ordinary stacked scan outputs of the same fused program. Same kernel-
     path restriction as ``with_grad_norm``.
+    ``with_digests``: the numerics-provenance aux — the aux dict also
+    carries per-step per-layer digest vectors under ``"digests"`` (each
+    leaf stacked to ``(num_batches, n_layers)``: bitcast-uint32 checksums
+    ``crc_w``/``crc_b`` of the post-update params plus param/pre-clip-grad
+    L2 norms — see ``_digest_aux``). Same kernel-path restriction.
     """
     if epoch_kernel:
         if megakernel:
             raise ValueError("megakernel and epoch_kernel are exclusive")
-        if with_grad_norm or with_step_stats:
+        if with_grad_norm or with_step_stats or with_digests:
             raise ValueError(
-                "with_grad_norm/with_step_stats are unavailable on the "
-                "kernel paths: the gradient never leaves the Pallas "
-                "kernel's VMEM"
+                "with_grad_norm/with_step_stats/with_digests are "
+                "unavailable on the kernel paths: the gradient never "
+                "leaves the Pallas kernel's VMEM"
             )
         epoch_core = _make_epoch_kernel_core(
             spec, opt, precision, fuse_mubatches, clip_norm
@@ -329,15 +377,18 @@ def make_train_epoch(
     else:
         batch_step = _make_batch_step(
             spec, opt, precision, fuse_mubatches, clip_norm, megakernel,
-            with_grad_norm or with_step_stats,
+            with_grad_norm or with_step_stats, with_digests,
         )
         epoch_core = _make_epoch_core(
-            batch_step, unroll, with_grad_norm, with_step_stats
+            batch_step, unroll, with_grad_norm, with_step_stats, with_digests
         )
     return jax.jit(epoch_core, donate_argnums=(0, 1))
 
 
-def _make_epoch_core(batch_step, unroll, with_grad_norm=False, with_step_stats=False):
+def _make_epoch_core(
+    batch_step, unroll, with_grad_norm=False, with_step_stats=False,
+    with_digests=False,
+):
     """The one epoch-scan body shared by make_train_epoch and make_train_run:
     ``core(params, opt_state, X, Y) -> (params, opt_state, mean_loss)`` —
     plus an aux dict when instrumented: ``{"grad_norm": mean}`` under
@@ -356,13 +407,16 @@ def _make_epoch_core(batch_step, unroll, with_grad_norm=False, with_step_stats=F
             params, opt_state, loss = out[0], out[1], out[2]
             gn = out[3] if track_gn else jnp.zeros(())
             carry = (params, opt_state, loss_sum + loss, gn_sum + gn)
+            ys = ()
             if with_step_stats:
                 from shallowspeed_tpu.optimizer import global_norm
 
                 # post-update param norm: the "did the step blow the
                 # weights up" scalar the health monitor watches
-                return carry, (loss, gn, global_norm(params))
-            return carry, None
+                ys += (loss, gn, global_norm(params))
+            if with_digests:
+                ys += (out[-1],)  # the digest dict rides last (see finish)
+            return carry, (ys if ys else None)
 
         (params, opt_state, loss_sum, gn_sum), ys = lax.scan(
             body,
@@ -371,13 +425,17 @@ def _make_epoch_core(batch_step, unroll, with_grad_norm=False, with_step_stats=F
             unroll=unroll,
         )
         nb = X.shape[0]
-        if not (with_grad_norm or with_step_stats):
+        if not (with_grad_norm or with_step_stats or with_digests):
             return params, opt_state, loss_sum / nb
         aux = {}
         if with_grad_norm:
             aux["grad_norm"] = gn_sum / nb
         if with_step_stats:
-            aux["step_loss"], aux["step_grad_norm"], aux["step_param_norm"] = ys
+            aux["step_loss"], aux["step_grad_norm"], aux["step_param_norm"] = (
+                ys[0], ys[1], ys[2]
+            )
+        if with_digests:
+            aux["digests"] = ys[-1]
         return params, opt_state, loss_sum / nb, aux
 
     return epoch_core

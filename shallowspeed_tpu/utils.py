@@ -14,20 +14,68 @@ import jax
 import numpy as np
 
 
-def model_hash(params_list) -> str:
-    """SHA1 over concatenated per-parameter SHA1s, in global layer order.
+def iter_param_blocks(params_list):
+    """Yield ``(global_layer, key, float32_array)`` for every logical (W, b)
+    block of a logical params tree, in global layer order.
+
+    This is the ONE digest-block definition shared by ``model_hash``, the
+    per-layer checksum stream (``layer_digests`` and the in-program scan
+    aux that mirrors it) and the divergence comparator: the exact float32
+    bytes each of them hashes/sums come from here, so the hash and the
+    digest stream can never disagree about what a "block" is.
 
     ``params_list``: list (per stage) of lists of {"W","b"} arrays (jax or
-    numpy). Mirrors reference utils.py:13-24 (sha1 of each param's bytes,
-    concatenated, re-hashed).
+    numpy).
     """
-    acc = ""
+    gl = 0
     for stage in params_list:
         for layer in stage:
             for key in ("W", "b"):
-                arr = np.ascontiguousarray(jax.device_get(layer[key]), np.float32)
-                acc += sha1(arr.tobytes()).hexdigest()
+                yield gl, key, np.ascontiguousarray(
+                    jax.device_get(layer[key]), np.float32
+                )
+            gl += 1
+
+
+def model_hash(params_list) -> str:
+    """SHA1 over concatenated per-parameter SHA1s, in global layer order.
+
+    Mirrors reference utils.py:13-24 (sha1 of each param's bytes,
+    concatenated, re-hashed); the bytes hashed are exactly the
+    ``iter_param_blocks`` blocks, so the hash and the divergence digest
+    stream share one block definition (the hash value itself is pinned by
+    tests/test_divergence.py).
+    """
+    acc = ""
+    for _gl, _key, arr in iter_param_blocks(params_list):
+        acc += sha1(arr.tobytes()).hexdigest()
     return sha1(acc.encode("utf-8")).hexdigest()
+
+
+def block_checksum(arr) -> int:
+    """The host-side digest checksum of one logical block: the uint32
+    wrap-around sum of the block's float32 bytes reinterpreted as uint32
+    words — exactly what the fused scan aux computes in-program with
+    ``jnp.sum(lax.bitcast_convert_type(x, jnp.uint32), dtype=jnp.uint32)``,
+    so mesh-psum'd digests can be asserted equal to this logical value.
+    """
+    a = np.ascontiguousarray(np.asarray(jax.device_get(arr), np.float32))
+    return int(a.view(np.uint32).sum(dtype=np.uint64) % (1 << 32))
+
+
+def layer_digests(params_list):
+    """Per-global-layer host digests of a logical params tree: a list of
+    ``{"layer", "crc_w", "crc_b", "pnorm_w", "pnorm_b"}`` dicts over the
+    ``iter_param_blocks`` blocks — the reference implementation the
+    in-program digest stream is tested against (tests/test_divergence.py).
+    """
+    out = {}
+    for gl, key, arr in iter_param_blocks(params_list):
+        d = out.setdefault(gl, {"layer": gl})
+        suffix = "w" if key == "W" else "b"
+        d[f"crc_{suffix}"] = block_checksum(arr)
+        d[f"pnorm_{suffix}"] = float(np.sqrt(np.sum(arr.astype(np.float64) ** 2)))
+    return [out[gl] for gl in sorted(out)]
 
 
 def assert_dp_replicas_in_sync(arr) -> None:

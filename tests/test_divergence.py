@@ -1,0 +1,381 @@
+"""Numerics provenance tests: the per-layer digest stream, first-divergence
+attribution, and the checkpoint-bisect replay (observability/divergence.py,
+docs/numerics.md "Divergence debugging").
+
+The load-bearing invariants:
+
+- the in-program digest aux (fused scan aux, psum'd over the mesh) equals
+  ``utils.layer_digests`` — the host reference over the logical blocks —
+  exactly (crc) / to float tolerance (norms) on EVERY layout, so a digest
+  row is layout-independent evidence;
+- the digest block definition is THE ``model_hash`` block definition
+  (satellite: one shared ``iter_param_blocks``), pinned by literal hash;
+- ``digests=False`` changes nothing: the uninstrumented program trains to
+  the instrumented twin's exact bits;
+- a ``flip@step=N`` injection — finite, invisible to loss/health — is
+  named by the comparator at exactly (step N, layer 0, W).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu import utils
+from shallowspeed_tpu.api import TrainingSession
+from shallowspeed_tpu.observability import JsonlMetrics, MetricsRecorder, read_jsonl
+from shallowspeed_tpu.observability.divergence import (
+    assert_digest_streams_equal,
+    assert_models_equal,
+    digest_stream,
+    first_divergence,
+    main as divergence_main,
+    tensor_diff,
+)
+
+SIZES = (24, 20, 18, 16, 14, 12, 11, 10)
+N, GBS = 256, 64  # 4 batches/epoch
+
+
+@pytest.fixture()
+def data_dir(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("divergence_data")
+    rng = np.random.RandomState(0)
+    for suffix, n in (("train", N), ("val", 64)):
+        x = rng.randn(n, SIZES[0]).astype(np.float32)
+        y = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], n)]
+        np.save(tmp_path / f"x_{suffix}.npy", x)
+        np.save(tmp_path / f"y_{suffix}.npy", y)
+    return tmp_path
+
+
+class _Rec(MetricsRecorder):
+    """In-memory record capture (the JSONL sink without the file)."""
+
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def _emit(self, rec):
+        self.records.append(rec)
+
+
+def _session(data_dir, **kw):
+    kw.setdefault("sizes", SIZES)
+    kw.setdefault("global_batch_size", GBS)
+    kw.setdefault("lr", 0.01)
+    return TrainingSession(data_dir=data_dir, **kw)
+
+
+def _digests(metrics):
+    return [r for r in metrics.records if r["kind"] == "digest"]
+
+
+# ---------------------------------------------------------------------------
+# the shared block definition (satellite: ONE digest definition)
+# ---------------------------------------------------------------------------
+
+
+def test_model_hash_value_is_pinned():
+    """The iter_param_blocks refactor must not move the hash: the SHA1 of
+    a fixed two-stage params tree is a literal constant (float32 bytes in
+    global layer order, W before b — the reference's definition)."""
+    params = [
+        [{"W": np.arange(6, dtype=np.float32).reshape(2, 3),
+          "b": np.zeros(2, np.float32)}],
+        [{"W": np.ones((1, 2), np.float32), "b": np.array([3.0], np.float32)}],
+    ]
+    assert utils.model_hash(params) == "29a2cfd20d8a5732b5b216051efe74c6e4a160b6"
+    blocks = list(utils.iter_param_blocks(params))
+    assert [(gl, k) for gl, k, _ in blocks] == [
+        (0, "W"), (0, "b"), (1, "W"), (1, "b")
+    ]
+    # the checksum is the exact uint32 wrap-sum of the bit patterns:
+    # 1.0=0x3f800000, -0.0=0x80000000, 2.5=0x40200000
+    assert utils.block_checksum(
+        np.array([1.0, -0.0, 2.5], np.float32)
+    ) == 0xFFA00000
+    assert utils.block_checksum(params[0][0]["W"]) == 0x40E00000
+    digs = utils.layer_digests(params)
+    assert [d["layer"] for d in digs] == [0, 1]
+    assert digs[1]["crc_b"] == 0x40400000  # 3.0 = 0x40400000
+    assert digs[0]["pnorm_b"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# in-program digests == host reference, per layout
+# ---------------------------------------------------------------------------
+
+
+# the exotic layouts ride the slow tier (tier-1 keeps seq + the stacked
+# dp2pp2 mesh; make diverge-smoke exercises dp2 + gpipe-pp4 end to end)
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(),
+        dict(dp=2, pp=2, schedule="gpipe"),
+        pytest.param(
+            dict(pp=2, tp=2, schedule="gpipe"), marks=pytest.mark.slow
+        ),
+        pytest.param(
+            dict(dp=2, pp=2, schedule="gpipe", zero1=True,
+                 optimizer="momentum"),
+            marks=pytest.mark.slow,
+        ),
+        pytest.param(
+            dict(pp=2, virtual_stages=2, schedule="interleaved"),
+            marks=pytest.mark.slow,
+        ),
+    ],
+    ids=["seq", "dp2pp2", "pp2tp2", "zero1", "interleaved"],
+)
+def test_digest_stream_matches_host_reference(data_dir, kw):
+    """The fused aux's psum'd uint32 checksums equal the logical blocks'
+    host checksums BITWISE on every layout (stacking, padding, tp shards
+    and zero1 flat chunks all cancel out), and the in-program float32
+    norms match the float64 host norms to float tolerance."""
+    m = _Rec()
+    run = _session(data_dir, digests=True, metrics=m, **kw)
+    run.train_epoch()
+    digs = _digests(m)
+    assert [d["step"] for d in digs] == [0, 1, 2, 3]
+    host = utils.layer_digests(run.params())
+    last = digs[-1]
+    assert last["layers"] == len(host) == 7
+    for gl, h in enumerate(host):
+        assert last["crc_w"][gl] == h["crc_w"], (kw, gl)
+        assert last["crc_b"][gl] == h["crc_b"], (kw, gl)
+        assert last["pnorm_w"][gl] == pytest.approx(h["pnorm_w"], rel=1e-4)
+        assert last["pnorm_b"][gl] == pytest.approx(h["pnorm_b"], rel=1e-4)
+        assert np.isfinite(last["gnorm_w"][gl]) and last["gnorm_w"][gl] >= 0
+
+
+def test_digests_off_is_bitwise_identical_and_chunk_invariant(data_dir):
+    """digests=True must observe, never perturb: the instrumented session
+    trains to the uninstrumented twin's exact bits — and chunked
+    train_steps dispatch emits the same digest rows as whole-epoch
+    dispatch (the stream numbering is global-step, not dispatch)."""
+    m = _Rec()
+    on = _session(data_dir, digests=True, metrics=m, dp=2, schedule="gpipe")
+    on.train_epoch()
+    off = _session(data_dir, dp=2, schedule="gpipe")
+    off.train_epoch()
+    assert_models_equal(on.params(), off.params(), "digests-on", "digests-off")
+
+    # chunk invariance is host-side numbering (api._record_digests stamps
+    # global steps, not dispatch indices) — the cheap sequential program
+    # exercises it identically
+    m2 = _Rec()
+    whole = _session(data_dir, digests=True, metrics=m2)
+    whole.train_epoch()
+    m3 = _Rec()
+    chunked = _session(data_dir, digests=True, metrics=m3)
+    while chunked.epoch < 1:
+        chunked.train_steps(3)
+    assert_digest_streams_equal(
+        _digests(m2), _digests(m3), "whole-epoch", "chunked"
+    )
+
+
+# ---------------------------------------------------------------------------
+# first-divergence attribution (pure stream logic)
+# ---------------------------------------------------------------------------
+
+
+def _row(step, **over):
+    base = dict(
+        kind="digest", name="train", step=step, epoch=0, layers=2,
+        crc_w=[10, 20], crc_b=[30, 40],
+        pnorm_w=[1.0, 2.0], pnorm_b=[0.5, 0.25],
+        gnorm_w=[0.1, 0.2], gnorm_b=[0.01, 0.02],
+    )
+    base.update(over)
+    return base
+
+
+def test_first_divergence_attribution_and_classes():
+    a = [_row(0), _row(1), _row(2)]
+    assert first_divergence(a, [_row(0), _row(1), _row(2)]) is None
+
+    # crc flip on layer 1's b at step 2, norms bit-identical -> ulp-level
+    b = [_row(0), _row(1), _row(2, crc_b=[30, 41])]
+    d = first_divergence(a, b)
+    assert (d["step"], d["layer"], d["tensor"]) == (2, 1, "b")
+    assert d["classification"] == "ulp-level"
+    assert d["last_agreeing_step"] == 1
+
+    # a real drift: crc and norms both move -> classified by norm delta
+    c = [_row(0), _row(1, crc_w=[11, 20], pnorm_w=[1.0000001, 2.0]), _row(2)]
+    d = first_divergence(a, c)
+    assert (d["step"], d["layer"], d["tensor"]) == (1, 0, "W")
+    assert d["classification"] == "float-tolerance"
+    g = first_divergence(a, [_row(0), _row(1, crc_w=[11, 20], pnorm_w=[9.0, 2.0])])
+    assert g["classification"] == "gross"
+
+    # W reported before b, lower layer before higher, lower step first
+    both = [_row(0), _row(1, crc_w=[10, 21], crc_b=[31, 40])]
+    d = first_divergence(a, both)
+    assert (d["step"], d["layer"], d["tensor"]) == (1, 0, "b")
+
+    # structurally-missing: a step one stream never recorded
+    d = first_divergence(a, [_row(0), _row(2)])
+    assert d["step"] == 1 and d["classification"] == "structurally-missing"
+    # ... and a layer-count mismatch
+    d = first_divergence(a, [_row(0, layers=1)])
+    assert d["step"] == 0 and d["classification"] == "structurally-missing"
+
+    with pytest.raises(AssertionError, match="step 2 layer 1 tensor b"):
+        assert_digest_streams_equal(a, b)
+
+
+def test_tensor_diff_ulp_forensics():
+    a = np.array([1.0, -1.0, 0.0, 2.0], np.float32)
+    assert tensor_diff(a, a)["n_diff"] == 0
+    # one-ulp neighbors in both directions, and the signed-zero identity
+    b = np.array([np.nextafter(np.float32(1.0), np.float32(2.0)),
+                  np.nextafter(np.float32(-1.0), np.float32(-2.0)),
+                  -0.0, 2.0], np.float32)
+    d = tensor_diff(a, b)
+    assert d["max_ulp"] == 1 and d["first_index"] == 0
+    assert d["n_diff"] == 3  # -0.0 differs BITWISE even at 0 ulp distance
+    # ulp distance crosses zero correctly: smallest subnormals are 2 apart
+    tiny = np.float32(1e-45)
+    d = tensor_diff(np.array([tiny]), np.array([-tiny]))
+    assert d["max_ulp"] == 2
+    with pytest.raises(ValueError, match="shape mismatch"):
+        tensor_diff(a, a[:2])
+
+
+def test_assert_models_equal_names_the_block():
+    pa = [[{"W": np.ones((2, 2), np.float32), "b": np.zeros(2, np.float32)}],
+          [{"W": np.ones((1, 2), np.float32), "b": np.zeros(1, np.float32)}]]
+    pb = [[{"W": np.ones((2, 2), np.float32), "b": np.zeros(2, np.float32)}],
+          [{"W": np.ones((1, 2), np.float32), "b": np.zeros(1, np.float32)}]]
+    assert_models_equal(pa, pb)
+    pb[1][0]["W"][0, 1] = np.nextafter(np.float32(1.0), np.float32(2.0))
+    with pytest.raises(AssertionError) as e:
+        assert_models_equal(pa, pb, "anchor", "candidate")
+    msg = str(e.value)
+    assert "layer 1 W" in msg and "max ulp 1" in msg and "flat index 1" in msg
+
+
+# ---------------------------------------------------------------------------
+# the flip injection + the CLI
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # make diverge-smoke runs this end to end via train.py
+def test_flip_fault_is_named_by_the_stream_and_cli(
+    data_dir, tmp_path, capsys
+):
+    """A single-bit flip injected at step 2 stays finite (loss/health see
+    nothing) but the comparator names exactly (step 2, layer 0, W) — and
+    the CLI exits 0 on identical streams, 2 on the flipped one, 1 on
+    usage/read errors (never colliding 2 with argparse's usage exit).
+    (Independent twin runs comparing IDENTICAL is make diverge-smoke's
+    e2e leg; here the clean stream doubles as its own twin.)"""
+    paths = {}
+    for tag, faults in (("a", ""), ("f", "flip@step=2")):
+        p = tmp_path / f"{tag}.jsonl"
+        with JsonlMetrics(p) as m:
+            run = _session(
+                data_dir, digests=True, metrics=m, dp=2, schedule="gpipe",
+                faults=faults,
+            )
+            while run.epoch < 1:
+                run.train_steps(2)
+        paths[tag] = str(p)
+
+    assert divergence_main([paths["a"], paths["a"]]) == 0
+    assert "IDENTICAL" in capsys.readouterr().out
+
+    assert divergence_main([paths["a"], paths["f"]]) == 2
+    out = capsys.readouterr().out
+    assert "first divergence: step 2 layer 0 tensor W" in out
+    assert "ulp-level" in out and "last agreeing step: 1" in out
+    d = first_divergence(
+        read_jsonl(paths["a"]), read_jsonl(paths["f"])
+    )
+    assert (d["step"], d["layer"], d["tensor"]) == (2, 0, "W")
+
+    # the flipped run's own config record carries the plan for replay
+    cfgs = [
+        r for r in read_jsonl(paths["f"])
+        if r["kind"] == "event" and r["name"] == "digest_config"
+    ]
+    assert len(cfgs) == 1 and cfgs[0]["faults"] == "flip@step=2"
+
+    # exit 1: unreadable file / stream without digests
+    assert divergence_main([str(tmp_path / "nope.jsonl"), paths["a"]]) == 1
+    plain = tmp_path / "plain.jsonl"
+    plain.write_text(json.dumps({"v": 1, "kind": "event", "name": "x"}) + "\n")
+    assert divergence_main([str(plain), paths["a"]]) == 1
+
+
+@pytest.mark.slow
+def test_bisect_replay_reproduces_the_flip(data_dir, tmp_path):
+    """--bisect restores each run's last agreeing snapshot, replays ONE
+    step with the recorded fault plan re-armed, and the replayed diff
+    names the same (layer, tensor) as the stream — max ulp 1 at flat
+    index 0, the flip's exact anchor."""
+    from shallowspeed_tpu.observability.divergence import bisect_replay
+
+    recs = {}
+    for tag, faults in (("a", ""), ("f", "flip@step=5")):
+        with JsonlMetrics(tmp_path / f"{tag}.jsonl") as m:
+            run = _session(
+                data_dir, digests=True, metrics=m, dp=2, schedule="gpipe",
+                faults=faults, checkpoint_dir=tmp_path / f"ck_{tag}",
+                checkpoint_keep=16,
+            )
+            while run.epoch < 2:
+                run.train_steps(1)
+                run.save_step_checkpoint()
+        recs[tag] = read_jsonl(tmp_path / f"{tag}.jsonl")
+
+    div = first_divergence(recs["a"], recs["f"])
+    assert (div["step"], div["layer"], div["tensor"]) == (5, 0, "W")
+    lines = []
+    diffs = bisect_replay(
+        recs["a"], recs["f"], str(tmp_path / "ck_a"), str(tmp_path / "ck_f"),
+        div, out=lines.append,
+    )
+    assert [(d["layer"], d["tensor"]) for d in diffs][0] == (0, "W")
+    assert diffs[0]["max_ulp"] == 1 and diffs[0]["first_index"] == 0
+    text = "\n".join(lines)
+    assert "bitwise-equal (divergence is INSIDE step 5)" in text
+    assert "replay attribution MATCHES" in text
+
+
+# ---------------------------------------------------------------------------
+# refusals: paths that cannot thread the aux say so
+# ---------------------------------------------------------------------------
+
+
+def test_digest_refusals(data_dir):
+    run = _session(data_dir, digests=True)
+    with pytest.raises(ValueError, match="digests ride the epoch/step scan"):
+        run.train_run(1)
+    with pytest.raises(ValueError, match="digests"):
+        _session(data_dir, digests=True, dp=2, pp=2, schedule="gpipe",
+                 runtime="mpmd")
+    with pytest.raises(ValueError, match="kernel paths"):
+        _session(data_dir, digests=True, fuse_mubatches=True,
+                 epoch_kernel=True)
+
+
+@pytest.mark.slow  # make diverge-smoke greps the rendered section e2e
+def test_report_renders_divergence_section(data_dir, tmp_path):
+    from shallowspeed_tpu.observability.report import build_report, render
+
+    p = tmp_path / "m.jsonl"
+    with JsonlMetrics(p) as m:
+        run = _session(data_dir, digests=True, metrics=m)
+        run.train_epoch()
+    report = build_report(read_jsonl(p), str(p), None, None)
+    info = report["divergence"]
+    assert info["records"] == 4 and info["layers"] == 7
+    assert (info["first_step"], info["last_step"]) == (0, 3)
+    text = render(report, "md")
+    assert "## Divergence" in text
+    assert "digest rows: 4 steps (0..3) x 7 layers" in text

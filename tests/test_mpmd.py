@@ -19,6 +19,7 @@ from shallowspeed_tpu import model as Mo
 from shallowspeed_tpu import schedules as S
 from shallowspeed_tpu.optimizer import SGD, Adam, MomentumSGD
 from shallowspeed_tpu.parallel import executor as E
+from shallowspeed_tpu.observability.divergence import assert_models_equal
 from shallowspeed_tpu.parallel import mpmd
 from shallowspeed_tpu.parallel.lowering import lower_schedule
 from shallowspeed_tpu.parallel.mesh import make_mesh
@@ -220,7 +221,9 @@ def test_session_mpmd_hash_and_predict_parity(mpmd_data_dir):
     for _ in range(2):
         a.train_epoch()
         b.train_epoch()
-    assert a.model_hash() == b.model_hash()
+    # digest-backed comparator: failure names the first divergent
+    # (layer, tensor) instead of a bare cross-runtime hash mismatch
+    assert_models_equal(a.params(), b.params(), "lockstep", "mpmd")
     x = np.random.RandomState(1).rand(50, 784).astype(np.float32)
     np.testing.assert_array_equal(a.predict(x), b.predict(x))
     # streaming submit returns the same rows as the blocking path
@@ -258,7 +261,10 @@ def test_kill_and_resume_is_runtime_independent(mpmd_data_dir, tmp_path):
         assert res.resumed_from is not None and res.global_step == 3
         while res.epoch < 2:
             res.train_steps(2)
-        assert res.model_hash() == twin.model_hash(), (killed_rt, resumed_rt)
+        assert_models_equal(
+            res.params(), twin.params(),
+            f"killed-{killed_rt}-resumed-{resumed_rt}", "twin",
+        )
 
 
 def test_async_checkpoint_defers_unstacking_bitwise(mpmd_data_dir, tmp_path):

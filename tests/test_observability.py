@@ -113,6 +113,7 @@ def test_null_metrics_hot_path_zero_net_allocation():
             m.trace("t")  # ... and the v10 tracing hook
             m.rollup("w")  # ... and the v11 live-telemetry hooks
             m.alert("a")
+            m.digest("d")  # ... and the v12 numerics-provenance hook
 
     burst(100)  # warm up caches (method cache, code objects)
     # background threads (XLA's pools) can allocate a handful of blocks at
@@ -951,18 +952,14 @@ def test_schema_v10_trace(tmp_path):
 def test_schema_v11_rollup_alert(tmp_path):
     """Schema v11 (additive): the ``rollup`` (closed tumbling-window
     summary) and ``alert`` (firing/resolved transition) kinds round trip
-    with the version stamp, the v11 reader accepts v1-v10 files
-    unchanged, a v12 file is refused, and NullMetrics no-ops both new
-    hooks. Carries the version pin and the one-ahead refusal (the
-    newest-schema convention)."""
+    with the version stamp, the v11+ reader accepts v1-v10 files
+    unchanged, and NullMetrics no-ops both new hooks. (The version pin
+    and one-ahead refusal moved to the v12 test — the newest-schema
+    convention.)"""
     from shallowspeed_tpu.observability.metrics import SCHEMA_KINDS
 
-    assert SCHEMA_VERSION == 11
-    # the registry IS the docstring's kind list: every recorder hook has
-    # a registered kind, and the newest kinds carry the newest version
     assert SCHEMA_KINDS["rollup"] == 11
     assert SCHEMA_KINDS["alert"] == 11
-    assert max(SCHEMA_KINDS.values()) == SCHEMA_VERSION
     path = tmp_path / "v11.jsonl"
     with JsonlMetrics(path) as m:
         m.rollup(
@@ -994,13 +991,56 @@ def test_schema_v11_rollup_alert(tmp_path):
         p = tmp_path / f"rollup-old-v{v}.jsonl"
         p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
         assert read_jsonl(p)[0]["kind"] == rec["kind"]
-    # one-directional refusal: a v12 file fails loudly
-    v12 = tmp_path / "v12.jsonl"
-    v12.write_text(json.dumps({"v": 12, "kind": "event"}) + "\n")
-    with pytest.raises(ValueError, match="newer"):
-        read_jsonl(v12)
     NullMetrics().rollup("serving", counters={})
     NullMetrics().alert("breaker_open", state="firing")
+
+
+def test_schema_v12_digest(tmp_path):
+    """Schema v12 (additive): the ``digest`` kind — one numerics-provenance
+    row per optimizer step, with per-global-layer crc/norm lists — round
+    trips with the version stamp AND the non-finite sanitizer, the v12
+    reader accepts v1-v11 files unchanged, a v13 file is refused, and
+    NullMetrics no-ops the hook. Carries the version pin and the one-ahead
+    refusal (the newest-schema convention)."""
+    from shallowspeed_tpu.observability.metrics import SCHEMA_KINDS
+
+    assert SCHEMA_VERSION == 12
+    # the registry IS the docstring's kind list: every recorder hook has
+    # a registered kind, and the newest kinds carry the newest version
+    assert SCHEMA_KINDS["digest"] == 12
+    assert max(SCHEMA_KINDS.values()) == SCHEMA_VERSION
+    path = tmp_path / "v12.jsonl"
+    with JsonlMetrics(path) as m:
+        m.digest(
+            "train", step=7, epoch=1, layers=2,
+            crc_w=[0x89BB9AF3, 1], crc_b=[0, 0xFFFFFFFF],
+            pnorm_w=[3.25, 0.5], pnorm_b=[0.125, 0.0625],
+            # a blown-up run's norms must survive as STRICT JSON (the
+            # sanitizer contract every schema bump re-proves)
+            gnorm_w=[float("nan"), 1.0], gnorm_b=[0.5, float("inf")],
+        )
+    recs = read_jsonl(path)
+    assert [r["kind"] for r in recs] == ["meta", "digest"]
+    assert all(r["v"] == SCHEMA_VERSION for r in recs)
+    d = recs[1]
+    assert d["step"] == 7 and d["layers"] == 2
+    assert d["crc_w"] == [0x89BB9AF3, 1] and d["crc_b"][1] == 0xFFFFFFFF
+    assert d["gnorm_w"][0] == "NaN" and d["gnorm_b"][1] == "Infinity"
+    # v1-v11 files load unchanged under the v12 reader
+    for v, rec in (
+        (1, {"kind": "event", "name": "epoch", "epoch": 0, "loss": 0.5}),
+        (5, {"kind": "request", "name": "ok", "id": 1}),
+        (11, {"kind": "alert", "name": "breaker_open", "state": "firing"}),
+    ):
+        p = tmp_path / f"digest-old-v{v}.jsonl"
+        p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
+        assert read_jsonl(p)[0]["kind"] == rec["kind"]
+    # one-directional refusal: a v13 file fails loudly
+    v13 = tmp_path / "v13.jsonl"
+    v13.write_text(json.dumps({"v": 13, "kind": "event"}) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        read_jsonl(v13)
+    NullMetrics().digest("train", step=0, crc_w=[])
 
 
 def test_replica_shard_suffix_and_fallback_read(tmp_path):

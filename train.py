@@ -235,6 +235,17 @@ def main():
         "`python -m shallowspeed_tpu.observability.report FILE`",
     )
     ap.add_argument(
+        "--digests",
+        action="store_true",
+        help="numerics provenance: compute per-step per-LAYER digests "
+        "(uint32 bitcast checksums of every post-update (W, b) block + "
+        "param/grad block norms) inside the fused epoch program and "
+        "stream them as schema-v12 digest records to --metrics-out; "
+        "compare two runs' streams with `python -m "
+        "shallowspeed_tpu.observability.divergence A.jsonl B.jsonl` to "
+        "name the first divergent (step, layer, tensor)",
+    )
+    ap.add_argument(
         "--audit",
         action="store_true",
         help="XLA program audit: at jit time, census the compiled "
@@ -378,6 +389,12 @@ def main():
             "the fused ONE-dispatch run is a lockstep contract — drop "
             "--fused-run (the epoch loop dispatches MPMD)"
         )
+    if args.digests and args.fused_run:
+        ap.error(
+            "--digests rides the epoch/step scan aux, which the fused "
+            "multi-epoch run program does not thread — drop --fused-run "
+            "(the epoch/step loops stream digest records)"
+        )
     if args.runtime == "mpmd" and (args.dp, args.pp, args.tp) == (1, 1, 1):
         ap.error(
             "--runtime mpmd needs a mesh layout (dp/pp/tp > 1): the "
@@ -438,6 +455,7 @@ def main():
             async_checkpoint=args.async_checkpoint,
             aot_cache_dir=args.aot_cache,
             runtime=args.runtime,
+            digests=args.digests,
         )
     except CheckpointError as e:
         # unrecoverable checkpoint state: the named file (or every snapshot
