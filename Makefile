@@ -10,7 +10,7 @@ SHELL := /bin/bash
         audit-smoke overlap-smoke split-smoke tp-smoke recovery-smoke \
         diverge-smoke \
         aot-smoke serve-smoke chaos-smoke alerts-smoke fleet-smoke trace-smoke \
-        mpmd-smoke bench-mpmd \
+        mpmd-smoke bench-mpmd replay-smoke \
         bench-serving bench-ckpt-aot data train train-mesh bench \
         bench-scaling schedules clean
 
@@ -586,6 +586,45 @@ trace-smoke:
 	    --format md > /tmp/tsmoke/train.report.md
 	grep -q "dispatch overhead" /tmp/tsmoke/train.report.md
 	@echo "trace-smoke OK: 2-replica kill-injected soak left a complete clock-aligned span chain for every terminal request, Tracing attribution + waterfalls rendered, measured dispatch-overhead record written"
+
+# capacity scoreboard end-to-end (docs/serving.md "Autoscaling & the
+# capacity scoreboard", ROADMAP item 4): measure the single-replica
+# saturation knee with the SAME engine knobs the autoscaler is armed with
+# (--max-slots 4 --dispatch-floor-ms 40 — on this 1-core CPU host the
+# service-time floor is what makes fleet capacity scale with replica
+# count; on accelerators the model forward provides the floor natively),
+# then replay ONE seeded compressed-diurnal trace (flash-crowd spike
+# included) three ways — static fleet, autoscaled, autoscaled + SIGKILL
+# chaos — and score every leg against the offline oracle. bench_replay
+# itself exits 1 if any scoreboard verdict fails (autoscaled must beat
+# static on BOTH SLO-violation minutes and wasted replica-hours, chaos
+# must flap zero times); on top the target asserts the flash crowd
+# provoked a scale_out inside the spike window, the trough a scale_in,
+# the report CLI renders the Capacity section with the flap count, and
+# the watch CLI folds the fleet size + latest autoscale decision. Exit 0.
+replay-smoke:
+	rm -rf /tmp/rpsmoke; mkdir -p /tmp/rpsmoke
+	python -c "import numpy as np; from pathlib import Path; d=Path('/tmp/rpsmoke/data'); d.mkdir(parents=True); rng=np.random.RandomState(0); [(np.save(d/('x_'+s+'.npy'), rng.rand(n,784).astype(np.float32)), np.save(d/('y_'+s+'.npy'), np.eye(10,dtype=np.float32)[rng.randint(0,10,n)])) for s,n in (('train',2048),('val',256))]"
+	$(CPU_MESH) python -m shallowspeed_tpu.serving.bench_serving --dp 1 \
+	    --data-dir /tmp/rpsmoke/data --global-batch-size 32 \
+	    --rates 40,80,120,160,240 --requests 120 --seed 0 --slo-ms 250 \
+	    --max-slots 4 --dispatch-floor-ms 40 --out /tmp/rpsmoke/sweep.json
+	python -c "import json; rec=json.load(open('/tmp/rpsmoke/sweep.json')); assert rec['knee_rps'] is not None, 'sweep found no saturation knee'; print('sweep: knee at %s rps/replica' % rec['knee_rps'])"
+	$(CPU_MESH) python -m shallowspeed_tpu.serving.bench_replay \
+	    --data-dir /tmp/rpsmoke/data --global-batch-size 32 \
+	    --max-slots 4 --dispatch-floor-ms 40 --aot-cache /tmp/rpsmoke/aot \
+	    --knee-from /tmp/rpsmoke/sweep.json --day-s 40 \
+	    --out /tmp/rpsmoke/AUTOSCALE_r01.json \
+	    --metrics-out /tmp/rpsmoke/replay.jsonl
+	python -c "import json; rec=json.load(open('/tmp/rpsmoke/AUTOSCALE_r01.json')); assert rec['bench']=='autoscale_scoreboard'; assert all(rec['verdicts'].values()), 'verdicts failed: %s' % [k for k,ok in rec['verdicts'].items() if not ok]; spike=rec['config']['trace']['spikes'][0]; a=rec['legs']['autoscaled']['decisions']; outs=[d for d in a if d['decision']=='scale_out']; ins=[d for d in a if d['decision']=='scale_in']; assert outs and ins, 'autoscaled leg missing scale_out/scale_in'; hit=[d for d in outs if spike['start']-2.0 <= d['t'] <= spike['start']+spike['duration']+2.0]; assert hit, 'no scale_out inside the flash-crowd window %r (outs at %r)' % (spike, [d['t'] for d in outs]); assert rec['legs']['chaos']['flaps']==0, 'chaos leg flapped'; print('scoreboard: flash crowd at t=%.1fs answered by scale_out at t=%.1fs, %d scale_in(s) on slack, chaos flaps=0' % (spike['start'], hit[0]['t'], len(ins)))"
+	python -m shallowspeed_tpu.observability.report '/tmp/rpsmoke/replay.jsonl*' \
+	    --format md --slo-ms 250 > /tmp/rpsmoke/report.md
+	grep -q "## Capacity" /tmp/rpsmoke/report.md
+	grep -q "flap count: 0" /tmp/rpsmoke/report.md
+	python -m shallowspeed_tpu.observability.watch '/tmp/rpsmoke/replay.jsonl*' \
+	    --once > /tmp/rpsmoke/watch.out
+	grep -q "fleet: " /tmp/rpsmoke/watch.out
+	@echo "replay-smoke OK: one seeded diurnal trace, three legs — every verdict true (autoscaled beat the static fleet on violation minutes AND wasted replica-hours), spike-window scale_out + slack scale_in, zero chaos flaps, Capacity section + watch fleet line rendered"
 
 # MPMD runtime end-to-end (ROADMAP item 1, docs/performance.md "The MPMD
 # runtime"): gpipe-pp4 + pipedream-pp4 + interleaved-pp2xV2 epochs under

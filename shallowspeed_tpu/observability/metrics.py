@@ -104,6 +104,13 @@ Record shapes (all lines share ``v``/``ts``/``kind``/``name``):
      "step": <global step>, "epoch": ..., "layers": n,
      "crc_w": [uint32 ...], "crc_b": [...], "pnorm_w": [float ...],
      "pnorm_b": [...], "gnorm_w": [...], "gnorm_b": [...]}          [v12+]
+    {"v": 13, "ts": ..., "kind": "autoscale", "name": <decision:
+     "scale_out"|"scale_in"|"replace"|"backpressure_on"|
+     "backpressure_off">, "direction": "out"|"in"|"hold", "rule":
+     <triggering rule|poll>, "t": ..., "replicas_before": n,
+     "replicas_after": n, "reason": ..., "window_end": ...|null,
+     "queue_depth": n, "value": ...|null, "threshold": ...|null,
+     "flap": bool, **evidence}                                      [v13+]
 
 Schema compatibility rules (SCHEMA_VERSION history):
 
@@ -221,8 +228,9 @@ Schema compatibility rules (SCHEMA_VERSION history):
   ``firing``/``resolved``, severity, the observed value vs threshold,
   the fast/slow burn rates for burn-rate rules, and the human
   ``reason``) kinds — the sensor-and-alarm evidence stream behind
-  ``observability.watch``, the report CLI's Alerts section and
-  ROADMAP item 4's autoscaler. No existing kind or field changed
+  ``observability.watch``, the report CLI's Alerts section and the
+  autoscaler (serving/autoscaler.py, since v13). No existing kind or
+  field changed
   meaning; the v11 reader accepts v1–v10 files unchanged and the
   strict refusal stays one-directional (a v12 file is refused).
 
@@ -241,6 +249,24 @@ Schema compatibility rules (SCHEMA_VERSION history):
   No existing kind or field changed meaning; the v12 reader accepts
   v1–v11 files unchanged and the strict refusal stays one-directional
   (a v13 file is refused).
+
+- v13 ADDITIVE: the ``autoscale`` kind (one closed-loop capacity
+  decision, serving/autoscaler.py, docs/serving.md § Autoscaling:
+  named by the decision — ``scale_out``/``scale_in``/``replace``/
+  ``backpressure_on``/``backpressure_off`` — carrying ``direction``
+  (``out``/``in``/``hold``), the triggering ``rule`` (an alert rule
+  name, or ``poll`` for a between-edges status decision), the decision
+  time ``t``, the fleet size ``replicas_before``/``replicas_after``,
+  the evidence it acted on (``value``/``threshold`` from the alert or
+  rollup window, ``window_end`` of the rollup window consulted,
+  ``queue_depth`` at decision time), a human ``reason``, and ``flap``
+  — True when this decision reverses the previous direction inside
+  the policy's flap window, the scoreboard's zero-flap gate) — the
+  evidence stream behind the capacity scoreboard
+  (serving/bench_replay.py, AUTOSCALE_r01.json) and the report CLI's
+  Capacity section. No existing kind or field changed meaning; the
+  v13 reader accepts v1–v12 files unchanged and the strict refusal
+  stays one-directional (a v14 file is refused).
 
 The contract for future bumps: additive kinds/fields bump the version and
 must keep old records readable; any change to an EXISTING kind's meaning
@@ -273,7 +299,7 @@ import time
 
 from shallowspeed_tpu.observability.spans import Span
 
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 SCHEMA_NAME = "shallowspeed_tpu.metrics"
 
 # The schema table: every record kind this schema version can write,
@@ -311,6 +337,7 @@ SCHEMA_KINDS = {
     "rollup": 11,
     "alert": 11,
     "digest": 12,
+    "autoscale": 13,
 }
 
 
@@ -404,6 +431,9 @@ class NullMetrics:
         pass
 
     def digest(self, name, **fields):
+        pass
+
+    def autoscale(self, name, **fields):
         pass
 
     def flush(self):
@@ -523,6 +553,9 @@ class MetricsRecorder:
 
     def digest(self, name, **fields):
         self._emit({"kind": "digest", "name": name, **fields})
+
+    def autoscale(self, name, **fields):
+        self._emit({"kind": "autoscale", "name": name, **fields})
 
     # -- recorder-internal hooks --------------------------------------------
 

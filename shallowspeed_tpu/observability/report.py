@@ -269,6 +269,7 @@ def build_report(records, source="", trace=None, slo_ms=None):
     alerts = _alerts_info(records)
     rollups = _rollups_info(records)
     divergence = _divergence_info(records)
+    capacity = _capacity_info(records)
 
     dispatch_overhead = None
     for r in records:
@@ -323,6 +324,7 @@ def build_report(records, source="", trace=None, slo_ms=None):
         "alerts": alerts,
         "rollups": rollups,
         "divergence": divergence,
+        "capacity": capacity,
         "dispatch_overhead": dispatch_overhead,
     }
 
@@ -1690,6 +1692,140 @@ def _divergence_lines(info, md):
     return lines
 
 
+def _capacity_info(records):
+    """Fold the schema-v13 capacity evidence (serving/autoscaler.py +
+    bench_replay.py): every ``autoscale`` decision with its rule and
+    fleet sizes, the replayed trace's offered-load curve
+    (``replay_trace`` event), and the per-leg scoreboard rows
+    (``replay_score`` events). None when the stream has no capacity
+    records (section omitted)."""
+    decisions = [r for r in records if r.get("kind") == "autoscale"]
+    trace = None
+    for r in records:
+        if r.get("kind") == "event" and r.get("name") == "replay_trace":
+            trace = r  # last wins
+    scores = [
+        r
+        for r in records
+        if r.get("kind") == "event" and r.get("name") == "replay_score"
+    ]
+    if not decisions and trace is None and not scores:
+        return None
+    by_leg = {}
+    for d in decisions:
+        by_leg.setdefault(d.get("leg") or "-", []).append(
+            {
+                k: d.get(k)
+                for k in (
+                    "name", "direction", "rule", "t", "replicas_before",
+                    "replicas_after", "queue_depth", "value", "threshold",
+                    "flap", "window_end", "reason",
+                )
+            }
+        )
+    for decs in by_leg.values():
+        decs.sort(key=lambda d: (d.get("t") is None, d.get("t")))
+    return {
+        "decisions": len(decisions),
+        "flaps": sum(1 for d in decisions if d.get("flap")),
+        "by_leg": dict(sorted(by_leg.items())),
+        "trace": (
+            {
+                "day_s": trace.get("day_s"),
+                "knee_rps": trace.get("knee_rps"),
+                "n_arrivals": trace.get("n_arrivals"),
+                "compression": trace.get("compression"),
+                "buckets": trace.get("buckets") or [],
+                "spikes": trace.get("spikes") or [],
+            }
+            if trace is not None
+            else None
+        ),
+        "scores": [
+            {
+                k: s.get(k)
+                for k in (
+                    "leg", "violation_s", "violation_minutes_modeled",
+                    "wasted_replica_s", "wasted_replica_hours_modeled",
+                    "flaps",
+                )
+            }
+            for s in scores
+        ],
+    }
+
+
+def _capacity_lines(info, md):
+    if not info:
+        return []
+    lines = ["## Capacity" if md else "capacity:"]
+    trace = info.get("trace")
+    if trace and trace["buckets"]:
+        lines.append(
+            f"- replayed trace: {trace['n_arrivals']} arrivals over "
+            f"{_fmt_num(trace['day_s'], 's')} "
+            f"(1s here = {_fmt_num(trace['compression'])}s modeled), "
+            f"knee {_fmt_num(trace['knee_rps'], 'rps')}, "
+            f"{len(trace['spikes'])} flash-crowd spike(s)"
+        )
+        lines.append(
+            "- offered load: "
+            + sparkline([b.get("rate_rps") for b in trace["buckets"]])
+        )
+    for leg, decs in (info.get("by_leg") or {}).items():
+        # the scale timeline against the curve above: each decision at
+        # its trace time, with the rule that justified it
+        sizes = " ".join(
+            f"{_fmt_num(d['t'], 's')}:"
+            f"{d['replicas_before']}→{d['replicas_after']}"
+            for d in decs
+            if d["name"] in ("scale_out", "scale_in")
+        )
+        lines.append(
+            f"- {leg}: {len(decs)} decision(s)"
+            + (f" | timeline {sizes}" if sizes else "")
+        )
+        # every sizing decision renders in full; the admission gate's
+        # on/off toggles (direction hold, high-frequency while replicas
+        # warm) collapse past the first few to keep the section readable
+        bp_shown, bp_total = 0, sum(
+            1 for d in decs if d["name"].startswith("backpressure")
+        )
+        for d in decs:
+            is_bp = d["name"].startswith("backpressure")
+            if is_bp and not d.get("flap"):
+                bp_shown += 1
+                if bp_shown > 3:
+                    continue
+            flap = " FLAP" if d.get("flap") else ""
+            lines.append(
+                f"  - [{_fmt_num(d['t'], 's')}] {d['name']} "
+                f"(rule {d['rule']}, "
+                f"{d['replicas_before']}→{d['replicas_after']}, queue "
+                f"{d['queue_depth']}){flap} — {d.get('reason')}"
+            )
+        if bp_total > 3:
+            lines.append(
+                f"  - … {bp_total - 3} more backpressure toggle(s) "
+                "while replacements warmed (admission gate, "
+                "replica count unchanged)"
+            )
+    flaps = info.get("flaps", 0)
+    lines.append(
+        f"- flap count: {flaps}"
+        + ("" if flaps == 0 else " — DIRECTION CHURN (policy bug)")
+    )
+    for s in info.get("scores") or []:
+        lines.append(
+            f"- score[{s['leg']}]: "
+            f"{_fmt_num(s['violation_minutes_modeled'], 'modeled violation-min')}, "
+            f"{_fmt_num(s['wasted_replica_hours_modeled'], 'wasted replica-h')}, "
+            f"{s['flaps']} flap(s)"
+        )
+    lines.append("")
+    return lines
+
+
 def render(report, fmt, comparison=None):
     if fmt == "json":
         out = dict(report)
@@ -1724,6 +1860,7 @@ def render(report, fmt, comparison=None):
         _alerts_lines(report.get("alerts"), report.get("rollups"), md)
     )
     lines.extend(_divergence_lines(report.get("divergence"), md))
+    lines.extend(_capacity_lines(report.get("capacity"), md))
     header = "## Span breakdown" if md else "span breakdown:"
     lines.append(header)
     if report["spans"]:

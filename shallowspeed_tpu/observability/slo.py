@@ -1,6 +1,7 @@
 """SLO alerting: multi-window multi-burn-rate rules, an alert
 firing→resolved lifecycle emitted as schema-v11 ``alert`` records, and
-the ``AlertSink`` hook ROADMAP item 4's autoscaler will consume.
+the ``AlertSink`` hook the autoscaler (``serving/autoscaler.py``)
+consumes.
 
 BURN-RATE MATH (docs/observability.md § Live telemetry & alerting). An
 SLO target of ``slo_target`` (say 99% of requests good) leaves an error
@@ -62,6 +63,40 @@ from shallowspeed_tpu.observability.rollup import (
 # — "dropped"/"expired" under overload are capacity, not correctness;
 # the knee/queue rules cover those)
 BAD_VERDICTS = ("error", "unhealthy")
+
+# the achieved-rate slack the ONE breach definition below tolerates
+# before calling a window saturated (the historic find_knee default)
+SLO_ACHIEVED_FRACTION = 0.9
+
+
+def slo_breach(
+    p99_latency_s,
+    offered_rps,
+    achieved_rps,
+    slo_ms,
+    achieved_fraction=SLO_ACHIEVED_FRACTION,
+):
+    """THE SLO-breach predicate — the single definition shared by
+    ``bench_serving.find_knee`` (so ``knee_rps`` is "the first offered
+    rate that breaches") and the capacity scoreboard's violation-minute
+    scorer (``serving/bench_replay.py``), so the knee and the scoreboard
+    can never disagree about what a violation is.
+
+    A window/row breaches when its p99 latency exceeds the SLO, or its
+    achieved rate falls below ``achieved_fraction`` x the offered rate
+    (saturation: the service is silently shedding the difference into
+    the backlog). Returns the breach reason (``"p99_above_slo"`` /
+    ``"achieved_below_offered"``) or ``None`` — callers needing a bool
+    truth-test the return. ``None`` inputs abstain rather than guess:
+    a missing p99 (no completions) only breaches through the achieved
+    test, and with no evidence at all the verdict is "no breach"."""
+    if slo_ms is not None and p99_latency_s is not None:
+        if p99_latency_s > slo_ms / 1000.0:
+            return "p99_above_slo"
+    if achieved_rps is not None and offered_rps:
+        if achieved_rps < achieved_fraction * offered_rps:
+            return "achieved_below_offered"
+    return None
 
 
 class AlertSink:

@@ -120,6 +120,13 @@ class WatchState:
         self.active = {}  # (rule, replica_id) -> latest firing record
         self.events = deque(maxlen=12)  # recent health events
         self.summaries = {}  # latest `serving`/`fleet` summary per name
+        # the capacity loop (schema v13): live fleet size + the most
+        # recent autoscale decision — folded from `autoscale` records
+        # and the fleet_health scale_up/scale_down target field, so the
+        # dashboard shows the loop acting whether or not a policy runs
+        self.fleet_replicas = None
+        self.autoscale_count = 0
+        self.last_autoscale = None
         self.history = history
         # the watcher's OWN rollups recomputed from raw records — the
         # surface for runs that predate v11 emitters, and the
@@ -180,8 +187,19 @@ class WatchState:
             c.count(ts, "steps")
             if rec.get("loss") is not None:
                 c.gauge(ts, "loss", rec["loss"])
+        elif kind == "autoscale":
+            self.autoscale_count += 1
+            self.last_autoscale = rec
+            if rec.get("replicas_after") is not None:
+                self.fleet_replicas = rec["replicas_after"]
         elif kind in ("serving_health", "fleet_health", "health"):
             self.events.append(rec)
+            if (
+                kind == "fleet_health"
+                and rec.get("name") in ("scale_up", "scale_down")
+                and rec.get("target") is not None
+            ):
+                self.fleet_replicas = rec["target"]
         elif kind in ("serving", "fleet"):
             self.summaries[f"{kind}:{rec.get('name')}"] = rec
 
@@ -212,6 +230,11 @@ class WatchState:
                 ),
             },
             "summaries": dict(sorted(self.summaries.items())),
+            "fleet": {
+                "replicas": self.fleet_replicas,
+                "autoscale_decisions": self.autoscale_count,
+                "last_autoscale": self.last_autoscale,
+            },
         }
 
     # -- text rendering -----------------------------------------------------
@@ -235,6 +258,17 @@ class WatchState:
             lines.append(f"ALERTS FIRING: {firing}")
         else:
             lines.append("alerts: none firing")
+        if self.fleet_replicas is not None or self.last_autoscale:
+            parts = [f"fleet: {_fmt(self.fleet_replicas)} replica(s)"]
+            la = self.last_autoscale
+            if la:
+                parts.append(
+                    f"last autoscale [{_fmt(la.get('t'))}] "
+                    f"{la.get('name')} ({la.get('direction')}, rule "
+                    f"{la.get('rule')}, {_fmt(la.get('replicas_before'))}"
+                    f"→{_fmt(la.get('replicas_after'))})"
+                )
+            lines.append(" | ".join(parts))
         for a in self.alerts[-6:]:
             t = a.get("t")
             lines.append(

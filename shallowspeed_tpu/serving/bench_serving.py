@@ -69,6 +69,7 @@ import time
 import numpy as np
 
 from shallowspeed_tpu import faults as F
+from shallowspeed_tpu.observability import slo
 from shallowspeed_tpu.observability.metrics import json_safe
 from shallowspeed_tpu.serving.engine import ServingEngine
 from shallowspeed_tpu.serving.loadgen import (
@@ -95,17 +96,23 @@ SWEEP_ROW_FIELDS = (
 )
 
 
-def find_knee(rows, slo_ms, achieved_fraction=0.9):
+def find_knee(rows, slo_ms, achieved_fraction=slo.SLO_ACHIEVED_FRACTION):
     """The saturation knee: the first offered rate (rows are swept in
-    ascending offered order) whose p99 exceeds the SLO or whose achieved
-    rate falls below ``achieved_fraction`` x offered. None = no knee
-    inside the swept range (the verdict then says so instead of guessing)."""
+    ascending offered order) that breaches the shared SLO predicate —
+    p99 above the SLO, or achieved rate below ``achieved_fraction`` x
+    offered. The breach definition lives in ``observability.slo.
+    slo_breach`` (the capacity scoreboard scores violation minutes with
+    the SAME call, so knee and scoreboard can never disagree). None =
+    no knee inside the swept range (the verdict then says so instead of
+    guessing)."""
     for row in rows:
-        p99 = row.get("p99_latency_s")
-        if slo_ms is not None and p99 is not None and p99 > slo_ms / 1000.0:
-            return row["offered_rps"]
-        ach, off = row.get("achieved_rps"), row.get("offered_rps")
-        if ach is not None and off and ach < achieved_fraction * off:
+        if slo.slo_breach(
+            row.get("p99_latency_s"),
+            row.get("offered_rps"),
+            row.get("achieved_rps"),
+            slo_ms,
+            achieved_fraction=achieved_fraction,
+        ):
             return row["offered_rps"]
     return None
 
@@ -118,12 +125,21 @@ def sweep(
     slo_ms=None,
     rows_choices=(1, 2, 3, 4, 8),
     metrics=None,
+    max_slots=None,
+    dispatch_floor_ms=0.0,
 ):
     """Run the offered-load sweep on an existing session; returns the
     versioned JSON-able bench record. The SAME seeded request stream is
     replayed at every rate (only the arrival clock changes), so rows
-    differ by load, not workload."""
-    engine = ServingEngine(session, slo_ms=slo_ms, metrics=metrics)
+    differ by load, not workload. ``dispatch_floor_ms``/``max_slots``
+    shape the engine exactly as a replay fleet's workers would be shaped
+    (engine.py "dispatch floor") — measure the knee with the SAME values
+    you arm the autoscaler with, or the measurement prices the wrong
+    machine."""
+    engine = ServingEngine(
+        session, slo_ms=slo_ms, metrics=metrics, max_slots=max_slots,
+        dispatch_floor_ms=dispatch_floor_ms,
+    )
     # compile every rung before the sweep: the percentiles must measure
     # serving under load, not the first rate's XLA compiles
     engine.warm_ladder()
@@ -153,6 +169,8 @@ def sweep(
             "seed": seed,
             "slo_ms": slo_ms,
             "rows_choices": list(rows_choices),
+            "max_slots": max_slots,
+            "dispatch_floor_ms": dispatch_floor_ms,
         },
         "latency_bound_s": bound["seconds"],
         "latency_bound_ticks": bound["ticks"],
@@ -642,6 +660,14 @@ def main(argv=None):
         "anchor is reached",
     )
     ap.add_argument(
+        "--dispatch-floor-ms",
+        type=float,
+        default=0.0,
+        help="per-dispatch service-time floor (engine.py 'dispatch "
+        "floor'): measure the knee with the SAME floor the replay "
+        "fleet's workers run, so the knee transfers to the fleet path",
+    )
+    ap.add_argument(
         "--chaos-out", default=None, help="write the chaos JSON record here"
     )
     ap.add_argument(
@@ -792,6 +818,8 @@ def main(argv=None):
         slo_ms=args.slo_ms,
         rows_choices=tuple(int(r) for r in args.rows.split(",") if r.strip()),
         metrics=metrics,
+        max_slots=args.max_slots,
+        dispatch_floor_ms=args.dispatch_floor_ms,
     )
     text = json.dumps(json_safe(record), indent=2, allow_nan=False)
     if args.out:

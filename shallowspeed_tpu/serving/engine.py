@@ -77,7 +77,17 @@ submitted request reaches exactly one TERMINAL verdict, never silence:
 - **chaos**: a ``faults=`` plan (the PR6 grammar, ``@dispatch=N``
   anchors) injects ``die``/``slow``/``nan``/``error`` faults into the
   dispatch loop deterministically — ``bench_serving``'s chaos soak and
-  ``make chaos-smoke`` drive it.
+  ``make chaos-smoke`` drive it;
+- **dispatch floor**: ``dispatch_floor_ms`` pads every successful
+  dispatch up to a fixed service-time floor (the worker sleeps out the
+  remainder). On accelerators the model forward provides this floor
+  naturally; on a shared/single-core CPU testbed the knob makes a
+  replica's capacity slot-concurrency-bound (``max_slots / floor``)
+  instead of bound by the host core, so a fleet's capacity scales with
+  replica count and a measured single-engine knee transfers to the
+  fleet path — what ``bench_replay``'s capacity scoreboard needs to
+  judge horizontal scaling honestly (the knob is recorded as a caveat
+  in its committed artifact).
 
 Clock-domain contract (docs/observability.md § Tracing): every request
 timestamp this engine records — ``enqueue_t``/``dispatch_t``/
@@ -246,6 +256,7 @@ class ServingEngine:
         loaded_step=None,
         shed_on_submit=False,
         faults=None,
+        dispatch_floor_ms=0.0,
         tracer=None,
         telemetry_window_s=1.0,
         knee_rps=None,
@@ -287,6 +298,9 @@ class ServingEngine:
         self._reload_dir = reload_dir
         self._loaded_step = loaded_step  # watcher freshness floor
         self._shed_on_submit = bool(shed_on_submit)
+        if dispatch_floor_ms < 0:
+            raise ValueError("dispatch_floor_ms must be >= 0")
+        self._dispatch_floor_s = float(dispatch_floor_ms) / 1000.0
         self._faults = F.make_plan(faults)
         # request tracing (module docstring): a standalone engine owns
         # its requests end to end — it mints trace ids and emits the
@@ -583,6 +597,13 @@ class ServingEngine:
             done.extend(self._recover_failed_dispatch(batch, seq, e))
             self._record_depth(self.clock())
             return done
+        if self._dispatch_floor_s:
+            # service-time floor: pad the dispatch up to the configured
+            # wall (constructor docstring) — sleeping, so co-located
+            # replicas serve their floors concurrently
+            spent = self.clock() - t_d
+            if spent < self._dispatch_floor_s:
+                time.sleep(self._dispatch_floor_s - spent)
         t_preds = self.clock()  # dispatch span boundary: rung program done
         t_c = self.clock()
         off = 0

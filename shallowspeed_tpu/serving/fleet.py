@@ -663,6 +663,11 @@ class ServingFleet:
         self._depth_sum = 0.0
         self._depth_n = 0
         self._parity_mismatches = 0
+        # admission gate (serving/autoscaler.py backpressure): consulted
+        # per submit AFTER the degraded check; a reason string sheds the
+        # request as dropped with that reason
+        self._admission_gate = None
+        self._gate_dropped = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -854,6 +859,23 @@ class ServingFleet:
 
     # -- admission -----------------------------------------------------------
 
+    def set_admission_gate(self, gate):
+        """Install (or clear, with ``None``) the admission gate: a
+        ``gate(fleet) -> reason|None`` callable consulted on every
+        ``submit`` after the degraded check. A truthy reason sheds the
+        request immediately — ``dropped`` with that reason — which is the
+        autoscaler's warm-up backpressure hook (``serving/autoscaler.py``):
+        while replacement replicas warm, an unbounded backlog would burn
+        every queued deadline past the analytical latency floor, so the
+        policy sheds at admission instead and the refusals are scored
+        honestly as violations by the capacity scoreboard."""
+        self._admission_gate = gate
+
+    @property
+    def gate_dropped(self):
+        """Requests shed by the admission gate (backpressure refusals)."""
+        return self._gate_dropped
+
     def submit(self, x, deadline_ms=None, arrival_t=None):
         """Admit one request of ``(rows, in_dim)`` inputs; returns its
         ``FleetRequest`` (terminal immediately when refused).
@@ -881,6 +903,12 @@ class ServingFleet:
         if self._degraded:
             self._complete(req, "dropped", reason="fleet_degraded")
             return req
+        if self._admission_gate is not None:
+            reason = self._admission_gate(self)
+            if reason:
+                self._gate_dropped += 1
+                self._complete(req, "dropped", reason=str(reason))
+                return req
         if not self._router.admit(req):
             self._complete(req, "dropped", reason="fleet_queue_full")
             return req
@@ -1490,6 +1518,7 @@ class ServingFleet:
             "replicas_target": self._target,
             "replicas_ready": self.n_ready,
             "replicas_dead": self._replicas_dead,
+            "gate_dropped": self._gate_dropped,
             "per_replica": {
                 i.replica_id: {
                     "state": i.state,

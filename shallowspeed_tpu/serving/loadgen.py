@@ -96,7 +96,7 @@ def request_payloads(n, in_dim, seed=0, rows_choices=(1, 2, 3, 4, 8), data=None)
 
 def run_open_loop(
     engine, payloads, arrivals, deadline_ms=None, sleep=time.sleep,
-    should_stop=None,
+    should_stop=None, on_tick=None, tick_s=0.05,
 ):
     """Replay ``payloads`` against the engine on the ``arrivals`` schedule
     (seconds from start, one per payload); returns the completed requests.
@@ -116,7 +116,15 @@ def run_open_loop(
     the graceful-drain hook (serving ``__main__``'s SIGTERM/SIGINT
     handler): once it returns True, ADMISSION stops (remaining payloads
     are never submitted) but everything already queued is drained to a
-    terminal verdict before returning."""
+    terminal verdict before returning.
+
+    ``on_tick``: an optional ``on_tick(elapsed_s)`` callable invoked once
+    per loop iteration with seconds since the drive started — the
+    autoscaler's poll hook (``serving/autoscaler.py``): the policy makes
+    its between-edge decisions here, on the driver thread, so scaling
+    actions never race the submit/step loop. When set, idle sleeps are
+    capped at ``tick_s`` so the policy keeps observing through quiet
+    troughs instead of sleeping until the next arrival."""
     if len(payloads) != len(arrivals):
         raise ValueError("one arrival time per payload")
     t0 = engine.clock()
@@ -126,6 +134,8 @@ def run_open_loop(
             while engine.queue_depth:
                 done.extend(_step_reentrant(engine))
             break
+        if on_tick is not None:
+            on_tick(engine.clock() - t0)
         now = engine.clock() - t0
         while i < n and arrivals[i] <= now:
             engine.submit(
@@ -135,7 +145,8 @@ def run_open_loop(
         if engine.queue_depth:
             done.extend(_step_reentrant(engine))
         elif i < n:
-            sleep(max(0.0, arrivals[i] - (engine.clock() - t0)))
+            idle = max(0.0, arrivals[i] - (engine.clock() - t0))
+            sleep(min(idle, tick_s) if on_tick is not None else idle)
     return done
 
 

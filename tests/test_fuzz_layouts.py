@@ -200,7 +200,12 @@ def _assert_lattice_case_matches_sequential(
         )
 
 
-@pytest.mark.parametrize("seed", range(12))
+@pytest.mark.parametrize(
+    "seed",
+    # seed 5 deterministically draws the heaviest combo (~10s alone) —
+    # it rides the slow tier (1-core wall budget), still in the full suite
+    [pytest.param(s, marks=pytest.mark.slow) if s == 5 else s for s in range(12)],
+)
 def test_random_r2_feature_combo_matches_sequential(seed):
     """Random (optimizer, zero1, virtual-stage) combinations must still equal
     sequential training with the same optimizer — the round-2 features
@@ -255,7 +260,12 @@ def _random_case_r3(seed):
     )
 
 
-@pytest.mark.parametrize("seed", range(12))
+@pytest.mark.parametrize(
+    "seed",
+    # seed 7 deterministically draws the heaviest combo (~7s alone) —
+    # it rides the slow tier (1-core wall budget), still in the full suite
+    [pytest.param(s, marks=pytest.mark.slow) if s == 7 else s for s in range(12)],
+)
 def test_random_r3_kernel_backend_combo_matches_sequential(seed):
     """Random (optimizer, zero1, kernel_backend, virtual, epoch-vs-step,
     grad-bucket-bytes, backward-split, tp) combinations must still equal
@@ -429,7 +439,18 @@ def session_data_dir(tmp_path_factory):
     return d
 
 
-@pytest.mark.parametrize("layout", sorted(KILL_RESUME_LAYOUTS))
+@pytest.mark.parametrize(
+    "layout",
+    [
+        # the elastic restores run two full sessions each and are the
+        # slowest legs — exotic layouts ride the slow tier (1-core wall
+        # budget); the same-layout legs keep tier-1 coverage
+        pytest.param(lay, marks=pytest.mark.slow)
+        if lay.startswith("elastic")
+        else lay
+        for lay in sorted(KILL_RESUME_LAYOUTS)
+    ],
+)
 def test_kill_and_resume_bitwise_identical_to_uninterrupted(
     layout, session_data_dir, tmp_path
 ):
@@ -585,7 +606,17 @@ def flagship_data_dir(tmp_path_factory):
     return d
 
 
-@pytest.mark.parametrize("layout", sorted(ASYNC_KILL_LAYOUTS))
+@pytest.mark.parametrize(
+    "layout",
+    [
+        "dp2",
+        # the writer-window contract is layout-free host-side snapshot
+        # logic, so one tier-1 subprocess leg suffices; the tp2/pp4 twins
+        # ride the slow tier (1-core wall budget), still in the full suite
+        pytest.param("gpipe-pp4", marks=pytest.mark.slow),
+        pytest.param("tp2", marks=pytest.mark.slow),
+    ],
+)
 def test_async_save_sigkill_in_writer_window_resumes_bitwise(
     layout, flagship_data_dir, tmp_path
 ):

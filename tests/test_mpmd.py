@@ -232,6 +232,9 @@ def test_session_mpmd_hash_and_predict_parity(mpmd_data_dir):
     np.testing.assert_array_equal(b.predict(one), resolve())
 
 
+@pytest.mark.slow  # four full runs (both kill/resume directions) — slow
+# tier per the 1-core wall budget; the per-runtime kill-resume legs and
+# the lockstep-parity tests keep tier-1 coverage of each half
 def test_kill_and_resume_is_runtime_independent(mpmd_data_dir, tmp_path):
     """Checkpoints are runtime-independent: a run killed under ONE
     runtime resumes under the OTHER and finishes on the uninterrupted

@@ -114,6 +114,7 @@ def test_null_metrics_hot_path_zero_net_allocation():
             m.rollup("w")  # ... and the v11 live-telemetry hooks
             m.alert("a")
             m.digest("d")  # ... and the v12 numerics-provenance hook
+            m.autoscale("a")  # ... and the v13 capacity hook
 
     burst(100)  # warm up caches (method cache, code objects)
     # background threads (XLA's pools) can allocate a handful of blocks at
@@ -999,16 +1000,12 @@ def test_schema_v12_digest(tmp_path):
     """Schema v12 (additive): the ``digest`` kind — one numerics-provenance
     row per optimizer step, with per-global-layer crc/norm lists — round
     trips with the version stamp AND the non-finite sanitizer, the v12
-    reader accepts v1-v11 files unchanged, a v13 file is refused, and
-    NullMetrics no-ops the hook. Carries the version pin and the one-ahead
-    refusal (the newest-schema convention)."""
+    reader accepts v1-v11 files unchanged, and NullMetrics no-ops the
+    hook. (The version pin and one-ahead refusal moved to the v13 test —
+    the newest-schema convention.)"""
     from shallowspeed_tpu.observability.metrics import SCHEMA_KINDS
 
-    assert SCHEMA_VERSION == 12
-    # the registry IS the docstring's kind list: every recorder hook has
-    # a registered kind, and the newest kinds carry the newest version
     assert SCHEMA_KINDS["digest"] == 12
-    assert max(SCHEMA_KINDS.values()) == SCHEMA_VERSION
     path = tmp_path / "v12.jsonl"
     with JsonlMetrics(path) as m:
         m.digest(
@@ -1035,12 +1032,55 @@ def test_schema_v12_digest(tmp_path):
         p = tmp_path / f"digest-old-v{v}.jsonl"
         p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
         assert read_jsonl(p)[0]["kind"] == rec["kind"]
-    # one-directional refusal: a v13 file fails loudly
-    v13 = tmp_path / "v13.jsonl"
-    v13.write_text(json.dumps({"v": 13, "kind": "event"}) + "\n")
-    with pytest.raises(ValueError, match="newer"):
-        read_jsonl(v13)
     NullMetrics().digest("train", step=0, crc_w=[])
+
+
+def test_schema_v13_autoscale(tmp_path):
+    """Schema v13 (additive): the ``autoscale`` kind — one capacity
+    decision with its evidence (rule, direction, fleet size before/
+    after, rollup window, flap flag) — round trips with the version
+    stamp, the v13 reader accepts v1-v12 files unchanged, a v14 file is
+    refused, and NullMetrics no-ops the hook. Carries the version pin
+    and the one-ahead refusal (the newest-schema convention)."""
+    from shallowspeed_tpu.observability.metrics import SCHEMA_KINDS
+
+    assert SCHEMA_VERSION == 13
+    # the registry IS the docstring's kind list: every recorder hook has
+    # a registered kind, and the newest kinds carry the newest version
+    assert SCHEMA_KINDS["autoscale"] == 13
+    assert max(SCHEMA_KINDS.values()) == SCHEMA_VERSION
+    path = tmp_path / "v13.jsonl"
+    with JsonlMetrics(path) as m:
+        m.autoscale(
+            "scale_out", direction="out", rule="knee_proximity", t=12.5,
+            replicas_before=1, replicas_after=2, replicas_ready=1,
+            queue_depth=4, window_end=12.0, value=43.7, threshold=40.5,
+            flap=False, reason="admitted rate within 10% of the knee",
+            leg="autoscaled",
+        )
+    recs = read_jsonl(path)
+    assert [r["kind"] for r in recs] == ["meta", "autoscale"]
+    assert all(r["v"] == SCHEMA_VERSION for r in recs)
+    d = recs[1]
+    assert d["name"] == "scale_out" and d["direction"] == "out"
+    assert d["replicas_before"] == 1 and d["replicas_after"] == 2
+    assert d["rule"] == "knee_proximity" and d["flap"] is False
+    # v1-v12 files load unchanged under the v13 reader
+    for v, rec in (
+        (1, {"kind": "event", "name": "epoch", "epoch": 0, "loss": 0.5}),
+        (5, {"kind": "request", "name": "ok", "id": 1}),
+        (11, {"kind": "alert", "name": "breaker_open", "state": "firing"}),
+        (12, {"kind": "digest", "name": "train", "step": 0, "crc_w": [1]}),
+    ):
+        p = tmp_path / f"autoscale-old-v{v}.jsonl"
+        p.write_text(json.dumps({"v": v, "ts": 0.0, **rec}) + "\n")
+        assert read_jsonl(p)[0]["kind"] == rec["kind"]
+    # one-directional refusal: a v14 file fails loudly
+    v14 = tmp_path / "v14.jsonl"
+    v14.write_text(json.dumps({"v": 14, "kind": "event"}) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        read_jsonl(v14)
+    NullMetrics().autoscale("scale_out", direction="out")
 
 
 def test_replica_shard_suffix_and_fallback_read(tmp_path):

@@ -7,6 +7,8 @@ import prepare_data
 from shallowspeed_tpu.data import Dataset
 
 
+@pytest.mark.slow  # `make data` drives prepare() end to end; the digits
+# and determinism legs keep tier-1 coverage (1-core wall budget)
 def test_synthetic_source_end_to_end(tmp_path):
     used = prepare_data.prepare(tmp_path / "d", source="synthetic")
     assert used == "synthetic"
@@ -30,6 +32,8 @@ def test_digits_source_shapes(tmp_path):
     assert len(x) > 40000  # replicated to MNIST-like scale
 
 
+@pytest.mark.slow  # the fallback chain re-runs a full prepare() — slow
+# tier per the 1-core wall budget; the source legs above stay tier-1
 def test_auto_falls_back_when_network_source_fails(tmp_path, monkeypatch):
     # deterministic offline simulation: the network source raises, the chain
     # lands on the next offline source (no real fetch, no retry stalls)

@@ -1,0 +1,607 @@
+"""Capacity scoreboard tests (ISSUE 18): diurnal replay determinism,
+the offline oracle on hand-computed synthetic traces, the shared
+SLO-breach predicate, the autoscale policy against a fake fleet on an
+injected clock, and the watch/report capacity surfaces.
+
+Everything here is fleet-free and fast (tier-1): the driven-leg
+integration lives in ``make replay-smoke``.
+"""
+
+import inspect
+import json
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu.observability import slo
+from shallowspeed_tpu.observability.metrics import json_safe
+from shallowspeed_tpu.serving import bench_replay
+from shallowspeed_tpu.serving.autoscaler import AutoscalePolicy
+from shallowspeed_tpu.serving.bench_serving import find_knee
+from shallowspeed_tpu.serving.loadgen import run_open_loop
+from shallowspeed_tpu.serving.replay import diurnal_rate, diurnal_trace
+
+
+# -- trace determinism -------------------------------------------------------
+
+
+def test_diurnal_trace_deterministic():
+    """Same seed -> byte-identical arrival schedule and rate trace;
+    a different seed -> a different trace."""
+    a = diurnal_trace(day_s=30.0, base_rps=5.0, peak_rps=20.0, seed=7)
+    b = diurnal_trace(day_s=30.0, base_rps=5.0, peak_rps=20.0, seed=7)
+    assert np.array_equal(a["arrivals"], b["arrivals"])
+    assert a["arrivals"].tobytes() == b["arrivals"].tobytes()
+    assert json.dumps(a["buckets"]) == json.dumps(b["buckets"])
+    assert a["config"] == b["config"]
+    c = diurnal_trace(day_s=30.0, base_rps=5.0, peak_rps=20.0, seed=8)
+    assert not np.array_equal(a["arrivals"], c["arrivals"])
+
+
+def test_diurnal_trace_shape():
+    """Arrivals are sorted inside [0, day_s); the bucket counts account
+    for every arrival; the flash-crowd spike raises the analytic rate
+    above the plain diurnal curve; the thinning envelope dominates."""
+    tr = diurnal_trace(
+        day_s=60.0, base_rps=4.0, peak_rps=16.0, seed=3,
+        n_spikes=1, spike_mult=3.0,
+    )
+    arr = tr["arrivals"]
+    assert arr.shape[0] == tr["config"]["n_arrivals"] > 0
+    assert np.all(np.diff(arr) >= 0)
+    assert arr[0] >= 0.0 and arr[-1] < 60.0
+    assert sum(b["arrivals"] for b in tr["buckets"]) == arr.shape[0]
+    cfg = tr["config"]
+    (spike,) = cfg["spikes"]
+    mid = spike["start"] + spike["duration"] / 2.0
+    with_spike = diurnal_rate(mid, 60.0, 4.0, 16.0, cfg["spikes"])
+    without = diurnal_rate(mid, 60.0, 4.0, 16.0, ())
+    assert with_spike == pytest.approx(3.0 * without)
+    for b in tr["buckets"]:
+        assert b["rate_rps"] <= cfg["rate_max"] + 1e-9
+    assert cfg["compression"] == pytest.approx(86400.0 / 60.0)
+
+
+# -- the oracle and the scorers (hand-computed) ------------------------------
+
+
+def _flat_buckets(rate, n=6, width=10.0):
+    return [
+        {
+            "t0": i * width,
+            "t1": (i + 1) * width,
+            "rate_rps": rate,
+            "arrivals": int(rate * width),
+            "offered_rps": rate,
+        }
+        for i in range(n)
+    ]
+
+
+def test_oracle_constant_trace():
+    """Constant demand 25 rps on a 10-rps knee: ceil(25/10) = 3 replicas
+    every bucket; with max 3 the day is feasible (0 violation minutes,
+    3 x 60s = 180 replica-seconds); with max 2 EVERY bucket is
+    infeasible — exactly 1.0 violation minutes over the 60s trace."""
+    buckets = _flat_buckets(25.0)
+    oracle = bench_replay.oracle_schedule(buckets, 10.0, max_replicas=3)
+    assert [b["replicas"] for b in oracle] == [3] * 6
+    assert not any(b["infeasible"] for b in oracle)
+    score = bench_replay.oracle_score(oracle)
+    assert score["violation_minutes"] == 0.0
+    assert score["replica_s"] == pytest.approx(180.0)
+    clamped = bench_replay.oracle_schedule(buckets, 10.0, max_replicas=2)
+    assert all(b["infeasible"] for b in clamped)
+    assert bench_replay.oracle_score(clamped)["violation_minutes"] == (
+        pytest.approx(1.0)
+    )
+
+
+def test_oracle_step_trace_and_waste():
+    """Step trace (two quiet buckets at 5 rps, four busy at 25) on a
+    10-rps knee: oracle = [1,1,3,3,3,3]. A static fleet of 3 wastes
+    exactly 2 replicas x 20 quiet seconds = 40 replica-seconds; an
+    autoscaled timeline that steps 1 -> 3 at t=20 wastes nothing."""
+    buckets = _flat_buckets(5.0, n=2) + [
+        {**b, "t0": b["t0"] + 20.0, "t1": b["t1"] + 20.0}
+        for b in _flat_buckets(25.0, n=4)
+    ]
+    oracle = bench_replay.oracle_schedule(buckets, 10.0, max_replicas=3)
+    assert [b["replicas"] for b in oracle] == [1, 1, 3, 3, 3, 3]
+    static = [(0.0, 3)]
+    assert bench_replay.replica_seconds(static, 60.0) == pytest.approx(180.0)
+    assert bench_replay.wasted_replica_seconds(static, oracle) == (
+        pytest.approx(40.0)
+    )
+    scaled = [(0.0, 1), (20.0, 3)]
+    assert bench_replay.replica_seconds(scaled, 60.0) == pytest.approx(140.0)
+    assert bench_replay.wasted_replica_seconds(scaled, oracle) == (
+        pytest.approx(0.0)
+    )
+
+
+def test_oracle_spike_trace():
+    """One spike bucket beyond max capacity: only ITS width is
+    infeasible violation time; the clamp never under-runs min_replicas."""
+    buckets = _flat_buckets(8.0, n=5)
+    buckets[2] = {**buckets[2], "rate_rps": 55.0, "offered_rps": 55.0}
+    oracle = bench_replay.oracle_schedule(
+        buckets, 10.0, min_replicas=2, max_replicas=4
+    )
+    assert [b["replicas"] for b in oracle] == [2, 2, 4, 2, 2]
+    assert [b["infeasible"] for b in oracle] == [
+        False, False, True, False, False,
+    ]
+    score = bench_replay.oracle_score(oracle, compression=60.0)
+    assert score["violation_s"] == pytest.approx(10.0)
+    assert score["violation_minutes_modeled"] == pytest.approx(10.0)
+
+
+def test_score_samples_charges_shed_load():
+    """The violation scorer and find_knee share ONE breach definition:
+    a bucket whose p99 beats the SLO but whose ok-rate fell under the
+    achieved fraction still breaches (shed load is charged, not
+    hidden), and the reason string is slo_breach's own."""
+    buckets = _flat_buckets(10.0, n=2)
+    # bucket 0: all 100 requests ok and fast -> no breach; bucket 1:
+    # only 50 of 100 ok (rest dropped) -> achieved 5 < 0.9 x 10
+    samples = [
+        {"arrival": 0.5 + i * 0.05, "verdict": "ok", "latency_s": 0.005}
+        for i in range(100)
+    ]
+    samples += [
+        {
+            "arrival": 10.5 + i * 0.05,
+            "verdict": "ok" if i < 50 else "dropped",
+            "latency_s": 0.005 if i < 50 else None,
+        }
+        for i in range(100)
+    ]
+    out = bench_replay.score_samples(samples, buckets, slo_ms=100.0)
+    assert out["buckets"][0]["breach"] is None
+    assert out["buckets"][1]["breach"] == "achieved_below_offered"
+    assert out["violation_s"] == pytest.approx(10.0)
+    assert out["verdicts"] == {"ok": 150, "dropped": 50}
+
+
+def test_breach_predicate_is_shared():
+    """Satellite 1: find_knee's default achieved fraction IS the slo
+    module's, and the knee it returns is the first row slo_breach
+    flags — the scoreboard and the knee can never disagree."""
+    sig = inspect.signature(find_knee)
+    assert (
+        sig.parameters["achieved_fraction"].default
+        is slo.SLO_ACHIEVED_FRACTION
+    )
+    assert slo.slo_breach(0.2, 10.0, 10.0, slo_ms=100.0) == "p99_above_slo"
+    assert slo.slo_breach(0.01, 10.0, 8.0, slo_ms=100.0) == (
+        "achieved_below_offered"
+    )
+    assert slo.slo_breach(0.01, 10.0, 9.95, slo_ms=100.0) is None
+    # abstention: no p99 evidence only breaches through achieved; no
+    # evidence at all is "no breach", never a guess
+    assert slo.slo_breach(None, 10.0, 5.0, slo_ms=100.0) == (
+        "achieved_below_offered"
+    )
+    assert slo.slo_breach(None, 0.0, None, slo_ms=None) is None
+    rows = [
+        {"offered_rps": 10.0, "p99_latency_s": 0.01, "achieved_rps": 10.0},
+        {"offered_rps": 20.0, "p99_latency_s": 0.01, "achieved_rps": 17.0},
+        {"offered_rps": 40.0, "p99_latency_s": 0.30, "achieved_rps": 39.0},
+    ]
+    assert find_knee(rows, slo_ms=100.0) == 20.0
+    flagged = [
+        r["offered_rps"]
+        for r in rows
+        if slo.slo_breach(
+            r["p99_latency_s"], r["offered_rps"], r["achieved_rps"], 100.0
+        )
+    ]
+    assert flagged[0] == find_knee(rows, slo_ms=100.0)
+
+
+def test_scoreboard_record_deterministic():
+    """Same trace + samples + timelines (an injected clock's numbers)
+    -> the SAME scoreboard record, byte for byte: nothing inside the
+    assembly reads a wall clock."""
+    trace = diurnal_trace(day_s=30.0, base_rps=5.0, peak_rps=20.0, seed=1)
+    oracle = bench_replay.oracle_schedule(
+        trace["buckets"], 10.0, max_replicas=3
+    )
+    samples = [
+        {"arrival": float(t), "verdict": "ok", "latency_s": 0.004}
+        for t in trace["arrivals"][:50]
+    ]
+
+    def build():
+        legs = {}
+        for leg, timeline in (
+            ("static", [(0.0, 2)]),
+            ("autoscaled", [(0.0, 1), (9.0, 2), (22.0, 1)]),
+            ("chaos", [(0.0, 1), (9.0, 2)]),
+        ):
+            legs[leg] = {
+                **bench_replay.score_leg(
+                    samples, trace["buckets"], 100.0, timeline, oracle,
+                    compression=trace["config"]["compression"],
+                ),
+                "flaps": 0,
+            }
+        rec = bench_replay.scoreboard_record(
+            trace, 10.0, 100.0, legs, oracle,
+            config={"seed": 1}, caveats=["injected clock"],
+        )
+        return json.dumps(json_safe(rec), sort_keys=True, allow_nan=False)
+
+    assert build() == build()
+    rec = json.loads(build())
+    assert rec["bench"] == "autoscale_scoreboard"
+    assert "chaos_zero_flaps" in rec["verdicts"]
+    assert "autoscaled_beats_static_violation_minutes" in rec["verdicts"]
+
+
+# -- the policy on a fake fleet (injected clock) -----------------------------
+
+
+class FakeFleet:
+    """status()/scale_up/scale_down shaped like ServingFleet, fully
+    synchronous and clockless — the policy's decisions are driven by
+    the `now` values the test passes to tick()."""
+
+    def __init__(self, n_ready=1):
+        self._next = 0
+        self.states = {}
+        for _ in range(n_ready):
+            self._add("ready")
+        self.queue = 0
+        self.dead = 0
+        self.admitted = None
+        self.window_end = None
+        self.alerts_active = {}
+        self.degraded = False
+        self.scale_ups = []
+        self.scale_downs = []
+        self.gate = None
+
+    def _add(self, state):
+        rid = self._next
+        self._next += 1
+        self.states[rid] = state
+        return rid
+
+    def set_admission_gate(self, fn):
+        self.gate = fn
+
+    def scale_up(self, checkpoint=None, wait_ready=True):
+        self.scale_ups.append(wait_ready)
+        return self._add("starting")
+
+    def scale_down(self, replica_id=None):
+        rid = max(r for r, s in self.states.items() if s == "ready")
+        self.states[rid] = "draining"
+        self.scale_downs.append(rid)
+        return rid
+
+    def ready_all(self):
+        for rid, s in self.states.items():
+            if s == "starting":
+                self.states[rid] = "ready"
+
+    def kill_one(self):
+        rid = max(r for r, s in self.states.items() if s == "ready")
+        self.states[rid] = "dead"
+        self.dead += 1
+
+    def status(self):
+        ready = sum(1 for s in self.states.values() if s == "ready")
+        last = None
+        if self.admitted is not None:
+            last = {
+                "window_end": self.window_end,
+                "rates": {"admitted": {"rate": self.admitted}},
+            }
+        return {
+            "queue_depth": self.queue,
+            "inflight": 0,
+            "degraded": self.degraded,
+            "replicas_target": ready,
+            "replicas_ready": ready,
+            "replicas_dead": self.dead,
+            "gate_dropped": 0,
+            "per_replica": {
+                rid: {
+                    "state": s,
+                    "queue_depth": 0,
+                    "degraded": False,
+                    "inflight": 0,
+                    "last_health": None,
+                }
+                for rid, s in self.states.items()
+            },
+            "alerts_active": dict(self.alerts_active),
+            "telemetry": {"rollup": {"last_window": last}, "alerts": {}},
+        }
+
+
+def _policy(fleet, **kw):
+    kw.setdefault("knee_rps", 10.0)
+    kw.setdefault("max_replicas", 3)
+    p = AutoscalePolicy(**kw)
+    p.attach(fleet)
+    return p
+
+
+def test_policy_scale_out_on_knee_edge():
+    fleet = FakeFleet(n_ready=1)
+    p = _policy(fleet)
+    p.alert(
+        {"name": "knee_proximity", "state": "firing", "value": 9.3,
+         "threshold": 9.0, "reason": "admitted near knee"}
+    )
+    p.tick(1.0)
+    assert fleet.scale_ups == [False]  # non-blocking growth
+    d = p.decisions[-1]
+    assert d["decision"] == "scale_out" and d["rule"] == "knee_proximity"
+    assert d["direction"] == "out" and d["flap"] is False
+    assert d["replicas_before"] == 1 and d["replicas_after"] == 2
+    # a warming replica counts toward max: no runaway re-fire while it
+    # warms, even long past the cooldown
+    fleet.admitted = 9.5  # above 0.8 x knee x 1 ready
+    p.tick(50.0)
+    fleet.states[max(fleet.states)] = "ready"
+    p.tick(60.0)  # 2 ready, admitted under 0.8 x knee x 2 -> no action
+    assert fleet.scale_ups == [False]
+
+
+def test_policy_scale_out_resolved_edge_ignored():
+    fleet = FakeFleet(n_ready=1)
+    p = _policy(fleet)
+    p.alert({"name": "knee_proximity", "state": "resolved"})
+    p.alert({"name": "error_burn", "state": "firing"})  # queue empty
+    p.tick(1.0)
+    assert fleet.scale_ups == []
+    fleet.queue = 3  # burn concentrated in the fleet queue
+    p.alert({"name": "error_burn", "state": "firing", "value": 8.0,
+             "threshold": 6.0, "reason": "burn 8x"})
+    p.tick(2.0)
+    assert fleet.scale_ups == [False]
+    assert p.decisions[-1]["rule"] == "error_burn"
+    assert "fleet.queue" in p.decisions[-1]["reason"]
+
+
+def test_policy_scale_in_hysteresis_and_flap_accounting():
+    fleet = FakeFleet(n_ready=2)
+    p = _policy(
+        fleet, min_replicas=1, slack_hold_s=1.0, in_cooldown_s=2.0,
+        out_cooldown_s=0.5, flap_window_s=30.0,
+    )
+    fleet.admitted = 3.0  # < 0.5 x knee x 1 remaining
+    p.tick(0.0)
+    p.tick(0.5)  # slack held 0.5s < 1.0 hold -> no action yet
+    assert fleet.scale_downs == []
+    p.tick(1.2)  # held >= 1.0s, no prior scale -> drain one
+    assert len(fleet.scale_downs) == 1
+    d = p.decisions[-1]
+    assert d["decision"] == "scale_in" and d["direction"] == "in"
+    assert d["flap"] is False
+    # demand surges right back: the reversal inside the flap window is
+    # counted — the accounting the chaos leg's zero-flap gate reads
+    fleet.admitted = 9.5
+    p.tick(3.5)
+    assert fleet.scale_ups == [False]
+    assert p.decisions[-1]["decision"] == "scale_out"
+    assert p.decisions[-1]["flap"] is True and p.flaps == 1
+
+
+def test_policy_slack_interrupted_resets_hold():
+    fleet = FakeFleet(n_ready=2)
+    p = _policy(fleet, min_replicas=1, slack_hold_s=1.0)
+    fleet.admitted = 3.0
+    p.tick(0.0)
+    fleet.queue = 2  # backlog interrupts the slack streak
+    p.tick(0.6)
+    fleet.queue = 0
+    p.tick(1.4)  # streak restarted at 1.4, not 1.4s held
+    assert fleet.scale_downs == []
+    p.tick(2.5)
+    assert len(fleet.scale_downs) == 1
+
+
+def test_policy_replacement_is_not_a_flap():
+    fleet = FakeFleet(n_ready=2)
+    p = _policy(fleet)
+    fleet.kill_one()
+    p.tick(1.0)
+    assert fleet.scale_ups == [False]
+    d = p.decisions[-1]
+    assert d["decision"] == "replace" and d["direction"] == "hold"
+    assert p.flaps == 0
+    # the SAME death is never re-replaced on later ticks
+    p.tick(2.0)
+    assert fleet.scale_ups == [False]
+
+
+def test_policy_backpressure_gate():
+    fleet = FakeFleet(n_ready=1)
+    p = _policy(fleet, warm_queue_budget=5)
+    assert fleet.gate is not None and fleet.gate(fleet) is None
+    fleet.scale_up(wait_ready=False)  # a replica warming...
+    fleet.queue = 9  # ...and a backlog past the budget
+    p.tick(1.0)
+    assert p.decisions[-1]["decision"] == "backpressure_on"
+    assert fleet.gate(fleet) == "backpressure_warming"
+    fleet.queue = 2
+    p.tick(2.0)
+    assert p.decisions[-1]["decision"] == "backpressure_off"
+    assert fleet.gate(fleet) is None
+
+
+def test_policy_decisions_json_safe_and_require_knee():
+    fleet = FakeFleet(n_ready=1)
+    p = _policy(fleet)
+    p.alert({"name": "knee_proximity", "state": "firing"})
+    p.tick(1.0)
+    json.dumps(json_safe(p.decisions), allow_nan=False)
+    with pytest.raises(ValueError, match="knee"):
+        AutoscalePolicy(knee_rps=None)
+    with pytest.raises(RuntimeError, match="attach"):
+        AutoscalePolicy(knee_rps=10.0).tick(0.0)
+
+
+# -- the open-loop tick hook -------------------------------------------------
+
+
+class _TickEngine:
+    """Minimal engine for run_open_loop: injected clock advanced only by
+    sleep and step, so tick cadence is fully deterministic."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.queue = []
+
+    def clock(self):
+        return self.t
+
+    @property
+    def queue_depth(self):
+        return len(self.queue)
+
+    def submit(self, x, deadline_ms=None, arrival_t=None):
+        self.queue.append(x)
+
+    def step(self):
+        self.t += 0.001
+        batch = list(self.queue)
+        self.queue.clear()
+        return batch
+
+
+def test_run_open_loop_on_tick_caps_idle_sleep():
+    eng = _TickEngine()
+    ticks = []
+    done = run_open_loop(
+        eng,
+        payloads=[1, 2],
+        arrivals=[0.5, 1.0],
+        sleep=lambda dt: setattr(eng, "t", eng.t + dt),
+        on_tick=ticks.append,
+        tick_s=0.05,
+    )
+    assert len(done) == 2
+    assert len(ticks) >= 20  # ~1.0s of idle at <= 0.05s per sleep
+    gaps = np.diff(ticks)
+    assert gaps.max() <= 0.06  # idle sleeps capped at tick_s
+    # without the hook, the driver sleeps straight to the next arrival
+    eng2 = _TickEngine()
+    sleeps = []
+    run_open_loop(
+        eng2,
+        payloads=[1],
+        arrivals=[0.5],
+        sleep=lambda dt: (sleeps.append(dt),
+                          setattr(eng2, "t", eng2.t + dt))[1],
+    )
+    assert sleeps and sleeps[0] == pytest.approx(0.5)
+
+
+# -- watch + report capacity surfaces ----------------------------------------
+
+
+def _autoscale_line(**over):
+    rec = {
+        "v": 13, "ts": 1.0, "kind": "autoscale", "name": "scale_out",
+        "direction": "out", "rule": "knee_proximity", "t": 12.5,
+        "replicas_before": 1, "replicas_after": 2, "replicas_ready": 1,
+        "queue_depth": 4, "window_end": 12.0, "value": 9.3,
+        "threshold": 9.0, "flap": False, "reason": "near knee",
+        "leg": "autoscaled",
+    }
+    rec.update(over)
+    return json.dumps(rec)
+
+
+def test_watch_folds_autoscale(capsys):
+    """Satellite 2: the live snapshot carries fleet size + the latest
+    autoscale decision, as a pure fold of the bytes (same lines -> same
+    snapshot, the --once/--follow parity object)."""
+    from shallowspeed_tpu.observability.watch import WatchState
+
+    def fold():
+        st = WatchState()
+        st.ingest_line(_autoscale_line())
+        st.ingest_line(
+            _autoscale_line(name="scale_in", direction="in", rule="poll",
+                            t=40.0, replicas_before=2, replicas_after=1)
+        )
+        return st
+
+    st = fold()
+    snap = st.snapshot()
+    assert snap["fleet"]["replicas"] == 1
+    assert snap["fleet"]["autoscale_decisions"] == 2
+    assert snap["fleet"]["last_autoscale"]["name"] == "scale_in"
+    assert json.dumps(snap, sort_keys=True, default=str) == json.dumps(
+        fold().snapshot(), sort_keys=True, default=str
+    )
+    text = st.render_text("x.jsonl", [])
+    assert "fleet: 1 replica(s)" in text
+    assert "scale_in" in text and "rule poll" in text
+    # without a policy, fleet_health scale events still track size
+    st2 = WatchState()
+    st2.ingest_line(json.dumps(
+        {"v": 13, "ts": 2.0, "kind": "fleet_health", "name": "scale_up",
+         "replica_id": 2, "target": 3}
+    ))
+    assert st2.snapshot()["fleet"]["replicas"] == 3
+    # an empty stream renders no fleet line and a None surface
+    st3 = WatchState()
+    assert st3.snapshot()["fleet"]["replicas"] is None
+    assert "fleet:" not in st3.render_text("x.jsonl", [])
+
+
+def test_report_capacity_section():
+    from shallowspeed_tpu.observability.report import build_report, render
+
+    records = [
+        json.loads(_autoscale_line()),
+        json.loads(_autoscale_line(
+            name="replace", direction="hold", rule="poll", t=20.0,
+            leg="chaos", replicas_before=2, replicas_after=2,
+        )),
+        {
+            "v": 13, "ts": 3.0, "kind": "event", "name": "replay_trace",
+            "seed": 0, "day_s": 90.0, "knee_rps": 10.0, "n_arrivals": 100,
+            "compression": 960.0,
+            "buckets": [
+                {"t0": 0.0, "t1": 45.0, "rate_rps": 4.0,
+                 "offered_rps": 4.2},
+                {"t0": 45.0, "t1": 90.0, "rate_rps": 14.0,
+                 "offered_rps": 13.8},
+            ],
+            "spikes": [{"start": 40.0, "duration": 9.0, "mult": 2.0}],
+        },
+        {
+            "v": 13, "ts": 4.0, "kind": "event", "name": "replay_score",
+            "leg": "autoscaled", "violation_s": 6.0,
+            "violation_minutes_modeled": 96.0, "wasted_replica_s": 30.0,
+            "wasted_replica_hours_modeled": 8.0, "flaps": 0,
+        },
+    ]
+    report = build_report(records, source="replay.jsonl")
+    cap = report["capacity"]
+    assert cap["decisions"] == 2 and cap["flaps"] == 0
+    assert set(cap["by_leg"]) == {"autoscaled", "chaos"}
+    assert cap["trace"]["n_arrivals"] == 100
+    assert cap["scores"][0]["leg"] == "autoscaled"
+    text = render(report, "text")
+    assert "capacity:" in text
+    assert "offered load:" in text
+    assert "flap count: 0" in text
+    assert "scale_out (rule knee_proximity, 1→2" in text
+    assert "score[autoscaled]" in text
+    md = render(report, "md")
+    assert "## Capacity" in md
+    # a stream with no capacity records omits the section entirely
+    empty = build_report([{"v": 13, "kind": "step", "ts": 1.0}], source="x")
+    assert empty["capacity"] is None
+    assert "capacity:" not in render(empty, "text")
