@@ -10,7 +10,7 @@ SHELL := /bin/bash
         audit-smoke overlap-smoke split-smoke tp-smoke recovery-smoke \
         diverge-smoke \
         aot-smoke serve-smoke chaos-smoke alerts-smoke fleet-smoke trace-smoke \
-        mpmd-smoke bench-mpmd replay-smoke \
+        mpmd-smoke bench-mpmd replay-smoke recompute-smoke \
         bench-serving bench-ckpt-aot data train train-mesh bench \
         bench-scaling schedules clean
 
@@ -661,6 +661,38 @@ mpmd-smoke:
 	    --format md > /tmp/msmoke/gpipe.report.md
 	grep -q "dispatch overhead" /tmp/msmoke/gpipe.report.md
 	@echo "mpmd-smoke OK: three schedules hash-equal to lockstep twins under --runtime mpmd --audit, deadlock proof consulted, per-stage census clean, dispatch-probe row rendered"
+
+# activation recompute end-to-end (docs/lowering.md "Recompute ticks"):
+# 1 CPU epoch each for gpipe-pp4 and the split-backward pipedream-pp4
+# with --recompute --audit vs their stashed twins — final hashes BITWISE
+# equal (recompute is a memory knob, not a numerics knob), census clean,
+# the pipeline_program record's measured stash peak strictly below the
+# stashed twin's, the tick-table lifetime proof re-run standalone, and
+# the report CLI's Memory section rendering the two peaks side by side
+recompute-smoke:
+	rm -rf /tmp/recsmoke; mkdir -p /tmp/recsmoke
+	python -c "import numpy as np; from pathlib import Path; d=Path('/tmp/recsmoke/data'); d.mkdir(parents=True); rng=np.random.RandomState(0); [(np.save(d/('x_'+s+'.npy'), rng.rand(n,784).astype(np.float32)), np.save(d/('y_'+s+'.npy'), np.eye(10,dtype=np.float32)[rng.randint(0,10,n)])) for s,n in (('train',256),('val',96))]"
+	set -e; for lay in gpipe pipedream; do \
+	  if [ $$lay = pipedream ]; then SPLIT="--backward-split"; else SPLIT=""; fi; \
+	  COMMON="--data-dir /tmp/recsmoke/data --epochs 1 --global-batch-size 32 --no-eval --pp 4 --mubatches 4 --schedule $$lay"; \
+	  $(CPU_MESH) python train.py $$COMMON $$SPLIT \
+	      > /tmp/recsmoke/$$lay.stashed.out; \
+	  $(CPU_MESH) python train.py $$COMMON $$SPLIT --recompute --audit \
+	      --metrics-out /tmp/recsmoke/$$lay.rec.jsonl \
+	      > /tmp/recsmoke/$$lay.rec.out; \
+	  st_h=$$(grep -o 'final model hash: [0-9a-f]*' /tmp/recsmoke/$$lay.stashed.out); \
+	  rec_h=$$(grep -o 'final model hash: [0-9a-f]*' /tmp/recsmoke/$$lay.rec.out); \
+	  test -n "$$st_h" && test "$$st_h" = "$$rec_h" \
+	      || { echo "$$lay: HASH MISMATCH recompute [$$rec_h] vs stashed [$$st_h]"; exit 1; }; \
+	  echo "$$lay: recompute hash == stashed twin hash"; \
+	  python -c "import json,sys; lay='$$lay'; recs=[json.loads(l) for l in open('/tmp/recsmoke/'+lay+'.rec.jsonl')]; a=[r for r in recs if r.get('kind')=='xla_audit']; assert a and all(r.get('census_ok') for r in a), lay+': census mismatch'; prog=[r for r in recs if r.get('kind')=='event' and r.get('name')=='pipeline_program'][-1]; assert prog['recompute'], lay+': program not recompute'; peak, twin = prog['stash_bytes_peak'], prog['stash_bytes_peak_stashed_twin']; assert peak < twin, lay+': stash peak %d not below stashed twin %d' % (peak, twin); print(lay+': census clean, stash peak %d B < stashed twin %d B (%.0f%% smaller)' % (peak, twin, 100*(1-peak/twin)))"; \
+	  python -m shallowspeed_tpu.observability.report \
+	      /tmp/recsmoke/$$lay.rec.jsonl --format md \
+	      > /tmp/recsmoke/$$lay.report.md; \
+	  grep -q "activation stash" /tmp/recsmoke/$$lay.report.md; \
+	done
+	python -c "from shallowspeed_tpu import schedules as S; from shallowspeed_tpu.parallel.lowering import lower_schedule; from shallowspeed_tpu.analysis.stash import assert_recompute_peak_drop; [print(n, assert_recompute_peak_drop(lower_schedule(c, 4, 4, backward_split=b), lower_schedule(c, 4, 4, backward_split=b, recompute=True))) for n, c, b in (('gpipe', S.GPipeSchedule, False), ('pipedream-split', S.PipeDreamFlushSchedule, True))]"
+	@echo "recompute-smoke OK: recompute hashes bitwise-equal to stashed twins on gpipe + split pipedream, census clean, measured stash peak strictly below the stashed twin's, Memory section rendered"
 
 # the MPMD-vs-lockstep scoreboard (same-window epoch pair, dispatch-probe
 # pair, serving burst p99) — writes MPMD_r01.json on the flagship data

@@ -40,9 +40,9 @@ from shallowspeed_tpu.api import (  # the reference's canonical config
 )
 
 
-def _data(nb, rng):
-    X = rng.rand(nb, B, SIZES[0]).astype(np.float32)
-    Y = np.eye(SIZES[-1], dtype=np.float32)[rng.randint(0, SIZES[-1], (nb, B))]
+def _data(nb, rng, sizes=SIZES):
+    X = rng.rand(nb, B, sizes[0]).astype(np.float32)
+    Y = np.eye(sizes[-1], dtype=np.float32)[rng.randint(0, sizes[-1], (nb, B))]
     return X, Y
 
 
@@ -54,7 +54,7 @@ def _data(nb, rng):
 SIZES16 = (784, 256, 224, 192, 176, 160, 144, 128, 112, 96, 80, 64, 48, 32, 16, 10)
 
 
-def bench_sequential(nb, reps, sizes=SIZES):
+def bench_sequential(nb, reps, sizes=SIZES, act="relu"):
     import jax
     import jax.numpy as jnp
 
@@ -62,10 +62,10 @@ def bench_sequential(nb, reps, sizes=SIZES):
     from shallowspeed_tpu import trainer
     from shallowspeed_tpu.optimizer import SGD
 
-    spec = Mo.make_model_spec(sizes, 1, B)
+    spec = Mo.make_model_spec(sizes, 1, B, act=act)
     params = jax.tree.map(jnp.asarray, Mo.init_model(spec))
     epoch = trainer.make_train_epoch(spec, SGD(LR))
-    X, Y = _data(nb, np.random.RandomState(0))
+    X, Y = _data(nb, np.random.RandomState(0), sizes=sizes)
     Xe = jnp.asarray(X.reshape(nb, M, B // M, -1))
     Ye = jnp.asarray(Y.reshape(nb, M, B // M, -1))
     st = ()
@@ -81,7 +81,7 @@ def bench_sequential(nb, reps, sizes=SIZES):
 def _pipeline_epoch_setup(
     dp, pp, sched_name, nb, virtual=1, sizes=SIZES, zero1=False,
     optimizer=None, grad_bucket_bytes=0, backward_split=False, tp=1,
-    digests=False,
+    digests=False, act="relu", recompute=False,
 ):
     """Build one mesh config's epoch fn + initial state + data: the shared
     setup behind the plain timing rows and the same-window pairs. Returns
@@ -96,11 +96,11 @@ def _pipeline_epoch_setup(
     from shallowspeed_tpu.parallel import lower_schedule, make_mesh
 
     mesh = make_mesh(dp, pp, tp=tp)
-    spec = Mo.make_model_spec(sizes, pp * virtual, B)
+    spec = Mo.make_model_spec(sizes, pp * virtual, B, act=act)
     order = E.interleave_order(pp * virtual, pp) if virtual > 1 else None
     prog = lower_schedule(
         S.SCHEDULES[sched_name], M, pp, virtual=virtual,
-        backward_split=backward_split,
+        backward_split=backward_split, recompute=recompute,
     )
     stacked, flags = E.init_stacked(spec, mesh, order=order)
     opt = make_optimizer(optimizer, 2e-4) if optimizer else SGD(LR)
@@ -109,7 +109,7 @@ def _pipeline_epoch_setup(
         grad_bucket_bytes=grad_bucket_bytes, with_digests=digests,
     )
     st = E.zero1_init_state(opt, spec, mesh) if zero1 else opt.init(stacked)
-    X, Y = _data(nb, np.random.RandomState(0))
+    X, Y = _data(nb, np.random.RandomState(0), sizes=sizes)
     return prog, epoch, stacked, flags, st, jnp.asarray(X), jnp.asarray(Y)
 
 
@@ -145,7 +145,7 @@ SYNC_PAIRS = [
 ]
 
 
-def bench_sync_pair(name, cfg, nb):
+def bench_sync_pair(name, cfg, nb, sizes=SIZES, act="relu", model=None):
     """One anchor-vs-bucketed pair, same-window: returns a list of record
     dicts (one per mode) carrying grad_bucket_bytes + bucket count so a
     MULTICHIP capture of these rows is self-describing."""
@@ -156,7 +156,7 @@ def bench_sync_pair(name, cfg, nb):
 
     dp, pp = cfg["dp"], cfg["pp"]
     zero1 = cfg.get("zero1", False)
-    spec = Mo.make_model_spec(SIZES, pp, B)
+    spec = Mo.make_model_spec(sizes, pp, B, act=act)
     plan = gradsync.plan_buckets(
         spec, dp, pp, GRAD_SYNC_BUCKET_BYTES, zero1=zero1
     )
@@ -164,7 +164,8 @@ def bench_sync_pair(name, cfg, nb):
     run_ks = {}
     for label, gbb in modes.items():
         _, epoch, stacked, flags, st, Xj, Yj = _pipeline_epoch_setup(
-            dp, pp, cfg["sched"], nb, zero1=zero1, grad_bucket_bytes=gbb
+            dp, pp, cfg["sched"], nb, zero1=zero1, grad_bucket_bytes=gbb,
+            sizes=sizes, act=act,
         )
 
         def epoch_fn(p, s, X, Y, _epoch=epoch, _flags=flags):
@@ -185,6 +186,7 @@ def bench_sync_pair(name, cfg, nb):
                 "config": label,
                 "devices": dp * pp,
                 "samples_per_sec": round(sps, 1),
+                "model": model,
                 "grad_bucket_bytes": gbb,
                 "grad_buckets": plan.num_buckets if gbb else 0,
                 "zero1": zero1,
@@ -262,7 +264,7 @@ TP_PAIRS = [
 ]
 
 
-def bench_tp_pair(name, cfg, nb):
+def bench_tp_pair(name, cfg, nb, sizes=SIZES, act="relu", model=None):
     """One sequential-vs-tp pair, same-window: returns a list of record
     dicts (one per mode) carrying tp + vs_seq + the mesh layout note."""
     import jax
@@ -278,10 +280,10 @@ def bench_tp_pair(name, cfg, nb):
     dp, pp, tp = cfg["dp"], cfg["pp"], cfg["tp"]
     run_ks = {}
     # sequential leg
-    spec1 = Mo.make_model_spec(SIZES, 1, B)
+    spec1 = Mo.make_model_spec(sizes, 1, B, act=act)
     params = jax.tree.map(jnp.asarray, Mo.init_model(spec1))
     seq_epoch = trainer.make_train_epoch(spec1, SGD(LR))
-    X, Y = _data(nb, np.random.RandomState(0))
+    X, Y = _data(nb, np.random.RandomState(0), sizes=sizes)
     Xe = jnp.asarray(X.reshape(nb, M, B // M, -1))
     Ye = jnp.asarray(Y.reshape(nb, M, B // M, -1))
 
@@ -293,7 +295,7 @@ def bench_tp_pair(name, cfg, nb):
     # records (deterministic — same device order as the setup's mesh)
     mesh_layout = make_mesh_with_layout(dp, pp, tp=tp)[1]
     _, epoch, stacked, flags, st, Xj, Yj = _pipeline_epoch_setup(
-        dp, pp, "gpipe", nb, tp=tp
+        dp, pp, "gpipe", nb, tp=tp, sizes=sizes, act=act,
     )
 
     def tp_fn(p, s, X_, Y_, _e=epoch, _f=flags):
@@ -313,6 +315,7 @@ def bench_tp_pair(name, cfg, nb):
                 "config": label,
                 "devices": devices,
                 "samples_per_sec": round(sps, 1),
+                "model": model,
                 "tp": tp_val,
                 "mesh_layout": mesh_layout if tp_val > 1 else None,
                 "same_window": True,
@@ -335,7 +338,7 @@ SPLIT_PAIRS = [
 ]
 
 
-def bench_split_pair(name, cfg, nb):
+def bench_split_pair(name, cfg, nb, sizes=SIZES, act="relu", model=None):
     """One unsplit-vs-split backward pair, same-window: returns a list of
     record dicts (one per mode) carrying backward_split + the lowered
     programs' weighted bubble fractions so a MULTICHIP capture of these
@@ -351,7 +354,7 @@ def bench_split_pair(name, cfg, nb):
         # the setup's own lowered program feeds the recorded metric, so
         # the weighted bubble always describes the program being timed
         prog, epoch, stacked, flags, st, Xj, Yj = _pipeline_epoch_setup(
-            dp, pp, cfg["sched"], nb, backward_split=bs
+            dp, pp, cfg["sched"], nb, backward_split=bs, sizes=sizes, act=act,
         )
         wbubble[label] = round(1.0 - weighted_utilization(prog), 4)
 
@@ -369,6 +372,7 @@ def bench_split_pair(name, cfg, nb):
                 "config": label,
                 "devices": dp * pp,
                 "samples_per_sec": round(sps, 1),
+                "model": model,
                 "backward_split": bs,
                 "weighted_bubble_fraction": wbubble[label],
                 "same_window": True,
@@ -376,6 +380,132 @@ def bench_split_pair(name, cfg, nb):
             }
         )
     return records
+
+
+# stashed-vs-recompute pairs at pp4: same-window via the interleaved-trial
+# slope protocol. Recompute trades the residual-stash footprint for a
+# ~4/3 forward-FLOP tax (docs/lowering.md § Recompute ticks) — on a
+# compute-bound model the tax should be VISIBLE here (vs_stashed < 1),
+# which is the honest direction: this pair measures what recompute costs,
+# the stash-peak fields record what it buys.
+RECOMPUTE_PAIRS = [
+    ("pp4-gpipe-recompute", dict(dp=1, pp=4, sched="gpipe")),
+]
+
+
+def bench_recompute_pair(name, cfg, nb, sizes=SIZES, act="relu", model=None):
+    """One stashed-vs-recompute pair, same-window: returns a list of
+    record dicts (one per mode) carrying the recompute flag, the lowered
+    programs' stash peaks (the memory the tax buys back), and vs_stashed."""
+    from bench import make_run_k, slope_epoch_seconds_many
+
+    dp, pp = cfg["dp"], cfg["pp"]
+    modes = {f"{name}-stashed": False, f"{name}-on": True}
+    run_ks, peaks = {}, {}
+    for label, rec in modes.items():
+        prog, epoch, stacked, flags, st, Xj, Yj = _pipeline_epoch_setup(
+            dp, pp, cfg["sched"], nb, sizes=sizes, act=act, recompute=rec,
+        )
+        peaks[label] = {
+            "stash_slots": int(prog.n_stash_slots),
+            "xin_slots": int(prog.n_xin_slots),
+        }
+
+        def epoch_fn(p, s, X, Y, _epoch=epoch, _flags=flags):
+            return _epoch(p, _flags, s, X, Y)
+
+        run_ks[label] = make_run_k(epoch_fn, stacked, st, Xj, Yj)
+    slopes = slope_epoch_seconds_many(run_ks, k1=1, k2=3, trials=2, min_delta_s=0)
+    stashed_sps = nb * B / slopes[f"{name}-stashed"]
+    records = []
+    for label, rec in modes.items():
+        sps = nb * B / slopes[label]
+        records.append(
+            {
+                "config": label,
+                "devices": dp * pp,
+                "samples_per_sec": round(sps, 1),
+                "model": model,
+                "recompute": rec,
+                **peaks[label],
+                "same_window": True,
+                "vs_stashed": round(sps / stashed_sps, 4),
+            }
+        )
+    return records
+
+
+# lockstep-vs-MPMD runtime pairs: same-window via the interleaved-trial
+# slope protocol (the MPMD runner's ``run`` is epoch-shaped with the
+# lockstep signature, so both legs time the identical loop). The MPMD
+# per-stage runtime removes the lockstep lax.switch op-issue wall; on a
+# dispatch-bound toy MLP that win was masked by the runtime's own host
+# cost (MPMD_r01.json: 0.86x) — a compute-bound model is where it gets
+# to show, or where the refutation earns its caveat.
+MPMD_PAIRS = [
+    ("pp4-gpipe-mpmd", dict(dp=1, pp=4, sched="gpipe")),
+]
+
+
+def bench_mpmd_pair(name, cfg, nb, sizes=SIZES, act="relu", model=None):
+    """One lockstep-vs-MPMD runtime pair, same-window: returns a list of
+    record dicts (one per mode) carrying runtime + vs_lockstep."""
+    from bench import make_run_k, slope_epoch_seconds_many
+
+    from shallowspeed_tpu.optimizer import SGD
+    from shallowspeed_tpu.parallel import mpmd
+
+    dp, pp = cfg["dp"], cfg["pp"]
+    prog, epoch, stacked, flags, st, Xj, Yj = _pipeline_epoch_setup(
+        dp, pp, cfg["sched"], nb, sizes=sizes, act=act,
+    )
+
+    def lockstep_fn(p, s, X, Y, _epoch=epoch, _flags=flags):
+        return _epoch(p, _flags, s, X, Y)
+
+    # the MPMD leg drives the SAME lowered program through the per-stage
+    # runtime — with its OWN param/state buffers: the lockstep epoch
+    # donates its inputs, so sharing one stacked tree across legs would
+    # hand the runner deleted arrays
+    from shallowspeed_tpu.parallel import make_mesh
+
+    _, _, stacked2, flags2, st2, _, _ = _pipeline_epoch_setup(
+        dp, pp, cfg["sched"], nb, sizes=sizes, act=act,
+    )
+    mesh = make_mesh(dp, pp)
+    runner = mpmd.MpmdTrainRunner(mesh, _mpmd_spec(sizes, pp, act), prog,
+                                  B // dp // M, SGD(LR))
+
+    def mpmd_fn(p, s, X, Y, _r=runner, _flags=flags2):
+        return _r.run(p, _flags, s, X, Y)
+
+    run_ks = {
+        f"{name}-lockstep": make_run_k(lockstep_fn, stacked, st, Xj, Yj),
+        f"{name}-mpmd": make_run_k(mpmd_fn, stacked2, st2, Xj, Yj),
+    }
+    slopes = slope_epoch_seconds_many(run_ks, k1=1, k2=3, trials=2, min_delta_s=0)
+    lockstep_sps = nb * B / slopes[f"{name}-lockstep"]
+    records = []
+    for label, rt in ((f"{name}-lockstep", "lockstep"), (f"{name}-mpmd", "mpmd")):
+        sps = nb * B / slopes[label]
+        records.append(
+            {
+                "config": label,
+                "devices": dp * pp,
+                "samples_per_sec": round(sps, 1),
+                "model": model,
+                "runtime": rt,
+                "same_window": True,
+                "vs_lockstep": round(sps / lockstep_sps, 4),
+            }
+        )
+    return records
+
+
+def _mpmd_spec(sizes, pp, act):
+    from shallowspeed_tpu import model as Mo
+
+    return Mo.make_model_spec(sizes, pp, B, act=act)
 
 
 CONFIGS = [
@@ -397,85 +527,206 @@ CONFIGS = [
 ]
 
 
+def bench_dispatch_probe(nb, sizes, act, model):
+    """The measured op-issue share on this model (train.py
+    --dispatch-probe's machinery, bounded window): the number that says
+    whether a bench row on THIS model is compute- or dispatch-bound —
+    the compute-bound zoo exists so this drops below the toy MLP's
+    ~0.7."""
+    import tempfile
+
+    from shallowspeed_tpu.api import TrainingSession
+
+    with tempfile.TemporaryDirectory() as td:
+        rng = np.random.RandomState(0)
+        X, Y = _data(nb, rng, sizes=sizes)
+        np.save(Path(td) / "x_train.npy", X.reshape(-1, sizes[0]))
+        np.save(Path(td) / "y_train.npy", Y.reshape(-1, sizes[-1]))
+        np.save(Path(td) / "x_val.npy", X[0])
+        np.save(Path(td) / "y_val.npy", Y[0])
+        s = TrainingSession(
+            model=model, dp=1, pp=4, schedule="gpipe",
+            global_batch_size=B, mubatches=M, data_dir=td,
+        )
+        rec = s.measure_dispatch_overhead(repeats=2)
+    keep = (
+        "dispatch_overhead", "dispatch_overhead_instrumented",
+        "host_wall_s", "device_busy_s", "op_events", "op_source",
+        "profiler_inflation", "batches_per_epoch", "events_per_batch",
+        "window_valid", "window_invalid_reason",
+    )
+    row = {k: rec.get(k) for k in keep if rec.get(k) is not None}
+    row["config"] = "pp4-gpipe-dispatch-probe"
+    row["model"] = model
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", type=int, default=64, help="batches per rep")
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument(
+        "--model", default=None,
+        help="model-zoo config (model.MODEL_ZOO) to bench instead of the "
+        "flagship toy MLP: the compute-bound rows that unmask "
+        "dispatch-bound ratios (docs/performance.md). Rows record the "
+        "model name so captures stay self-describing.",
+    )
+    ap.add_argument(
+        "--pairs-only", action="store_true",
+        help="skip the plain throughput rows; run only the same-window "
+        "pairs (+ the dispatch probe when --model is set) — the "
+        "COMPUTE_r01.json protocol",
+    )
+    ap.add_argument(
+        "--out", default=None,
+        help="also write every emitted record into FILE as one JSON "
+        "document ({bench, model, records: [...]})",
+    )
     args = ap.parse_args()
+
+    act = "relu"
+    sizes = SIZES
+    if args.model:
+        from shallowspeed_tpu import model as Mo
+
+        sizes, act = Mo.resolve_model(args.model)
 
     import jax
 
     n_dev = len(jax.devices())
     results = {}
-    for name, cfg in CONFIGS:
-        dp, pp = cfg.get("dp", 1), cfg.get("pp", 1)
-        need = dp * pp
-        if need > n_dev:
-            print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
-            continue
-        sizes = cfg.get("sizes", SIZES)
-        if pp == 1 and dp == 1:
-            sps = bench_sequential(args.batches, args.reps, sizes=sizes)
-        else:
-            sps = bench_pipeline(
-                dp, pp, cfg["sched"], args.batches, args.reps,
-                virtual=cfg.get("virtual", 1), sizes=sizes,
-                zero1=cfg.get("zero1", False), optimizer=cfg.get("optimizer"),
+    emitted = []
+
+    def emit(rec):
+        emitted.append(rec)
+        print(json.dumps(rec))
+
+    if not args.pairs_only:
+        for name, cfg in CONFIGS:
+            dp, pp = cfg.get("dp", 1), cfg.get("pp", 1)
+            need = dp * pp
+            if need > n_dev:
+                emit({"config": name, "skipped": f"needs {need} devices, have {n_dev}"})
+                continue
+            if args.model and "sizes" in cfg:
+                continue  # the 16-size quirk rows only describe the toy MLP
+            row_sizes = cfg.get("sizes", sizes)
+            if pp == 1 and dp == 1:
+                sps = bench_sequential(
+                    args.batches, args.reps, sizes=row_sizes, act=act
+                )
+            else:
+                sps = bench_pipeline(
+                    dp, pp, cfg["sched"], args.batches, args.reps,
+                    virtual=cfg.get("virtual", 1), sizes=row_sizes,
+                    zero1=cfg.get("zero1", False), optimizer=cfg.get("optimizer"),
+                )
+            results[name] = sps
+            ref = "seq16" if row_sizes is SIZES16 else "seq"
+            eff = (
+                sps / (need * results[ref])
+                if ref in results and name != ref
+                else 1.0
             )
-        results[name] = sps
-        ref = "seq16" if sizes is not SIZES else "seq"
-        eff = (
-            sps / (need * results[ref])
-            if ref in results and name != ref
-            else 1.0
-        )
-        print(
-            json.dumps(
+            emit(
                 {
                     "config": name,
                     "devices": need,
                     "samples_per_sec": round(sps, 1),
+                    "model": args.model,
                     "efficiency_vs_seq": round(eff, 4),
                 }
             )
-        )
+
+    pair_kwargs = dict(sizes=sizes, act=act, model=args.model)
 
     # the anchor-vs-bucketed gradient-sync pairs (same-window per pair)
     for name, cfg in SYNC_PAIRS:
         need = cfg["dp"] * cfg["pp"]
         if need > n_dev:
-            print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
+            emit({"config": name, "skipped": f"needs {need} devices, have {n_dev}"})
             continue
-        for rec in bench_sync_pair(name, cfg, args.batches):
-            print(json.dumps(rec))
+        if args.pairs_only and cfg.get("zero1"):
+            continue  # COMPUTE protocol: the plain dp2 pair carries the story
+        for rec in bench_sync_pair(name, cfg, args.batches, **pair_kwargs):
+            emit(rec)
 
     # the unsplit-vs-split backward pairs (same-window per pair)
     for name, cfg in SPLIT_PAIRS:
         need = cfg["dp"] * cfg["pp"]
         if need > n_dev:
-            print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
+            emit({"config": name, "skipped": f"needs {need} devices, have {n_dev}"})
             continue
-        for rec in bench_split_pair(name, cfg, args.batches):
-            print(json.dumps(rec))
+        for rec in bench_split_pair(name, cfg, args.batches, **pair_kwargs):
+            emit(rec)
 
-    # the digests-off-vs-on pairs (same-window per pair): the measured
-    # on-path overhead of the numerics-provenance aux
-    for name, cfg in DIGEST_PAIRS:
-        need = cfg["dp"] * cfg["pp"]
-        if need > n_dev:
-            print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
-            continue
-        for rec in bench_digest_pair(name, cfg, args.batches):
-            print(json.dumps(rec))
+    if not args.pairs_only:
+        # the digests-off-vs-on pairs (same-window per pair): the measured
+        # on-path overhead of the numerics-provenance aux
+        for name, cfg in DIGEST_PAIRS:
+            need = cfg["dp"] * cfg["pp"]
+            if need > n_dev:
+                emit({"config": name, "skipped": f"needs {need} devices, have {n_dev}"})
+                continue
+            for rec in bench_digest_pair(name, cfg, args.batches):
+                emit(rec)
 
     # the sequential-vs-tensor-parallel pairs (same-window per pair)
     for name, cfg in TP_PAIRS:
         need = cfg["dp"] * cfg["pp"] * cfg["tp"]
         if need > n_dev:
-            print(json.dumps({"config": name, "skipped": f"needs {need} devices, have {n_dev}"}))
+            emit({"config": name, "skipped": f"needs {need} devices, have {n_dev}"})
             continue
-        for rec in bench_tp_pair(name, cfg, args.batches):
-            print(json.dumps(rec))
+        if args.pairs_only and cfg["dp"] > 1:
+            continue  # COMPUTE protocol: tp2-vs-seq is the story row
+        for rec in bench_tp_pair(name, cfg, args.batches, **pair_kwargs):
+            emit(rec)
+
+    # the stashed-vs-recompute pairs (same-window per pair)
+    for name, cfg in RECOMPUTE_PAIRS:
+        need = cfg["dp"] * cfg["pp"]
+        if need > n_dev:
+            emit({"config": name, "skipped": f"needs {need} devices, have {n_dev}"})
+            continue
+        for rec in bench_recompute_pair(name, cfg, args.batches, **pair_kwargs):
+            emit(rec)
+
+    # the lockstep-vs-MPMD runtime pairs (same-window per pair)
+    for name, cfg in MPMD_PAIRS:
+        need = cfg["dp"] * cfg["pp"]
+        if need > n_dev:
+            emit({"config": name, "skipped": f"needs {need} devices, have {n_dev}"})
+            continue
+        for rec in bench_mpmd_pair(name, cfg, args.batches, **pair_kwargs):
+            emit(rec)
+
+    if args.model:
+        emit(bench_dispatch_probe(args.batches, sizes, act, args.model))
+
+    if args.out:
+        Path(args.out).write_text(
+            json.dumps(
+                {
+                    "bench": "scaling",
+                    "model": args.model,
+                    "act": act,
+                    "sizes": list(sizes),
+                    "batches": args.batches,
+                    "platform": jax.devices()[0].platform,
+                    "n_devices": n_dev,
+                    "cpu_fallback_caveat": (
+                        "emulated CPU devices on one shared host core: "
+                        "machinery + relative ratios, not chip performance"
+                        if jax.devices()[0].platform == "cpu"
+                        else None
+                    ),
+                    "records": emitted,
+                },
+                indent=1,
+            )
+            + "\n"
+        )
 
 
 if __name__ == "__main__":

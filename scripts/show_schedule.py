@@ -12,11 +12,16 @@ executor will run — forward cells, backward cells, and the bubbles.
 Legend: F<m> forward of microbatch m · B<m> combined backward · b<m>
 backward-input (split: the relay-critical dgrad half) · W<m>
 backward-weight (split: the deferred wgrad half, packed into bubbles) ·
-'.' bubble (noop tick).
+r<m> recompute (``--recompute``: the stage forward re-run at the
+backward boundary, arXiv 2004.09910 — the residual stash shrinks to one
+slot, paid for with the extra forward tick) · '.' bubble (noop tick).
 
 Each diagram prints BOTH utilizations: equal-weight (active cells / all
 cells) and FLOP-weighted (a combined backward cell is 2x a forward's work;
 the split halves are 1x each — the metric that can see the split win).
+``--model`` resolves a model-zoo config and prints its per-stage stash
+footprint under the diagram, so the recompute trade is visible in bytes,
+not just cells.
 """
 
 import argparse
@@ -30,7 +35,9 @@ from shallowspeed_tpu.parallel.lowering import (  # noqa: E402
     OP_BWD,
     OP_BWD_W,
     OP_FWD,
+    OP_RECOMPUTE,
     lower_schedule,
+    program_stats,
     utilization,
     weighted_utilization,
 )
@@ -38,9 +45,11 @@ from shallowspeed_tpu.parallel.lowering import (  # noqa: E402
 ALL = {**S.SCHEDULES, "inference": S.InferenceSchedule}
 
 
-def render(name, M, stages, virtual=1, backward_split=False):
+def render(name, M, stages, virtual=1, backward_split=False,
+           recompute=False, model=None):
     prog = lower_schedule(
-        ALL[name], M, stages, virtual=virtual, backward_split=backward_split
+        ALL[name], M, stages, virtual=virtual, backward_split=backward_split,
+        recompute=recompute,
     )
     # interleaved cells carry the virtual chunk as a suffix: F2'1 = forward
     # of microbatch 2, chunk 1
@@ -59,6 +68,8 @@ def render(name, M, stages, virtual=1, backward_split=False):
                 cells.append(f"{tag}{mb}{ck}".ljust(width))
             elif op == OP_BWD_W:
                 cells.append(f"W{mb}{ck}".ljust(width))
+            elif op == OP_RECOMPUTE:
+                cells.append(f"r{mb}{ck}".ljust(width))
             else:
                 cells.append(".".ljust(width))
         lines.append(f"stage {s} │ " + " ".join(cells))
@@ -66,8 +77,9 @@ def render(name, M, stages, virtual=1, backward_split=False):
     wutil = weighted_utilization(prog)
     vtag = f" V={virtual}" if virtual > 1 else ""
     stag = " split-bwd" if prog.backward_split else ""
+    rtag = " recompute" if prog.recompute else ""
     header = (
-        f"{name}{stag}  M={M} S={stages}{vtag}: {prog.num_ticks} ticks, "
+        f"{name}{stag}{rtag}  M={M} S={stages}{vtag}: {prog.num_ticks} ticks, "
         f"utilization {util * 100:.0f}% (bubbles {100 - util * 100:.0f}%) · "
         f"weighted {wutil * 100:.0f}% (bubbles {100 - wutil * 100:.0f}%)"
     )
@@ -77,6 +89,32 @@ def render(name, M, stages, virtual=1, backward_split=False):
     print(tick_hdr)
     for line in lines:
         print(line)
+    if prog.recompute or model:
+        # the stash story in slots (and, with --model, bytes from the
+        # real spec's padded slot shapes): what the r<m> cells buy
+        parts = [
+            f"stash: {prog.n_stash_slots} residual slot(s)"
+            + (f" + {prog.n_xin_slots} input slot(s)" if prog.recompute else "")
+        ]
+        if model:
+            from shallowspeed_tpu import model as Mo
+            from shallowspeed_tpu.api import FLAGSHIP_BATCH
+            from shallowspeed_tpu.observability.program_audit import (
+                format_bytes,
+            )
+
+            sizes, act = Mo.resolve_model(model)
+            spec = Mo.make_model_spec(
+                sizes, stages * virtual, FLAGSHIP_BATCH, act=act
+            )
+            stats = program_stats(
+                prog, spec=spec, mubatch_size=FLAGSHIP_BATCH // M
+            )
+            parts.append(
+                f"peak {format_bytes(stats['stash_bytes_peak'])}/device "
+                f"[{model}, B={FLAGSHIP_BATCH}]"
+            )
+        print("  ".join(parts))
     print()
 
 
@@ -94,6 +132,17 @@ def main():
         help="render the two-stage backward variant: b<m> = B-input at the "
         "combined backward's tick, W<m> = deferred B-weight packed into "
         "bubbles (gpipe/pipedream/naive)",
+    )
+    ap.add_argument(
+        "--recompute", action="store_true",
+        help="render the activation-recompute variant: r<m> = the stage "
+        "forward re-run at microbatch m's backward boundary (the residual "
+        "stash shrinks to 1 slot; gpipe/pipedream/naive)",
+    )
+    ap.add_argument(
+        "--model", default=None,
+        help="model-zoo config (model.MODEL_ZOO): print the rendered "
+        "program's peak stash bytes for this model under the diagram",
     )
     ap.add_argument(
         "--all",
@@ -120,14 +169,20 @@ def main():
                 f"M={args.mubatches}, S={args.stages})\n"
             )
             continue
-        # split applies to the flat training schedules only (the inference
-        # relay has no backward; interleaved is lowering-rejected)
+        # split/recompute apply to the flat training schedules only (the
+        # inference relay has no backward; interleaved is lowering-rejected)
         split = args.backward_split and name not in ("interleaved", "inference")
         if args.backward_split and name in ("interleaved", "inference"):
             if args.schedule == name:
                 raise SystemExit(f"--backward-split does not apply to {name}")
             print(f"{name}  (rendered without --backward-split)\n")
-        render(name, args.mubatches, args.stages, virtual=v, backward_split=split)
+        rec = args.recompute and name not in ("interleaved", "inference")
+        if args.recompute and name in ("interleaved", "inference"):
+            if args.schedule == name:
+                raise SystemExit(f"--recompute does not apply to {name}")
+            print(f"{name}  (rendered without --recompute)\n")
+        render(name, args.mubatches, args.stages, virtual=v,
+               backward_split=split, recompute=rec, model=args.model)
 
 
 if __name__ == "__main__":

@@ -18,6 +18,14 @@ buffer shapes assume:
   the allocated depth — the buffers are sized to the schedule's true
   pressure, neither torn (too small) nor quietly padded (too large).
 
+Recompute programs add the stage-input (xin) stash — filled by forwards,
+freed by the matching OP_RECOMPUTE cell — replayed under the same
+discipline, and ``assert_recompute_peak_drop`` is the pass the smoke
+targets run: it proves, from the two programs' ACTUAL tick tables, that
+the recompute twin's activation-stash peak is strictly below the stashed
+twin's (or already at the floor of one live slot, where no schedule can
+go lower).
+
 Violations raise ``ProgramAnalysisError`` naming the tick, stage and
 slot. Inference programs (no stash tables in use) pass trivially with
 zeroed stats.
@@ -121,6 +129,20 @@ def check_stash_lifetime(prog):
             )
     else:
         stats["gstash"] = {"peak": 0, "writes": 0, "reads": 0, "peeks": 0}
+    stats["xin_slots"] = int(getattr(prog, "n_xin_slots", 0) or 0)
+    if getattr(prog, "recompute", False):
+        stats["xin"] = _check_one_stash(
+            prog, "recompute input stash", prog.xin_write, prog.xin_read,
+            None, int(prog.n_xin_slots),
+        )
+        if stats["xin"]["writes"] != stats["xin"]["reads"]:
+            raise ProgramAnalysisError(
+                "recompute input stash writes and reads disagree: "
+                f"{stats['xin']['writes']} forwards stashed vs "
+                f"{stats['xin']['reads']} recomputes freed"
+            )
+    else:
+        stats["xin"] = {"peak": 0, "writes": 0, "reads": 0, "peeks": 0}
     if stats["stash"]["writes"] != stats["stash"]["reads"]:
         raise ProgramAnalysisError(
             "activation stash writes and reads disagree: "
@@ -128,3 +150,49 @@ def check_stash_lifetime(prog):
             f"{stats['stash']['reads']} backwards freed"
         )
     return stats
+
+
+def assert_recompute_peak_drop(stashed_prog, rec_prog):
+    """Prove — from the two twins' ACTUAL tick tables, not their
+    allocation metadata — that recompute shortened the activation-stash
+    lifetime: the recompute program's measured peak of concurrently-live
+    residual-stash slots must be STRICTLY below the stashed twin's, or
+    already sit at the floor of one live slot (a schedule that never
+    holds more than one stash — the naive schedules — has nothing left
+    to reclaim; demanding a drop there would be dishonest). The grad
+    stash of split programs is held to the same bar. Returns the
+    comparison dict the smoke target prints."""
+    if not getattr(rec_prog, "recompute", False):
+        raise ProgramAnalysisError(
+            "assert_recompute_peak_drop: second program is not a"
+            " recompute program"
+        )
+    if getattr(stashed_prog, "recompute", False):
+        raise ProgramAnalysisError(
+            "assert_recompute_peak_drop: first program must be the"
+            " stashed twin"
+        )
+    s0 = check_stash_lifetime(stashed_prog)
+    s1 = check_stash_lifetime(rec_prog)
+    out = {
+        "stash_peak_stashed": s0["stash"]["peak"],
+        "stash_peak_recompute": s1["stash"]["peak"],
+        "gstash_peak_stashed": s0["gstash"]["peak"],
+        "gstash_peak_recompute": s1["gstash"]["peak"],
+        "xin_peak": s1["xin"]["peak"],
+    }
+    for name in ("stash", "gstash"):
+        p0, p1 = s0[name]["peak"], s1[name]["peak"]
+        if p0 == 0:
+            continue  # e.g. no grad stash in combined-backward programs
+        if p0 > 1 and not p1 < p0:
+            raise ProgramAnalysisError(
+                f"recompute did not shorten the {name} lifetime: peak"
+                f" {p1} is not strictly below the stashed twin's {p0}"
+            )
+        if p0 == 1 and p1 != 1:
+            raise ProgramAnalysisError(
+                f"{name} peak {p1} regressed from the stashed twin's"
+                " floor of 1 live slot"
+            )
+    return out

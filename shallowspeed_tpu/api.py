@@ -86,6 +86,7 @@ class TrainingSession:
     def __init__(
         self,
         sizes=FLAGSHIP_SIZES,
+        model=None,
         dp=1,
         pp=1,
         tp=1,
@@ -104,6 +105,7 @@ class TrainingSession:
         zero1=False,
         grad_bucket_bytes=0,
         backward_split=False,
+        recompute=False,
         scan_unroll=1,
         tick_unroll=1,
         weight_decay=0.0,
@@ -157,6 +159,19 @@ class TrainingSession:
         # the epoch's update has been applied (the monitor observes the
         # fused program's outputs, it cannot unwind them).
         self._health = make_monitor(health)
+        # model zoo (model.MODEL_ZOO / train.py --model): a named
+        # compute-bound configuration — (sizes, activation family) — that
+        # overrides ``sizes``. The family is STATIC program structure
+        # (relu traces the historical expressions byte-identically; the
+        # gelu family adds residual slots and f32 grad-multiplier masks),
+        # and every zoo model keeps the 784-wide MNIST input so the data
+        # pipeline, checkpoints and serving slots compose unchanged.
+        self.model_name = model
+        if model is not None:
+            sizes, act = Mo.resolve_model(model)
+        else:
+            act = "relu"
+        self._act = act
         if global_batch_size % dp != 0:
             raise ValueError("global batch size must be divisible by dp")
         local_batch = global_batch_size // dp
@@ -222,6 +237,12 @@ class TrainingSession:
         self.V = virtual_stages
         self._sequential = dp == 1 and pp == 1 and virtual_stages == 1 and tp == 1
         self._kernel_backend = kernel_backend
+        if kernel_backend == "pallas" and act != "relu":
+            raise ValueError(
+                "kernel_backend='pallas' hard-codes the relu/identity slot "
+                "expressions; the gelu-family models (f32 grad-multiplier "
+                "masks, residual adds) run the XLA backend only"
+            )
         if kernel_backend == "pallas" and tp > 1:
             raise ValueError(
                 "tensor parallelism (tp > 1) shards each slot's W across "
@@ -275,6 +296,34 @@ class TrainingSession:
                 raise ValueError(
                     "backward_split needs the XLA per-slot backward; the "
                     "fused pallas flag kernel has no split halves"
+                )
+        # activation recompute (docs/lowering.md "Recompute ticks"): drop
+        # the forward's activation stashes, keep only the stage INPUT, and
+        # re-run the stage forward inside the backward tick (OP_RECOMPUTE)
+        # — a memory-for-FLOPs trade that shortens the stash lifetime from
+        # fwd->bwd to recompute->bwd (arXiv 2004.09910's checkpointing,
+        # tick-table form). Bitwise-identical training: the recompute
+        # re-traces the character-identical forward expressions.
+        self._recompute = bool(recompute)
+        if self._recompute:
+            if self._sequential:
+                raise ValueError(
+                    "recompute drops pipeline activation stashes and "
+                    "re-runs the stage forward at the backward tick; the "
+                    "sequential path holds no cross-tick stash — use "
+                    "dp/pp > 1"
+                )
+            if virtual_stages > 1:
+                raise ValueError(
+                    "recompute is not supported with interleaved virtual "
+                    "stages (the chunked stash rotation is its own "
+                    "lifetime discipline; recomputing it is future work)"
+                )
+            if kernel_backend == "pallas":
+                raise ValueError(
+                    "recompute re-runs the XLA per-slot forward inside "
+                    "the backward tick; the fused pallas flag kernel has "
+                    "no recompute branch"
                 )
         # pipeline runtime (docs/performance.md "The MPMD runtime"):
         # "lockstep" is the historical ONE-SPMD-program executor (the
@@ -447,7 +496,7 @@ class TrainingSession:
         self.batches_per_epoch = nb
 
         n_model_stages = pp * virtual_stages
-        self.spec = Mo.make_model_spec(sizes, n_model_stages, self.B)
+        self.spec = Mo.make_model_spec(sizes, n_model_stages, self.B, act=act)
         # device-major stage placement for virtual chunks (identity otherwise)
         self._order = (
             E.interleave_order(n_model_stages, pp) if virtual_stages > 1 else None
@@ -533,6 +582,13 @@ class TrainingSession:
                 raise ValueError(
                     f"checkpoint sizes {loaded_spec.sizes} do not match the "
                     f"requested model sizes {self.spec.sizes}"
+                )
+            if getattr(loaded_spec, "act", "relu") != self.spec.act:
+                raise ValueError(
+                    f"checkpoint activation family "
+                    f"{getattr(loaded_spec, 'act', 'relu')!r} does not match "
+                    f"the requested model's {self.spec.act!r} — the family "
+                    f"is program structure, not a runtime knob"
                 )
             saved_opt = meta.get("extra", {}).get("optimizer")
             if saved_opt is not None:
@@ -638,13 +694,14 @@ class TrainingSession:
             # (nan/flip) must fire again or the divergence won't reproduce
             self._metrics.event(
                 "digest_config",
-                sizes=list(sizes), dp=dp, pp=pp, tp=self.tp,
+                sizes=list(sizes), model=model, dp=dp, pp=pp, tp=self.tp,
                 schedule=schedule, global_batch_size=global_batch_size,
                 mubatches=mubatches, lr=lr, precision=precision,
                 optimizer=optimizer, momentum=momentum,
                 virtual_stages=virtual_stages, zero1=zero1,
                 grad_bucket_bytes=grad_bucket_bytes,
-                backward_split=backward_split, scan_unroll=scan_unroll,
+                backward_split=backward_split, recompute=recompute,
+                scan_unroll=scan_unroll,
                 tick_unroll=tick_unroll, weight_decay=weight_decay,
                 clip_norm=clip_norm, fuse_mubatches=fuse_mubatches,
                 data_dir=None if data_dir is None else str(data_dir),
@@ -713,6 +770,7 @@ class TrainingSession:
                 prog = lower_schedule(
                     S.SCHEDULES[schedule], mubatches, pp, virtual=self.V,
                     backward_split=self._backward_split,
+                    recompute=self._recompute,
                 )
             if self._metrics.enabled or self._audit_strict:
                 # program-level static analysis at lowering time, BEFORE
@@ -726,11 +784,34 @@ class TrainingSession:
                 # per-tick program stats, recorded once at lowering time:
                 # the executor's runtime tick behaviour is fully determined
                 # by these static tables (ticks, sends, occupancy, bubble)
-                stats = program_stats(prog)
+                stats = program_stats(
+                    prog, spec=self.spec,
+                    mubatch_size=local_batch // mubatches, tp=self.tp,
+                )
+                if self._recompute:
+                    # the stashed twin's footprint, lowered alongside (pure
+                    # Python, no compile): the report CLI's Memory section
+                    # renders the two peaks side by side from ONE stream —
+                    # the saving is an artifact of both real tick tables,
+                    # not a formula
+                    twin = program_stats(
+                        lower_schedule(
+                            S.SCHEDULES[schedule], mubatches, pp,
+                            virtual=self.V,
+                            backward_split=self._backward_split,
+                            recompute=False,
+                        ),
+                        spec=self.spec,
+                        mubatch_size=local_batch // mubatches, tp=self.tp,
+                    )
+                    stats["stash_bytes_peak_stashed_twin"] = twin[
+                        "stash_bytes_peak"
+                    ]
+                    stats["stash_slots_stashed_twin"] = twin["stash_slots"]
                 self._metrics.event(
                     "pipeline_program",
                     schedule=schedule, dp=dp, pp=pp, tp=self.tp,
-                    virtual=self.V, **stats,
+                    virtual=self.V, model=self.model_name, **stats,
                 )
                 self._metrics.gauge(
                     "pipeline.bubble_fraction", stats["bubble_fraction"]
@@ -895,9 +976,9 @@ class TrainingSession:
         hash over the lowered StableHLO does the real invalidation work;
         this keeps distinct configurations from ever sharing a filename)."""
         return (
-            tuple(self.spec.sizes), self.dp, self.pp, self.tp, self.V,
-            self.schedule, self.B, self.M, self._precision_name,
-            self._kernel_backend, self._slot_rows,
+            tuple(self.spec.sizes), self._act, self.dp, self.pp, self.tp,
+            self.V, self.schedule, self.B, self.M, self._precision_name,
+            self._kernel_backend, self._slot_rows, self._recompute,
         )
 
     def _record_static_analysis(self, prog, program):
@@ -2520,6 +2601,13 @@ class TrainingSession:
                 f"checkpoint sizes {loaded_spec.sizes} do not match this "
                 f"session's model sizes {self.spec.sizes} — a hot reload "
                 "must preserve every compiled program's shapes"
+            )
+        if getattr(loaded_spec, "act", "relu") != self.spec.act:
+            raise ValueError(
+                f"checkpoint activation family "
+                f"{getattr(loaded_spec, 'act', 'relu')!r} does not match "
+                f"this session's {self.spec.act!r} — a hot reload must "
+                "preserve every compiled program's structure"
             )
         with self._metrics.span("device_put"):
             if self._sequential:

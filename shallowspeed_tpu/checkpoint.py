@@ -154,6 +154,7 @@ def build_snapshot(
     meta = {
         "format_version": FORMAT_VERSION,
         "sizes": list(spec.sizes),
+        "act": spec.act,
         "global_batch_size": spec.global_batch_size,
         "epoch": int(epoch),
         "step_in_epoch": None if step_in_epoch is None else int(step_in_epoch),
@@ -466,7 +467,11 @@ def assemble_checkpoint(
         ) from e
     if global_batch_size is None:
         global_batch_size = meta["global_batch_size"]
-    spec = make_model_spec(meta["sizes"], n_stages, global_batch_size)
+    # pre-zoo snapshots carry no "act": every one of them is a relu MLP
+    spec = make_model_spec(
+        meta["sizes"], n_stages, global_batch_size,
+        act=meta.get("act", "relu"),
+    )
     params_list = _partition(flat, spec)
     # shape sanity against the re-partitioned spec
     for sspec, layers in zip(spec.stages, params_list):

@@ -42,13 +42,24 @@ class StageSpec:
     """Static description of one pipeline stage (trace-time constant)."""
 
     local_sizes: tuple  # activation dims owned by this stage, len = n_linears+1
-    relu_flags: tuple  # per-Linear fused-ReLU flag
+    relu_flags: tuple  # per-Linear fused-activation flag (act names which one)
     has_head: bool  # softmax + MSE head lives on the last stage
     global_batch_size: int
+    act: str = "relu"  # activation family: "relu" (MLP) or "gelu" (block zoo)
+    residual_flags: tuple = ()  # per-Linear: output += the PREVIOUS Linear's
+    # input (the transformer-style skip over one up/down projection pair);
+    # () means no residuals (every relu-family spec)
 
     @property
     def n_linears(self):
         return len(self.local_sizes) - 1
+
+    @property
+    def res_flags(self):
+        """residual_flags normalized to one bool per Linear."""
+        if len(self.residual_flags) == self.n_linears:
+            return self.residual_flags
+        return (False,) * self.n_linears
 
     @property
     def in_dim(self):
@@ -68,6 +79,7 @@ class ModelSpec:
     n_stages: int
     global_batch_size: int
     stages: tuple  # tuple[StageSpec]
+    act: str = "relu"
 
     @property
     def in_dim(self):
@@ -76,6 +88,10 @@ class ModelSpec:
     @property
     def out_dim(self):
         return self.sizes[-1]
+
+    @property
+    def has_residual(self):
+        return any(any(s.res_flags) for s in self.stages)
 
 
 def partition_sizes(sizes: Sequence[int], n_stages: int):
@@ -96,9 +112,23 @@ def partition_sizes(sizes: Sequence[int], n_stages: int):
     ]
 
 
-def make_model_spec(sizes, n_stages, global_batch_size) -> ModelSpec:
+def make_model_spec(sizes, n_stages, global_batch_size, act="relu") -> ModelSpec:
+    if act not in ("relu", "gelu"):
+        raise ValueError(f"unknown activation family {act!r} (relu|gelu)")
     locals_ = partition_sizes(sizes, n_stages)
-    if len(locals_[-1]) == 1:
+    stage_size = len(sizes) // n_stages
+    n_lin_total = len(sizes) - 1
+    if act == "gelu" and n_stages > 1 and stage_size % 2 != 0:
+        # the gelu family assigns activation/residual by GLOBAL Linear
+        # parity; an odd per-stage slice would flip local parity stage to
+        # stage, breaking the even/odd slot contract tp sharding and the
+        # stacked executor's static slot loop key off
+        raise ValueError(
+            f"gelu-family models need an even per-stage slice so local slot "
+            f"parity equals global Linear parity; len(sizes)={len(sizes)} "
+            f"over {n_stages} stages gives {stage_size}"
+        )
+    if act == "relu" and len(locals_[-1]) == 1:
         import warnings
 
         warnings.warn(
@@ -114,15 +144,36 @@ def make_model_spec(sizes, n_stages, global_batch_size) -> ModelSpec:
     for i, loc in enumerate(locals_):
         is_last = i == n_stages - 1
         n_lin = len(loc) - 1
-        relu_flags = tuple(
-            not (is_last and l == n_lin - 1) for l in range(n_lin)
-        )  # last Linear of last stage has no activation (layers.py:253-257)
+        if act == "relu":
+            # last Linear of last stage has no activation (layers.py:253-257)
+            act_flags = tuple(
+                not (is_last and l == n_lin - 1) for l in range(n_lin)
+            )
+            res_flags = ()
+        else:
+            # transformer-style block family: per global Linear index g,
+            # even g is the up-projection (gelu), odd g the down-projection
+            # (no activation) whose output takes the block-input residual
+            # whenever the dims agree; the GLOBAL final Linear feeds the
+            # softmax head raw
+            act_flags = []
+            res_flags = []
+            for l in range(n_lin):
+                g = i * stage_size + l
+                act_flags.append(g % 2 == 0 and g != n_lin_total - 1)
+                res_flags.append(
+                    g % 2 == 1 and sizes[g - 1] == sizes[g + 1]
+                )
+            act_flags = tuple(act_flags)
+            res_flags = tuple(res_flags)
         stages.append(
             StageSpec(
                 local_sizes=tuple(loc),
-                relu_flags=relu_flags,
+                relu_flags=act_flags,
                 has_head=is_last,
                 global_batch_size=global_batch_size,
+                act=act,
+                residual_flags=res_flags,
             )
         )
     return ModelSpec(
@@ -130,7 +181,44 @@ def make_model_spec(sizes, n_stages, global_batch_size) -> ModelSpec:
         n_stages=n_stages,
         global_batch_size=global_batch_size,
         stages=tuple(stages),
+        act=act,
     )
+
+
+# ---------------------------------------------------------------------------
+# Model zoo: named compute-bound configurations, all flowing through the
+# same ops/schedules/lowering/executor stack (docs/performance.md "--model").
+# ``mnist-mlp`` is the flagship reference model (api.FLAGSHIP_SIZES aliases
+# it); the others exist to make per-tick compute dominate dispatch on hosts
+# where the flagship epoch is op-issue-bound (DISPATCH_r01).
+# ---------------------------------------------------------------------------
+
+MODEL_ZOO = {
+    # the reference ShallowSpeed MNIST MLP (uneven stages at pp4 by design)
+    "mnist-mlp": dict(sizes=(784, 128, 127, 126, 125, 124, 123, 10), act="relu"),
+    # compute-bound MLP: ~10.5 MFLOP/sample forward+backward, same depth /
+    # pp divisibility as the flagship — the bench default for COMPUTE_r01
+    "mlp-wide": dict(sizes=(784, 512, 512, 512, 512, 512, 512, 10), act="relu"),
+    # showcase depth: 23 Linears x 2048 wide (~0.5 GFLOP/sample) — the
+    # stash-peak-bound regime where recompute pays (24 sizes: pp 2/3/4/6/8)
+    "mlp-deep": dict(sizes=(784,) + (2048,) * 22 + (10,), act="relu"),
+    # transformer-style blocks: 256-wide trunk, 1024-wide gelu up/down
+    # projections with residual adds on every dim-matched block
+    "transformer": dict(
+        sizes=(784, 1024, 256, 1024, 256, 1024, 256, 10), act="gelu"
+    ),
+}
+
+
+def resolve_model(name):
+    """MODEL_ZOO name -> (sizes, act)."""
+    try:
+        entry = MODEL_ZOO[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; zoo: {', '.join(sorted(MODEL_ZOO))}"
+        ) from None
+    return tuple(entry["sizes"]), entry["act"]
 
 
 def init_stage_params(spec: StageSpec):
@@ -177,17 +265,33 @@ def stage_forward(
     (layers.py:115-122,152-155,176-180) with caches made explicit.
     """
     caches = []
-    for l in range(spec.n_linears):
-        if spec.relu_flags[l]:
-            y, mask = ops.linear_relu_fused(
-                x, params[l]["W"], params[l]["b"], precision=precision
-            )
-            caches.append((x, mask))
-            x = y
-        else:
+    if spec.act == "gelu":
+        res = spec.res_flags
+        x_prev = None  # input of the PREVIOUS Linear (the block input)
+        for l in range(spec.n_linears):
             y = ops.linear(x, params[l]["W"], params[l]["b"], precision=precision)
-            caches.append((x, _placeholder(jnp.bool_)))
-            x = y
+            if spec.relu_flags[l]:
+                caches.append((x, ops.gelu_grad_mult(y)))
+                y_act = ops.gelu(y)
+            else:
+                caches.append((x, _placeholder()))
+                y_act = y
+            if res[l]:
+                y_act = y_act + x_prev
+            x_prev = x
+            x = y_act
+    else:
+        for l in range(spec.n_linears):
+            if spec.relu_flags[l]:
+                y, mask = ops.linear_relu_fused(
+                    x, params[l]["W"], params[l]["b"], precision=precision
+                )
+                caches.append((x, mask))
+                x = y
+            else:
+                y = ops.linear(x, params[l]["W"], params[l]["b"], precision=precision)
+                caches.append((x, _placeholder(jnp.bool_)))
+                x = y
     if spec.has_head:
         z = x
         out = ops.softmax(z, group_rows=head_group_rows)
@@ -218,15 +322,32 @@ def stage_backward(
     else:
         g = dout
     grads = [None] * spec.n_linears
-    for l in reversed(range(spec.n_linears)):
-        x_in, bitmask = caches[l]
-        if spec.relu_flags[l]:
-            g, dw, db = ops.linear_relu_grad_fused(
-                g, bitmask, x_in, params[l]["W"], precision=precision
+    if spec.act == "gelu":
+        res = spec.res_flags
+        g_prev = None  # incoming grad at the previously-processed Linear l+1
+        for l in reversed(range(spec.n_linears)):
+            x_in, dact = caches[l]
+            g_in = g
+            g_pre = g_in * dact if spec.relu_flags[l] else g_in
+            g, dw, db = ops.linear_grad(
+                g_pre, x_in, params[l]["W"], precision=precision
             )
-        else:
-            g, dw, db = ops.linear_grad(g, x_in, params[l]["W"], precision=precision)
-        grads[l] = {"W": dw, "b": jnp.reshape(db, (1, -1))}
+            if l + 1 < spec.n_linears and res[l + 1]:
+                # residual at l+1 adds this Linear's INPUT to y_{l+1}: the
+                # incoming grad there flows straight into dx here
+                g = g + g_prev
+            grads[l] = {"W": dw, "b": jnp.reshape(db, (1, -1))}
+            g_prev = g_in
+    else:
+        for l in reversed(range(spec.n_linears)):
+            x_in, bitmask = caches[l]
+            if spec.relu_flags[l]:
+                g, dw, db = ops.linear_relu_grad_fused(
+                    g, bitmask, x_in, params[l]["W"], precision=precision
+                )
+            else:
+                g, dw, db = ops.linear_grad(g, x_in, params[l]["W"], precision=precision)
+            grads[l] = {"W": dw, "b": jnp.reshape(db, (1, -1))}
     return g, grads
 
 

@@ -53,7 +53,12 @@ ENV_PEAK = "SHALLOWSPEED_PEAK_FLOPS"
 # the metric that can see the split-backward win (equal-weight utilization
 # counts a 4P backward cell and a 2P forward cell the same, so it scores a
 # schedule that splits backwards WORSE while the lockstep step time drops).
-PIPELINE_OP_COSTS = {"fwd": 1.0, "bwd": 2.0, "bwd_in": 1.0, "bwd_w": 1.0}
+PIPELINE_OP_COSTS = {
+    "fwd": 1.0, "bwd": 2.0, "bwd_in": 1.0, "bwd_w": 1.0,
+    # an OP_RECOMPUTE cell re-runs a full stage forward (torchgpipe
+    # trade): same 2P matmul work as a forward tick
+    "recompute": 1.0,
+}
 
 
 def mlp_train_flops_per_sample(sizes):

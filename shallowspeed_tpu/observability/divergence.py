@@ -268,6 +268,7 @@ def _session_from_config(cfg, resume_path):
     keep = ",".join(repr(f) for f in plan.faults if f.kind != "die")
     return TrainingSession(
         sizes=tuple(cfg["sizes"]),
+        model=cfg.get("model"),
         dp=cfg["dp"], pp=cfg["pp"], tp=cfg["tp"],
         schedule=cfg["schedule"],
         global_batch_size=cfg["global_batch_size"],
@@ -283,6 +284,7 @@ def _session_from_config(cfg, resume_path):
         zero1=cfg.get("zero1", False),
         grad_bucket_bytes=cfg.get("grad_bucket_bytes", 0),
         backward_split=cfg.get("backward_split", False),
+        recompute=cfg.get("recompute", False),
         scan_unroll=cfg.get("scan_unroll", 1),
         tick_unroll=cfg.get("tick_unroll", 1),
         weight_decay=cfg.get("weight_decay", 0.0),

@@ -513,7 +513,16 @@ def expected_comms(
 
             fwd_w, bwd_w = tp_allreduce_sites(spec, tp, training=not inference)
             cells = prog.num_chunks * prog.num_micro_batches
-            payload = 4 * mubatch_size * cells * (sum(fwd_w) + sum(bwd_w))
+            # activation recompute re-runs the whole stage forward inside
+            # the backward tick: every forward psum site fires TWICE per
+            # (chunk, microbatch) — the comms side of the recompute tax —
+            # and the OP_RECOMPUTE switch branch holds its own copy of the
+            # forward psum ops, raising the structural op-count floor
+            rec = bool(getattr(prog, "recompute", False))
+            fwd_passes = 2 if rec else 1
+            payload = 4 * mubatch_size * cells * (
+                fwd_passes * sum(fwd_w) + sum(bwd_w)
+            )
             axes["tp"] = {
                 "kind": "all_reduce",
                 "algorithm": "ring",
@@ -524,7 +533,9 @@ def expected_comms(
                 ],
                 "allreduce_bytes_per_device": int(payload),
                 "bytes_per_step_per_device": int(2 * (tp - 1) / tp * payload),
-                "hlo_min_all_reduce_ops": len(fwd_w) + len(bwd_w),
+                "hlo_min_all_reduce_ops": (
+                    fwd_passes * len(fwd_w) + len(bwd_w)
+                ),
             }
             required.append("all_reduce")
         if pp > 1:

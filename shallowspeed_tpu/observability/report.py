@@ -225,6 +225,26 @@ def build_report(records, source="", trace=None, slo_ms=None):
     # work, so equal-weight cells under-state heavy-tick bubbles
     weighted_bubble = prog.get("weighted_bubble_fraction") if prog else None
     backward_split = bool(prog.get("backward_split")) if prog else False
+    # the per-model activation-stash story (PR19): program_stats derives
+    # the peak from the real spec's padded slot shapes and the actual tick
+    # tables; a recompute run also carries its stashed twin's peak so the
+    # Memory section can render the saving side by side from ONE stream
+    stash_memory = None
+    if prog and prog.get("stash_bytes_peak") is not None:
+        stash_memory = {
+            "model": prog.get("model"),
+            "recompute": bool(prog.get("recompute")),
+            "stash_slots": prog.get("stash_slots"),
+            "xin_slots": prog.get("xin_slots"),
+            "grad_stash_slots": prog.get("grad_stash_slots"),
+            "stash_bytes_per_slot": prog.get("stash_bytes_per_slot"),
+            "xin_bytes_per_slot": prog.get("xin_bytes_per_slot"),
+            "stash_bytes_peak": prog.get("stash_bytes_peak"),
+            "stash_bytes_peak_stashed_twin": prog.get(
+                "stash_bytes_peak_stashed_twin"
+            ),
+            "stash_slots_stashed_twin": prog.get("stash_slots_stashed_twin"),
+        }
 
     findings = [r for r in records if r.get("kind") == "health"]
     halted = [f for f in findings if f.get("action") == "halt"]
@@ -296,6 +316,7 @@ def build_report(records, source="", trace=None, slo_ms=None):
         "bubble_fraction": bubble,
         "weighted_bubble_fraction": weighted_bubble,
         "backward_split": backward_split,
+        "stash_memory": stash_memory,
         "spans": span_rows,
         "steps": len(steps),
         "step_loss_sparkline": sparkline(step_losses) if steps else None,
@@ -1124,40 +1145,84 @@ def _fmt_time_s(t):
     return f"{t * 1e6:.1f} µs"
 
 
-def _memory_lines(audit, md):
+def _memory_lines(audit, md, stash=None):
     """The memory section: compiled-program peak HBM vs per-chip capacity
-    -> headroom, or an OOM forecast when the program does not fit."""
+    -> headroom, or an OOM forecast when the program does not fit — plus
+    the per-model activation-stash peak (PR19): a recompute run renders
+    its peak NEXT TO its stashed twin's (both from real tick tables), and
+    the OOM forecast says what the twin's extra stash would do to the
+    compiled peak."""
     mem = (audit or {}).get("memory")
-    if not mem:
+    if not mem and not stash:
         return []
     lines = ["## Memory (compiled program)" if md else "memory (compiled program):"]
-    peak = mem.get("peak_hbm_bytes")
-    cap = audit.get("hbm_per_chip")
-    head = audit.get("hbm_headroom_fraction")
-    # memory_analysis sizes are per device (the addressable shard), so the
-    # peak compares against one chip's capacity directly
-    line = f"peak HBM: {format_bytes(peak)} (per device)"
-    if cap and head is not None:
-        if head < 0:
-            line += (
-                f" — OOM FORECAST: exceeds the {format_bytes(cap)}/chip "
-                f"capacity ({audit.get('hbm_source')}) by "
-                f"{format_bytes(-head * cap)}"
+    peak = (mem or {}).get("peak_hbm_bytes")
+    if mem:
+        cap = audit.get("hbm_per_chip")
+        head = audit.get("hbm_headroom_fraction")
+        # memory_analysis sizes are per device (the addressable shard), so
+        # the peak compares against one chip's capacity directly
+        line = f"peak HBM: {format_bytes(peak)} (per device)"
+        if cap and head is not None:
+            if head < 0:
+                line += (
+                    f" — OOM FORECAST: exceeds the {format_bytes(cap)}/chip "
+                    f"capacity ({audit.get('hbm_source')}) by "
+                    f"{format_bytes(-head * cap)}"
+                )
+            else:
+                line += (
+                    f" of {format_bytes(cap)}/chip ({audit.get('hbm_source')}) "
+                    f"— {head * 100:.1f}% headroom"
+                )
+        lines.append(line)
+        lines.append(
+            "  args {a} + output {o} + temp {t} (aliased {al})".format(
+                a=format_bytes(mem.get("argument_size_in_bytes")),
+                o=format_bytes(mem.get("output_size_in_bytes")),
+                t=format_bytes(mem.get("temp_size_in_bytes")),
+                al=format_bytes(mem.get("alias_size_in_bytes")),
             )
-        else:
-            line += (
-                f" of {format_bytes(cap)}/chip ({audit.get('hbm_source')}) "
-                f"— {head * 100:.1f}% headroom"
-            )
-    lines.append(line)
-    lines.append(
-        "  args {a} + output {o} + temp {t} (aliased {al})".format(
-            a=format_bytes(mem.get("argument_size_in_bytes")),
-            o=format_bytes(mem.get("output_size_in_bytes")),
-            t=format_bytes(mem.get("temp_size_in_bytes")),
-            al=format_bytes(mem.get("alias_size_in_bytes")),
         )
-    )
+    if stash:
+        model = stash.get("model") or "mnist-mlp"
+        speak = stash.get("stash_bytes_peak")
+        if stash.get("recompute"):
+            twin = stash.get("stash_bytes_peak_stashed_twin")
+            line = (
+                f"activation stash [{model}]: peak {format_bytes(speak)}"
+                f"/device under recompute ({stash.get('stash_slots')} "
+                f"residual + {stash.get('xin_slots')} input slot(s)) vs "
+                f"{format_bytes(twin)} stashed twin "
+                f"({stash.get('stash_slots_stashed_twin')} slot(s))"
+            )
+            if twin and speak is not None and twin > 0:
+                line += f" — {(1 - speak / twin) * 100:.0f}% smaller"
+            lines.append(line)
+            cap = (audit or {}).get("hbm_per_chip")
+            if (
+                twin
+                and speak is not None
+                and _finite(peak)
+                and cap
+            ):
+                # what the stashed twin would cost THIS model on THIS
+                # chip: the compiled peak plus the stash delta, scored
+                # against capacity — the per-model OOM forecast
+                would = peak + (twin - speak)
+                frac = would / cap
+                lines.append(
+                    f"  stashed-twin forecast: peak HBM would be "
+                    f"{format_bytes(would)} ({frac * 100:.1f}% of "
+                    f"{format_bytes(cap)}/chip"
+                    + (" — OOM FORECAST)" if frac > 1 else ")")
+                )
+        else:
+            lines.append(
+                f"activation stash [{model}]: peak {format_bytes(speak)}"
+                f"/device ({stash.get('stash_slots')} slot(s), stashed — "
+                "rerun with --recompute to trade FLOPs for this footprint)"
+            )
     lines.append("")
     return lines
 
@@ -1850,7 +1915,11 @@ def render(report, fmt, comparison=None):
     lines.append("")
     lines.extend(_cost_lines(report["cost_model"]))
     lines.append("")
-    lines.extend(_memory_lines(report.get("xla_audit"), md))
+    lines.extend(
+        _memory_lines(
+            report.get("xla_audit"), md, stash=report.get("stash_memory")
+        )
+    )
     lines.extend(_comms_lines(report.get("xla_audit"), md))
     lines.extend(_reliability_lines(report.get("reliability"), md))
     lines.extend(_serving_lines(report.get("serving"), md))

@@ -70,6 +70,35 @@ def relu_grad(g, bitmask):
     return g * bitmask
 
 
+_INV_SQRT2 = 0.7071067811865476
+_INV_SQRT_2PI = 0.3989422804014327
+
+
+def gelu(x):
+    """Exact (erf) GELU: x * Phi(x) — the transformer-block activation of
+    the model zoo. gelu(0) == 0, so zero-padded rows and columns stay
+    exactly zero — the same padding invariant linear/relu keep, which the
+    stacked SPMD executor relies on."""
+    return 0.5 * x * (1.0 + lax.erf(x * _INV_SQRT2))
+
+
+def gelu_grad_mult(z):
+    """d gelu(z)/dz = Phi(z) + z * phi(z), from the pre-activation ``z``.
+
+    The gelu analogue of relu's cached bitmask: the backward multiplies the
+    incoming grad elementwise, ``g_eff = g * gelu_grad_mult(z)``. The value
+    at z == 0 is 0.5 (not 0), but padded positions carry g == 0, so nothing
+    leaks into padding.
+    """
+    phi = _INV_SQRT_2PI * jnp.exp(-0.5 * z * z)
+    return 0.5 * (1.0 + lax.erf(z * _INV_SQRT2)) + z * phi
+
+
+def gelu_grad(g, z):
+    """VJP of gelu given the cached pre-activation z."""
+    return g * gelu_grad_mult(z)
+
+
 def linear(x, w, b, precision=DEFAULT_PRECISION):
     """y = x @ w.T + b with w: (out, in), b: (1, out) or (out,).
 

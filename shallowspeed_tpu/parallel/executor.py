@@ -71,7 +71,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from shallowspeed_tpu import ops
 from shallowspeed_tpu.model import ModelSpec, init_model
 from shallowspeed_tpu.parallel.compat import shard_map
-from shallowspeed_tpu.parallel.lowering import OP_BWD, OP_BWD_W, OP_FWD, TickProgram
+from shallowspeed_tpu.parallel.lowering import (
+    OP_BWD,
+    OP_BWD_W,
+    OP_FWD,
+    OP_RECOMPUTE,
+    TickProgram,
+)
 from shallowspeed_tpu.parallel.mesh import mesh_tp
 
 
@@ -178,6 +184,34 @@ def tp_allreduce_sites(spec: ModelSpec, tp: int, training: bool = True):
     return fwd, bwd
 
 
+def stash_slot_nbytes(spec: ModelSpec, mubatch_size: int, tp: int = 1):
+    """Per-slot byte cost of each stash ring the executor carries, from the
+    real spec's padded slot shapes — the ONE sizing the observability layer
+    (``program_stats(spec=...)``, the report CLI's Memory section) uses to
+    turn lowering slot counts into HBM bytes. Returns a dict:
+
+    - ``"stash"``: one residual-stash slot — the per-slot activations
+      (``xs_widths``, f32), the backward multipliers (``mask_widths``;
+      1-byte bools for the relu family, f32 gelu-derivative values for the
+      gelu family) and the head-logit stash row (``D_out``, f32);
+    - ``"xin"``: one recompute input-stash slot (the stage input, f32);
+    - ``"gstash"``: one split grad-stash slot (per-slot effective
+      output-grads — f32 at the mask widths, because g_eff lives in the
+      same representation as its mask).
+    """
+    dims = slot_shapes(spec, tp)
+    _, _, xs_widths, mask_widths = tp_local_dims(dims, tp)
+    mask_bytes = 1 if spec.act == "relu" else 4
+    mb = mubatch_size
+    return {
+        "stash": 4 * mb * sum(xs_widths)
+        + mask_bytes * mb * sum(mask_widths)
+        + 4 * mb * dims[-1][0],
+        "xin": 4 * mb * dims[0][1],
+        "gstash": 4 * mb * sum(mask_widths),
+    }
+
+
 def relay_width(spec: ModelSpec) -> int:
     """True maximum inter-stage boundary width: the widest activation (and
     therefore activation-gradient) ever shipped over the ``pp`` axis.
@@ -208,7 +242,14 @@ def stack_params(params_list, spec: ModelSpec, order=None, tp: int = 1):
 
     Returns (stacked, flags):
       stacked = {"W": tuple_l of (S, out_l, in_l), "b": tuple_l of (S, out_l)}
-      flags   = {"active": (S,L), "relu": (S,L), "head_mask": (S, out_last)}
+      flags   = {"active": (S,L), "relu": (S,L), "residual": (S,L),
+                 "head_mask": (S, out_last)}
+
+    ``relu[r, l]`` is the stage's per-slot ACTIVATION flag (the key predates
+    the model zoo): apply the spec's activation family (relu or gelu) after
+    slot l. ``residual[r, l]`` marks the gelu family's residual adds
+    (y_l += x_{l-1}); always all-False for relu-family specs, whose traces
+    never read it.
     All numpy; device-put with ``put_stacked`` (P('pp') on the stage axis;
     per-slot column/row tp shards on a tp mesh). ``order[r]`` names the
     model stage stored at stacked row r (identity by default;
@@ -225,20 +266,28 @@ def stack_params(params_list, spec: ModelSpec, order=None, tp: int = 1):
     bs = [np.zeros((S, o), np.float32) for o, _ in dims]
     active = np.zeros((S, L), np.bool_)
     relu = np.zeros((S, L), np.bool_)
+    residual = np.zeros((S, L), np.bool_)
     head_mask = np.zeros((S, dims[-1][0]), np.bool_)
     for r, s in enumerate(order):
         sspec, sparams = spec.stages[s], params_list[s]
+        res_flags = sspec.res_flags
         for l, layer in enumerate(sparams):
             out_d, in_d = layer["W"].shape
             Ws[l][r, :out_d, :in_d] = np.asarray(layer["W"])
             bs[l][r, :out_d] = np.asarray(layer["b"]).reshape(-1)
             active[r, l] = True
             relu[r, l] = sspec.relu_flags[l]
+            residual[r, l] = res_flags[l]
         if sspec.has_head:
             head_mask[r, : sspec.out_dim] = True
     return (
         {"W": tuple(Ws), "b": tuple(bs)},
-        {"active": active, "relu": relu, "head_mask": head_mask},
+        {
+            "active": active,
+            "relu": relu,
+            "residual": residual,
+            "head_mask": head_mask,
+        },
     )
 
 
@@ -587,7 +636,10 @@ def _fit(a, width):
     return jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, width - cur)])
 
 
-def _stage_fwd(Ws, bs, active, relu, dims, x, precision, kernel_backend="xla"):
+def _stage_fwd(
+    Ws, bs, active, relu, dims, x, precision, kernel_backend="xla",
+    act="relu", residual=None,
+):
     """Forward through the per-slot stacks; returns (out, xs, masks) where
     xs[l]: (mb, in_l) and masks[l]: (mb, out_l).
 
@@ -595,8 +647,17 @@ def _stage_fwd(Ws, bs, active, relu, dims, x, precision, kernel_backend="xla"):
     (pallas_ops.linear_flag_fwd): the traced relu flag rides into the kernel
     as a scalar operand, so the chunk-uniform layer loop needs no static
     per-stage specialization. Same math (flag-selected relu on z = x@w.T+b,
-    mask = z > 0) either way."""
+    mask = z > 0) either way.
+
+    ``act`` is the spec's STATIC activation family: "relu" traces exactly
+    the historical program (bool bitmask residuals, no residual-add
+    expressions anywhere — byte-identical); "gelu" stores the f32
+    derivative ``gelu_grad_mult(z)`` in the mask slot (1.0 where the flag
+    is off) and adds the ``residual`` flags' skip connections
+    (y_l += x_{l-1}, exact under _fit because residual widths are equal by
+    spec construction and padding is exact zeros)."""
     xs, masks = [], []
+    x_prev = None
     for l, (o, i) in enumerate(dims):
         x_l = _fit(x, i)
         if kernel_backend == "pallas":
@@ -608,19 +669,39 @@ def _stage_fwd(Ws, bs, active, relu, dims, x, precision, kernel_backend="xla"):
             )
             xs.append(x_l)
             masks.append(mask_f > 0)
+        elif act == "gelu":
+            y = ops.linear(x_l, Ws[l], bs[l], precision=precision)
+            xs.append(x_l)
+            masks.append(jnp.where(relu[l], ops.gelu_grad_mult(y), 1.0))
+            y_act = jnp.where(relu[l], ops.gelu(y), y)
+            if l > 0:
+                y_act = y_act + jnp.where(
+                    residual[l], _fit(x_prev, o), 0.0
+                )
         else:
             y = ops.linear(x_l, Ws[l], bs[l], precision=precision)
             xs.append(x_l)
             masks.append(y > 0)
             y_act = jnp.where(relu[l], ops.relu(y), y)
+        x_prev = x_l
         x = jnp.where(active[l], y_act, _fit(x_l, o))
     return x, tuple(xs), tuple(masks)
 
 
-def _stage_bwd(Ws, active, relu, dims, xs, masks, g, precision, kernel_backend="xla"):
-    """Backward through the per-slot stacks; returns (dx, gWs, gbs)."""
+def _stage_bwd(
+    Ws, active, relu, dims, xs, masks, g, precision, kernel_backend="xla",
+    act="relu", residual=None,
+):
+    """Backward through the per-slot stacks; returns (dx, gWs, gbs).
+
+    Gelu family: ``masks`` carry the stashed f32 derivative values, so the
+    effective-grad expression is the SAME ``g * mask`` character string as
+    relu's; residual skip grads add the NEXT slot's incoming grad to this
+    slot's dx (x_{l-1} fed both linear l and the residual at slot l's
+    output)."""
     L = len(dims)
     gWs, gbs = [None] * L, [None] * L
+    g_prev = None
     for l in reversed(range(L)):
         o, i = dims[l]
         g_l = _fit(g, o)
@@ -635,13 +716,17 @@ def _stage_bwd(Ws, active, relu, dims, xs, masks, g, precision, kernel_backend="
         else:
             g_eff = jnp.where(relu[l], g_l * masks[l], g_l)
             dx, dw, db = ops.linear_grad(g_eff, xs[l], Ws[l], precision=precision)
+            if act == "gelu" and l + 1 < L:
+                dx = dx + jnp.where(residual[l + 1], _fit(g_prev, i), 0.0)
         gWs[l] = jnp.where(active[l], dw, 0.0)
         gbs[l] = jnp.where(active[l], db, 0.0)
         g = jnp.where(active[l], dx, _fit(g_l, i))
+        g_prev = g_l
     return g, tuple(gWs), tuple(gbs)
 
 
-def _stage_bwd_input(Ws, active, relu, dims, masks, g, precision):
+def _stage_bwd_input(Ws, active, relu, dims, masks, g, precision,
+                     act="relu", residual=None):
     """The relay-critical half of the split backward: the dgrad chain only.
 
     Returns ``(dx, g_effs)`` — the input gradient the upstream stage waits
@@ -651,16 +736,22 @@ def _stage_bwd_input(Ws, active, relu, dims, masks, g, precision):
     stashes them so the deferred B-weight never recomputes a dgrad matmul.
     Bit-parity: each slot's ``g_eff``/``dx`` expressions are character-
     identical to ``_stage_bwd``'s, so the downstream wgrads are too.
+    Residual skip grads (gelu family) ride the dx chain here too — they
+    never touch ``g_eff``, so the deferred B-weight is family-agnostic.
     """
     L = len(dims)
     g_effs = [None] * L
+    g_prev = None
     for l in reversed(range(L)):
         o, i = dims[l]
         g_l = _fit(g, o)
         g_eff = jnp.where(relu[l], g_l * masks[l], g_l)
         g_effs[l] = g_eff
         dx = ops.linear_grad_input(g_eff, Ws[l], precision=precision)
+        if act == "gelu" and l + 1 < L:
+            dx = dx + jnp.where(residual[l + 1], _fit(g_prev, i), 0.0)
         g = jnp.where(active[l], dx, _fit(g_l, i))
+        g_prev = g_l
     return g, tuple(g_effs)
 
 
@@ -722,7 +813,8 @@ def _tp_scatter(a_loc, t, full_w):
     return lax.dynamic_update_slice_in_dim(z, a_loc, t * a_loc.shape[-1], axis=-1)
 
 
-def _stage_fwd_tp(Ws, bs, active, relu, dims, x, precision, tp_idx, tp):
+def _stage_fwd_tp(Ws, bs, active, relu, dims, x, precision, tp_idx, tp,
+                  act="relu", residual=None):
     """Megatron-sharded forward through the per-slot stacks (tp > 1).
 
     Returns ``(out_full, xs, masks)``: the stage output completed to full
@@ -737,16 +829,30 @@ def _stage_fwd_tp(Ws, bs, active, relu, dims, x, precision, tp_idx, tp):
     passthrough takes the rank's shard of the fitted activation, an odd
     passthrough scatters the shard back to full width THROUGH the slot's
     own psum (the inactive branch rides the same collective — uniform
-    collectives, masked payloads, the executor's house idiom)."""
+    collectives, masked payloads, the executor's house idiom).
+
+    Gelu family (``act="gelu"``): the mask slots carry the f32 derivative
+    values in the same representation (sharded pre-activation at column
+    slots, full post-psum at row slots), and the ``residual`` skip adds
+    land at ROW slots only (the zoo's residual flags sit on odd global
+    parity, which even per-stage slices preserve locally) AFTER the slot's
+    psum — both operands are full-width there, so the add is replicated,
+    never collective-scaled."""
     L = len(dims)
     xs, masks = [], []
+    x_prev = None
     for l, (o, i) in enumerate(dims):
         if l % 2 == 0:  # column-parallel: full input, sharded output
             x_l = _fit(x, i)
             z_loc = ops.linear(x_l, Ws[l], bs[l], precision=precision)
             xs.append(x_l)
-            masks.append(z_loc > 0)
-            y_loc = jnp.where(relu[l], ops.relu(z_loc), z_loc)
+            if act == "gelu":
+                masks.append(jnp.where(relu[l], ops.gelu_grad_mult(z_loc), 1.0))
+                y_loc = jnp.where(relu[l], ops.gelu(z_loc), z_loc)
+            else:
+                masks.append(z_loc > 0)
+                y_loc = jnp.where(relu[l], ops.relu(z_loc), z_loc)
+            x_prev = x_l
             x = jnp.where(
                 active[l], y_loc, _tp_shard(_fit(x_l, o), tp_idx, o // tp)
             )
@@ -760,8 +866,15 @@ def _stage_fwd_tp(Ws, bs, active, relu, dims, x, precision, tp_idx, tp):
             )
             z_full = lax.psum(pre, "tp")
             xs.append(x)
-            masks.append(z_full > 0)
-            y = jnp.where(relu[l], ops.relu(z_full), z_full)
+            if act == "gelu":
+                masks.append(
+                    jnp.where(relu[l], ops.gelu_grad_mult(z_full), 1.0)
+                )
+                y = jnp.where(relu[l], ops.gelu(z_full), z_full)
+                y = y + jnp.where(residual[l], _fit(x_prev, o), 0.0)
+            else:
+                masks.append(z_full > 0)
+                y = jnp.where(relu[l], ops.relu(z_full), z_full)
             x = jnp.where(active[l], y, z_full)
     if (L - 1) % 2 == 0:
         # trailing column slot left the stage output sharded: complete it
@@ -770,14 +883,20 @@ def _stage_fwd_tp(Ws, bs, active, relu, dims, x, precision, tp_idx, tp):
     return x, tuple(xs), tuple(masks)
 
 
-def _stage_bwd_input_tp(Ws, active, relu, dims, masks, g, precision, tp_idx, tp):
+def _stage_bwd_input_tp(Ws, active, relu, dims, masks, g, precision, tp_idx, tp,
+                        act="relu", residual=None):
     """The dgrad chain of the Megatron backward (tp > 1): the split
     B-input, and — composed with ``_stage_bwd_weight_tp`` below — the
     combined backward's first half. Returns ``(dx_full, g_effs)``; the
     per-slot effective output-grads are stashed in the SAME representation
-    the masks use (sharded for column slots, full for row slots)."""
+    the masks use (sharded for column slots, full for row slots).
+
+    Gelu residual grads land at COLUMN slots only (the skip's producer is
+    the even slot's full-width input), AFTER the slot's dx psum — both
+    operands full-width and replicated, exactly mirroring the forward."""
     L = len(dims)
     g_effs = [None] * L
+    g_prev = None
     if (L - 1) % 2 == 0:
         # the stage output was completed to full width; the trailing
         # column slot's dgrad consumes this rank's shard of its grad
@@ -793,6 +912,8 @@ def _stage_bwd_input_tp(Ws, active, relu, dims, masks, g, precision, tp_idx, tp)
                 active[l], part, _fit(_tp_scatter(g, tp_idx, o), i)
             )
             g = lax.psum(pre, "tp")
+            if act == "gelu" and l + 1 < L:
+                g = g + jnp.where(residual[l + 1], _fit(g_prev, i), 0.0)
         else:  # row-parallel: full g, local sharded dx
             g_l = _fit(g, o)
             g_eff = jnp.where(relu[l], g_l * masks[l], g_l)
@@ -801,6 +922,7 @@ def _stage_bwd_input_tp(Ws, active, relu, dims, masks, g, precision, tp_idx, tp)
             g = jnp.where(
                 active[l], dx, _tp_shard(_fit(g_l, i), tp_idx, i // tp)
             )
+            g_prev = g_l
     return g, tuple(g_effs)
 
 
@@ -824,12 +946,14 @@ def _stage_bwd_weight_tp(active, dims, xs, g_effs, precision, tp_idx, tp):
     return tuple(gWs), tuple(gbs)
 
 
-def _stage_bwd_tp(Ws, active, relu, dims, xs, masks, g, precision, tp_idx, tp):
+def _stage_bwd_tp(Ws, active, relu, dims, xs, masks, g, precision, tp_idx, tp,
+                  act="relu", residual=None):
     """Combined Megatron backward: the literal composition of the two
     halves (same composition contract as ops.linear_grad — split and
     combined schedules can never disagree, at any tp)."""
     dx, g_effs = _stage_bwd_input_tp(
-        Ws, active, relu, dims, masks, g, precision, tp_idx, tp
+        Ws, active, relu, dims, masks, g, precision, tp_idx, tp,
+        act=act, residual=residual,
     )
     gWs, gbs = _stage_bwd_weight_tp(
         active, dims, xs, g_effs, precision, tp_idx, tp
@@ -953,6 +1077,18 @@ def make_pipeline_step(
             "pallas flag kernel computes dgrad+wgrad in one unit and has "
             "no split halves); use kernel_backend='xla'"
         )
+    act = spec.act
+    if act != "relu" and kernel_backend == "pallas":
+        raise ValueError(
+            f"the fused pallas flag kernels implement the relu family only; "
+            f"use kernel_backend='xla' for act={act!r} models"
+        )
+    rec = bool(getattr(prog, "recompute", False))
+    if rec and kernel_backend == "pallas":
+        raise ValueError(
+            "recompute re-runs the stage forward through the XLA slot "
+            "functions; use kernel_backend='xla' with --recompute"
+        )
     dims = slot_shapes(spec, tp_n)
     # this device's slot geometry: at tp == 1 these ARE the global dims
     # (identical trace, byte for byte); at tp > 1 the Megatron shards
@@ -968,6 +1104,7 @@ def make_pipeline_step(
     Kf, Kb = prog.n_fwd_slots, prog.n_bwd_slots
     Ks = prog.n_stash_slots
     Kg = prog.n_gstash_slots  # grad-stash depth (split programs only)
+    Kx = prog.n_xin_slots  # input-stash depth (recompute programs only)
     mb_sz = mubatch_size
     B_global = spec.global_batch_size
     training = prog.is_training
@@ -1099,6 +1236,10 @@ def make_pipeline_step(
         tab_dict.update(
             sp=prog.stash_peek, gw=prog.gstash_write, gr=prog.gstash_read
         )
+    if rec:
+        # recompute programs route the input-stash write/read pair (the
+        # forward stores its stage input; the recompute frees it)
+        tab_dict.update(xw=prog.xin_write, xr=prog.xin_read)
     tabs = jax.tree.map(jnp.asarray, tab_dict)
     # ring shifts: with virtual chunks the device-(P-1) -> device-0 wrap IS a
     # stage boundary (chunk c on the last device feeds chunk c+1 on the
@@ -1114,6 +1255,7 @@ def make_pipeline_step(
         bsV = stacked["b"]
         activeV = flags["active"]  # (V, L)
         reluV = flags["relu"]
+        residualV = flags["residual"]  # (V, L); all-False for relu specs
         head_maskV = flags["head_mask"]  # (V, D_out)
         stage = lax.axis_index("pp")
         tp_idx = lax.axis_index("tp") if tp_n > 1 else 0
@@ -1136,13 +1278,17 @@ def make_pipeline_step(
             # accumulators, head-logit stash and the loss tally only exist in
             # training programs — inference never runs a backward, so it
             # carries only its predictions
+            # the mask stash holds relu bitmasks (bool) for the relu family
+            # and gelu derivative VALUES (f32) for the gelu family — same
+            # slot discipline, family-appropriate dtype
+            mask_dtype = jnp.bool_ if act == "relu" else jnp.float32
             carry.update(
                 xs=tuple(
                     jnp.zeros((Ks + 1, mb_sz, w), jnp.float32)
                     for w in xs_widths
                 ),
                 masks=tuple(
-                    jnp.zeros((Ks + 1, mb_sz, w), jnp.bool_)
+                    jnp.zeros((Ks + 1, mb_sz, w), mask_dtype)
                     for w in mask_widths
                 ),
                 z=jnp.zeros((Ks + 1, mb_sz, D_out), jnp.float32),
@@ -1163,6 +1309,15 @@ def make_pipeline_step(
                         for w in mask_widths
                     )
                 )
+            if rec:
+                # recompute input stash: the stage input each forward tick
+                # parks (slots assigned by the lowering, +1 trash; the
+                # global stage 0 reloads from HBM instead and never claims
+                # one). Freed at the recompute tick — the short lifetime
+                # analysis/stash.py proves
+                carry.update(
+                    xin=jnp.zeros((Kx + 1, mb_sz, D_in), jnp.float32)
+                )
         else:
             carry.update(preds=jnp.zeros((M + 1, mb_sz, D_out), jnp.float32))
         zero_fwd = jnp.zeros((mb_sz, W_rel), jnp.float32)
@@ -1179,40 +1334,65 @@ def make_pipeline_step(
             def chunk_params():
                 Ws = [pick(w, v) for w in WsV]
                 bs = [pick(b, v) for b in bsV]
-                return Ws, bs, pick(activeV, v), pick(reluV, v), pick(head_maskV, v)
+                return (
+                    Ws,
+                    bs,
+                    pick(activeV, v),
+                    pick(reluV, v),
+                    pick(residualV, v),
+                    pick(head_maskV, v),
+                )
 
             def noop(c):
                 return c, zero_fwd, zero_bwd
 
+            def run_stage_fwd(Ws, bs, active, relu, residual, x_in):
+                """The ONE stage-forward call both the forward tick and the
+                recompute tick make — character-identical expressions from
+                a bitwise-identical input are the recompute parity
+                contract."""
+                if tp_n > 1:
+                    return _stage_fwd_tp(
+                        Ws, bs, active, relu, dims, x_in, precision,
+                        tp_idx, tp_n, act=act, residual=residual,
+                    )
+                return _stage_fwd(
+                    Ws, bs, active, relu, dims, x_in, precision,
+                    kernel_backend, act=act, residual=residual,
+                )
+
             def forward(c):
-                Ws, bs, active, relu, head_mask = chunk_params()
+                Ws, bs, active, relu, residual, head_mask = chunk_params()
                 # non-input stages receive a W_rel-wide relay; pad it up to
                 # D_in so both branches of the where agree (exact: relayed
                 # activations are zero beyond their true boundary width)
                 x_in = jnp.where(
                     load_in, x[mb_r], _fit(c["fwd_mail"][row["rf"][stage]], D_in)
                 )
-                if tp_n > 1:
-                    out, xs_l, masks_l = _stage_fwd_tp(
-                        Ws, bs, active, relu, dims, x_in, precision,
-                        tp_idx, tp_n,
-                    )
-                else:
-                    out, xs_l, masks_l = _stage_fwd(
-                        Ws, bs, active, relu, dims, x_in, precision,
-                        kernel_backend,
-                    )
+                out, xs_l, masks_l = run_stage_fwd(
+                    Ws, bs, active, relu, residual, x_in
+                )
                 c = dict(c)
                 p = ops.softmax(out, valid_mask=head_mask[None, :])
                 if training:
-                    sw = row["sw"][stage]  # lowering-assigned stash slot
-                    c["xs"] = tuple(
-                        buf.at[sw].set(val) for buf, val in zip(c["xs"], xs_l)
-                    )
-                    c["masks"] = tuple(
-                        buf.at[sw].set(val) for buf, val in zip(c["masks"], masks_l)
-                    )
-                    c["z"] = c["z"].at[sw].set(out)
+                    if rec:
+                        # recompute program: park only the stage INPUT (the
+                        # residuals are re-derived at the recompute tick);
+                        # the global stage 0 reloads from HBM, its xw is
+                        # the trash slot
+                        xw = row["xw"][stage]
+                        c["xin"] = c["xin"].at[xw].set(x_in)
+                    else:
+                        sw = row["sw"][stage]  # lowering-assigned stash slot
+                        c["xs"] = tuple(
+                            buf.at[sw].set(val)
+                            for buf, val in zip(c["xs"], xs_l)
+                        )
+                        c["masks"] = tuple(
+                            buf.at[sw].set(val)
+                            for buf, val in zip(c["masks"], masks_l)
+                        )
+                        c["z"] = c["z"].at[sw].set(out)
                     mb_loss = ops.mse_loss(p, y[mb_r], B_global)
                     c["loss"] = c["loss"] + jnp.where(is_head, mb_loss, 0.0)
                 else:
@@ -1220,8 +1400,33 @@ def make_pipeline_step(
                 payload = jnp.where(row["sf"][stage] == 1, _fit(out, W_rel), 0.0)
                 return c, payload, zero_bwd
 
+            def recompute(c):
+                # OP_RECOMPUTE: re-run the stage forward from the parked
+                # input and stash the residuals the imminent backward
+                # consumes. Same input bits + the same run_stage_fwd
+                # expressions = bitwise-identical xs/masks/z to what the
+                # stashed twin's forward tick stored. No loss accumulation
+                # (the forward tick already tallied it), no sends.
+                Ws, bs, active, relu, residual, head_mask = chunk_params()
+                x_in = jnp.where(
+                    load_in, x[mb_r], _fit(c["xin"][row["xr"][stage]], D_in)
+                )
+                out, xs_l, masks_l = run_stage_fwd(
+                    Ws, bs, active, relu, residual, x_in
+                )
+                c = dict(c)
+                sw = row["sw"][stage]
+                c["xs"] = tuple(
+                    buf.at[sw].set(val) for buf, val in zip(c["xs"], xs_l)
+                )
+                c["masks"] = tuple(
+                    buf.at[sw].set(val) for buf, val in zip(c["masks"], masks_l)
+                )
+                c["z"] = c["z"].at[sw].set(out)
+                return c, zero_fwd, zero_bwd
+
             def backward(c):
-                Ws, bs, active, relu, head_mask = chunk_params()
+                Ws, bs, active, relu, residual, head_mask = chunk_params()
                 # lowering guarantees every training backward has a real
                 # stash slot in [0, Ks) (replay-asserted), so no clamp needed
                 sr = row["sr"][stage]
@@ -1239,12 +1444,12 @@ def make_pipeline_step(
                 if tp_n > 1:
                     dx, gW_d, gb_d = _stage_bwd_tp(
                         Ws, active, relu, dims, xs_r, masks_r, g_in,
-                        precision, tp_idx, tp_n,
+                        precision, tp_idx, tp_n, act=act, residual=residual,
                     )
                 else:
                     dx, gW_d, gb_d = _stage_bwd(
                         Ws, active, relu, dims, xs_r, masks_r, g_in,
-                        precision, kernel_backend,
+                        precision, kernel_backend, act=act, residual=residual,
                     )
                 c = dict(c)
                 if V == 1:
@@ -1261,7 +1466,7 @@ def make_pipeline_step(
                 # SAME tick — PEEKS the activation stash (masks + logits;
                 # the B-weight frees it later) and stashes the per-slot
                 # effective output-grads for the deferred wgrad
-                Ws, bs, active, relu, head_mask = chunk_params()
+                Ws, bs, active, relu, residual, head_mask = chunk_params()
                 sp = row["sp"][stage]
                 g0 = ops.softmax_mse_head_grad(
                     c["z"][sp], y[mb_r], B_global, valid_mask=head_mask[None, :]
@@ -1274,11 +1479,12 @@ def make_pipeline_step(
                 if tp_n > 1:
                     dx, g_effs = _stage_bwd_input_tp(
                         Ws, active, relu, dims, masks_r, g_in, precision,
-                        tp_idx, tp_n,
+                        tp_idx, tp_n, act=act, residual=residual,
                     )
                 else:
                     dx, g_effs = _stage_bwd_input(
-                        Ws, active, relu, dims, masks_r, g_in, precision
+                        Ws, active, relu, dims, masks_r, g_in, precision,
+                        act=act, residual=residual,
                     )
                 c = dict(c)
                 gw = row["gw"][stage]
@@ -1293,7 +1499,7 @@ def make_pipeline_step(
                 # in lowering-enforced B-input order (bit-identical fp sums
                 # vs the combined schedule); frees both stash slots by
                 # overwrite-on-reuse — no messages in or out
-                _, _, active, _, _ = chunk_params()
+                _, _, active, _, _, _ = chunk_params()
                 sr = row["sr"][stage]
                 gr = row["gr"][stage]
                 xs_r = tuple(buf[sr] for buf in c["xs"])
@@ -1315,13 +1521,19 @@ def make_pipeline_step(
                     c["gb"] = tuple(a.at[v].add(d) for a, d in zip(c["gb"], gb_d))
                 return c, zero_fwd, zero_bwd
 
-            # branch order is the op-code encoding:
-            # OP_NOOP=0, OP_FWD=1, OP_BWD=2 (B-input when split), OP_BWD_W=3
-            assert (OP_FWD, OP_BWD, OP_BWD_W) == (1, 2, 3)
+            # branch order is the op-code encoding: OP_NOOP=0, OP_FWD=1,
+            # OP_BWD=2 (B-input when split), OP_BWD_W=3, OP_RECOMPUTE=4
+            assert (OP_FWD, OP_BWD, OP_BWD_W, OP_RECOMPUTE) == (1, 2, 3, 4)
             if training and split:
                 branches = [noop, forward, backward_input, backward_weight]
             else:
                 branches = [noop, forward] + ([backward] if training else [noop])
+            if training and rec:
+                # recompute programs may not use OP_BWD_W without split, but
+                # the switch is indexed by op code, so pad to position 4
+                while len(branches) < OP_RECOMPUTE:
+                    branches.append(noop)
+                branches.append(recompute)
             carry, fwd_out, bwd_out = lax.switch(opv, branches, carry)
 
             # uniform collectives outside the switch: relay payloads
@@ -1502,7 +1714,7 @@ def make_pipeline_step(
 
     pp = P("pp")
     dp_spec = P("dp")
-    flags_specs = {"active": pp, "relu": pp, "head_mask": pp}
+    flags_specs = {"active": pp, "relu": pp, "residual": pp, "head_mask": pp}
     stacked_specs = stacked_param_specs(tp_n, L)
 
     if training:

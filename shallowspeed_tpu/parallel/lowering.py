@@ -39,15 +39,19 @@ from shallowspeed_tpu import schedules as S
 # op codes in the tick tables. In a SPLIT program (backward_split) OP_BWD
 # cells are the relay-critical B-input half — same tick the combined
 # backward would occupy, same message structure — and OP_BWD_W cells are
-# the deferred B-weight halves packed into former bubble ticks.
-OP_NOOP, OP_FWD, OP_BWD, OP_BWD_W = 0, 1, 2, 3
+# the deferred B-weight halves packed into former bubble ticks. In a
+# RECOMPUTE program OP_FWD cells stash only the stage INPUT and
+# OP_RECOMPUTE cells re-run the stage forward right before the backward,
+# writing the residual stash the backward then consumes (torchgpipe trade:
+# the stash lifetime shrinks from fwd->bwd to recompute->bwd).
+OP_NOOP, OP_FWD, OP_BWD, OP_BWD_W, OP_RECOMPUTE = 0, 1, 2, 3, 4
 
 
 @dataclasses.dataclass(frozen=True)
 class WorkItem:
     """One compute event parsed from a device's instruction stream."""
 
-    kind: int  # OP_FWD | OP_BWD | OP_BWD_W
+    kind: int  # OP_FWD | OP_BWD | OP_BWD_W | OP_RECOMPUTE
     mubatch_id: int
     chunk: int = 0  # virtual-stage chunk on this device (0 unless interleaved)
     needs_fwd_msg: bool = False  # consumes activations from the prior stage
@@ -103,6 +107,16 @@ class TickProgram:
     stash_peek: np.ndarray = None  # (T, S) int32: stash slot a B-input consults
     gstash_write: np.ndarray = None  # (T, S) int32: grad-stash slot a B-input fills
     gstash_read: np.ndarray = None  # (T, S) int32: grad-stash slot a B-weight frees
+    # activation-recompute extension (recompute programs only): OP_FWD cells
+    # write the stage INPUT into an xin slot instead of residuals into the
+    # activation stash; OP_RECOMPUTE cells read+free the xin slot, re-run
+    # the forward and write the residual stash slot the backward consumes.
+    # Global stage 0 skips the xin stash — its recompute reloads the
+    # microbatch input directly (load_in marks those cells too).
+    recompute: bool = False
+    n_xin_slots: int = 0  # stage-input stash depth (trash = index n_xin_slots)
+    xin_write: np.ndarray = None  # (T, S) int32: xin slot a forward fills
+    xin_read: np.ndarray = None  # (T, S) int32: xin slot a recompute frees
 
 
 class ScheduleLoweringError(ValueError):
@@ -137,7 +151,9 @@ def _op_weights(prog):
     from shallowspeed_tpu.observability.costmodel import PIPELINE_OP_COSTS as C
 
     bwd = C["bwd_in"] if prog.backward_split else C["bwd"]
-    return np.array([0.0, C["fwd"], bwd, C["bwd_w"]], np.float64)
+    return np.array(
+        [0.0, C["fwd"], bwd, C["bwd_w"], C["recompute"]], np.float64
+    )
 
 
 def weighted_makespan(prog):
@@ -170,7 +186,7 @@ def weighted_utilization(prog):
     return float(w[np.asarray(prog.op)].sum() / (prog.num_stages * span))
 
 
-def program_stats(prog):
+def program_stats(prog, spec=None, mubatch_size=None, tp=1):
     """Static per-program telemetry: everything a metrics consumer needs to
     reason about a lowered schedule without replaying it — tick count, send
     volume, mailbox/stash footprints, per-device occupancy and the bubble
@@ -178,7 +194,13 @@ def program_stats(prog):
     executor's runtime per-tick behaviour is fully determined by them), so
     recording this once per program is the per-tick story with zero runtime
     cost. All values are plain Python scalars/lists — JSON-serializable as-is
-    (the observability JSONL sink emits this dict verbatim)."""
+    (the observability JSONL sink emits this dict verbatim).
+
+    With ``spec`` + ``mubatch_size`` the dict additionally carries the
+    PER-MODEL stash memory: ``stash_bytes_peak`` = slot count x slot
+    activation bytes from the real spec's padded slot shapes (residual
+    stash + the recompute xin stash + the split grad stash) — the number
+    the report CLI's Memory section renders stashed-vs-recompute."""
     cells = prog.num_ticks * prog.num_stages
     util = utilization(prog)
     wutil = weighted_utilization(prog)
@@ -191,31 +213,47 @@ def program_stats(prog):
     # per-op-kind cell counts: OP_BWD cells are B-inputs in a split
     # program, combined backwards otherwise (reported under the honest key)
     n_bwd = int(np.sum(prog.op == OP_BWD))
-    return {
+    stats = {
         "num_ticks": int(prog.num_ticks),
         "num_stages": int(prog.num_stages),
         "num_micro_batches": int(prog.num_micro_batches),
         "num_chunks": int(prog.num_chunks),
         "is_training": bool(prog.is_training),
         "backward_split": bool(prog.backward_split),
+        "recompute": bool(prog.recompute),
         "active_cells": int(np.sum(prog.op != OP_NOOP)),
         "total_cells": int(cells),
         "cells_fwd": int(np.sum(prog.op == OP_FWD)),
         "cells_bwd": 0 if prog.backward_split else n_bwd,
         "cells_bwd_in": n_bwd if prog.backward_split else 0,
         "cells_bwd_w": int(np.sum(prog.op == OP_BWD_W)),
+        "cells_recompute": int(np.sum(prog.op == OP_RECOMPUTE)),
         "sends_fwd": int(np.sum(prog.send_fwd)),
         "sends_bwd": int(np.sum(prog.send_bwd)),
         "fwd_mail_slots": int(prog.n_fwd_slots),
         "bwd_mail_slots": int(prog.n_bwd_slots),
         "stash_slots": int(prog.n_stash_slots),
         "grad_stash_slots": int(prog.n_gstash_slots),
+        "xin_slots": int(prog.n_xin_slots),
         "stage_occupancy": occupancy,
         "utilization": float(util),
         "bubble_fraction": float(1.0 - util),
         "weighted_utilization": float(wutil),
         "weighted_bubble_fraction": float(1.0 - wutil),
     }
+    if spec is not None and mubatch_size is not None:
+        from shallowspeed_tpu.parallel.executor import stash_slot_nbytes
+
+        per = stash_slot_nbytes(spec, mubatch_size, tp=tp)
+        stats["stash_bytes_per_slot"] = int(per["stash"])
+        stats["xin_bytes_per_slot"] = int(per["xin"])
+        stats["gstash_bytes_per_slot"] = int(per["gstash"])
+        stats["stash_bytes_peak"] = int(
+            prog.n_stash_slots * per["stash"]
+            + prog.n_xin_slots * per["xin"]
+            + prog.n_gstash_slots * per["gstash"]
+        )
+    return stats
 
 
 def program_flops(prog, spec, mubatch_size, tp=1):
@@ -245,10 +283,18 @@ def program_flops(prog, spec, mubatch_size, tp=1):
     n_fwd = int(np.sum(prog.op == OP_FWD))
     n_bwd = int(np.sum(prog.op == OP_BWD))
     n_bwd_w = int(np.sum(prog.op == OP_BWD_W))
+    # the recompute tax: every OP_RECOMPUTE cell re-runs a full stage
+    # forward (2 units) — charged here so MFU and the cost-model
+    # cross-check price recompute programs honestly
+    n_rec = int(np.sum(prog.op == OP_RECOMPUTE))
     # split programs spread the backward's 4-unit work over an OP_BWD
     # (dgrad, 2) and an OP_BWD_W (wgrad, 2) cell: same total FLOPs
     bwd_unit = 2 if prog.backward_split else 4
-    return (2 * n_fwd + bwd_unit * n_bwd + 2 * n_bwd_w) * mubatch_size * padded_p
+    return (
+        (2 * n_fwd + 2 * n_rec + bwd_unit * n_bwd + 2 * n_bwd_w)
+        * mubatch_size
+        * padded_p
+    )
 
 
 def program_comm_bytes(prog, spec, mubatch_size):
@@ -313,6 +359,7 @@ def parse_stage_stream(commands, stage_id, num_stages, training=True, num_chunks
     seen_zero = seen_opt = False
     has_combined = has_split = False
     bin_keys, bww_keys = set(), set()  # (chunk, mubatch) with a B-in / B-w
+    rec_keys = set()  # (chunk, mubatch) with a RecomputeForward
     for cmd in commands:
         if isinstance(cmd, S.ZeroGrad):
             if items or seen_zero:
@@ -354,6 +401,25 @@ def parse_stage_stream(commands, stage_id, num_stages, training=True, num_chunks
                 )
             )
             pend_fwd_msg = False
+        elif isinstance(cmd, S.RecomputeForward):
+            # re-materializes residuals from the stashed stage input: no
+            # messages in or out, like the deferred B-weight half
+            if seen_opt:
+                raise ScheduleLoweringError("compute after OptimizerStep")
+            if pend_fwd_msg or pend_bwd_msg:
+                raise ScheduleLoweringError(
+                    "a Recv cannot bind to a RecomputeForward (it consumes "
+                    "no messages — only the stashed stage input)"
+                )
+            key = (cmd.chunk_id, cmd.mubatch_id)
+            if key in rec_keys:
+                raise ScheduleLoweringError(
+                    f"duplicate RecomputeForward for microbatch {cmd.mubatch_id}"
+                )
+            rec_keys.add(key)
+            items.append(
+                WorkItem(OP_RECOMPUTE, cmd.mubatch_id, chunk=cmd.chunk_id)
+            )
         elif isinstance(cmd, (S.BackwardGradAcc, S.BackwardGradAllReduce)):
             if seen_opt:
                 raise ScheduleLoweringError("compute after OptimizerStep")
@@ -361,6 +427,12 @@ def parse_stage_stream(commands, stage_id, num_stages, training=True, num_chunks
                 raise ScheduleLoweringError("RecvActivations not consumed by a Forward")
             if pend_bwd_msg and stage_g(cmd.chunk_id) == last_stage_g:
                 raise ScheduleLoweringError("global last stage cannot RecvOutputGrad")
+            if rec_keys and (cmd.chunk_id, cmd.mubatch_id) not in rec_keys:
+                raise ScheduleLoweringError(
+                    f"Backward for microbatch {cmd.mubatch_id} precedes its "
+                    "RecomputeForward (the backward consumes the residuals "
+                    "the recompute re-materializes)"
+                )
             has_combined = True
             items.append(
                 WorkItem(
@@ -381,6 +453,12 @@ def parse_stage_stream(commands, stage_id, num_stages, training=True, num_chunks
                 raise ScheduleLoweringError("RecvActivations not consumed by a Forward")
             if pend_bwd_msg and stage_g(cmd.chunk_id) == last_stage_g:
                 raise ScheduleLoweringError("global last stage cannot RecvOutputGrad")
+            if rec_keys and (cmd.chunk_id, cmd.mubatch_id) not in rec_keys:
+                raise ScheduleLoweringError(
+                    f"BackwardInputGrad for microbatch {cmd.mubatch_id} "
+                    "precedes its RecomputeForward (the B-input consults the "
+                    "residuals the recompute re-materializes)"
+                )
             has_split = True
             bin_keys.add((cmd.chunk_id, cmd.mubatch_id))
             items.append(
@@ -505,6 +583,7 @@ def lower_schedule(
     training=None,
     virtual=1,
     backward_split=False,
+    recompute=False,
 ):
     """Compile a Schedule class into a TickProgram.
 
@@ -534,16 +613,24 @@ def lower_schedule(
                 "(the virtual-chunk steady state interleaves its own "
                 "chunks; splitting its backward is future work)"
             )
+        if recompute:
+            raise ScheduleLoweringError(
+                "recompute is not supported for interleaved schedules "
+                "(per-chunk input stashes under the virtual-chunk steady "
+                "state are future work)"
+            )
         kw = {"num_chunks": virtual}  # V=1 degenerates to one chunk per device
     elif virtual != 1:
         raise ScheduleLoweringError(
             f"virtual={virtual} requires an interleaved schedule; "
             f"{schedule_cls.__name__} places one stage per device"
         )
-    elif backward_split:
-        kw = {"backward_split": True}
     else:
         kw = {}
+        if backward_split:
+            kw["backward_split"] = True
+        if recompute:
+            kw["recompute"] = True
     streams = [
         S.flat_commands(
             schedule_cls(
@@ -576,6 +663,21 @@ def lower_schedule(
                     "(every stage must defer its weight grads or none may)"
                 )
 
+    # a program recomputes iff any stage emitted recompute cells — and then
+    # every backward-bearing stage must recompute too (the executor's
+    # forward branch stops stashing residuals program-wide)
+    rec = any(i.kind == OP_RECOMPUTE for items in stage_items for i in items)
+    if rec:
+        for s, items in enumerate(stage_items):
+            if any(i.kind == OP_BWD for i in items) and not any(
+                i.kind == OP_RECOMPUTE for i in items
+            ):
+                raise ScheduleLoweringError(
+                    f"stage {s}: backwards without RecomputeForwards in a "
+                    "recompute program (every stage re-materializes its "
+                    "residuals or none does)"
+                )
+
     # validate per-device (chunk, microbatch) coverage
     want = sorted(
         (c, mb) for c in range(virtual) for mb in range(num_micro_batches)
@@ -588,6 +690,16 @@ def lower_schedule(
             bwd = sorted((i.chunk, i.mubatch_id) for i in items if i.kind == OP_BWD)
             if bwd != want:
                 raise ScheduleLoweringError(f"stage {s}: backwards {bwd} != chunks x 0..M-1")
+            if rec:
+                rcs = sorted(
+                    (i.chunk, i.mubatch_id)
+                    for i in items
+                    if i.kind == OP_RECOMPUTE
+                )
+                if rcs != want:
+                    raise ScheduleLoweringError(
+                        f"stage {s}: recomputes {rcs} != chunks x 0..M-1"
+                    )
             if split:
                 # exactly one B-weight per B-input, in the SAME per-stage
                 # order: the weight-grad accumulators sum per microbatch in
@@ -653,12 +765,21 @@ def lower_schedule(
     # B-input tick to the B-weight tick, peak depth becomes buffer shapes.
     gstash_free_from = [[] for _ in range(P)]
     gstash_of = [dict() for _ in range(P)]
+    # stage-input stash allocation (recompute programs): a forward claims a
+    # slot for its INPUT (global stage 0 exempt — its recompute reloads the
+    # microbatch from HBM); the matching recompute frees it and claims the
+    # residual-stash slot instead. The residual stash is therefore held
+    # only recompute->backward — the measurably lower peak the stash
+    # analysis asserts.
+    xin_free_from = [[] for _ in range(P)]
+    xin_of = [dict() for _ in range(P)]
     # deferred B-weight items, FIFO per stage (FIFO = B-input order = the
     # combined schedule's accumulation order, the bitwise-parity contract)
     pending_w = [deque() for _ in range(P)]
     rows = []  # per tick: list of per-device dicts
     t = 0
-    limit = 4 * virtual * num_micro_batches * P + 8 * virtual * P + 16
+    # recompute programs run one extra compute cell per (chunk, microbatch)
+    limit = (5 if rec else 4) * virtual * num_micro_batches * P + 8 * virtual * P + 16
     while any(
         ptr[s] < len(stage_items[s]) or pending_w[s] for s in range(P)
     ):
@@ -668,7 +789,7 @@ def lower_schedule(
             dict(
                 op=OP_NOOP, mb=num_micro_batches, rf=-1, rb=-1, sf=0, sb=0,
                 inf=-1, inb=-1, sw=-1, sr=-1, ck=0, li=0, ih=0,
-                sp=-1, gw=-1, gr=-1,
+                sp=-1, gw=-1, gr=-1, xw=-1, xr=-1,
             )
             for _ in range(P)
         ]
@@ -706,18 +827,81 @@ def lower_schedule(
                 r["gr"] = gslot
                 progressed = True
                 continue
+            if (
+                item.kind == OP_RECOMPUTE
+                and pending_w[s]
+                and stash_free_from[s]
+                and all(f > t for f in stash_free_from[s])
+            ):
+                # Drain a deferred B-weight BEFORE starting the next
+                # microbatch's recompute when every residual-stash slot is
+                # occupied: the B-weight frees its slot, so the recompute
+                # about to claim one reuses it instead of growing the peak.
+                # Without this rule a split-backward drain phase holds all M
+                # stashes (every tick has r/B work, so B-weights never pack
+                # into bubbles) and recompute buys no peak reduction. FIFO
+                # order is preserved — same accumulation order as the
+                # stashed twin, so bitwise parity holds; the cost is
+                # delaying the relay stream by one tick per drained
+                # B-weight, the memory-for-time recompute trade.
+                w = pending_w[s].popleft()
+                wkey = (w.chunk, w.mubatch_id)
+                r = row[s]
+                r["op"], r["mb"], r["ck"] = OP_BWD_W, w.mubatch_id, w.chunk
+                slot = stash_of[s].pop(wkey)
+                stash_free_from[s][slot] = t + 1
+                r["sr"] = slot
+                gslot = gstash_of[s].pop(wkey)
+                gstash_free_from[s][gslot] = t + 1
+                r["gr"] = gslot
+                progressed = True
+                continue
             key = (item.chunk, item.mubatch_id)
             # execute item at tick t
             stage_g = item.chunk * P + s
             r = row[s]
             r["op"], r["mb"], r["ck"] = item.kind, item.mubatch_id, item.chunk
-            r["li"] = int(stage_g == 0 and item.kind == OP_FWD)
+            r["li"] = int(
+                stage_g == 0 and item.kind in (OP_FWD, OP_RECOMPUTE)
+            )
             r["ih"] = int(stage_g == last_stage_g)
             if item.needs_fwd_msg:
                 r["rf"] = fwd_mail[s].consume(t, key)
             if item.needs_bwd_msg:
                 r["rb"] = bwd_mail[s].consume(t, key)
             if training and item.kind == OP_FWD:
+                if rec:
+                    # stash the stage INPUT only; residuals wait for the
+                    # recompute (global stage 0 reloads from HBM instead)
+                    if stage_g != 0:
+                        xfree = xin_free_from[s]
+                        for xslot, f in enumerate(xfree):
+                            if f <= t:
+                                break
+                        else:
+                            xfree.append(0)
+                            xslot = len(xfree) - 1
+                        xfree[xslot] = np.inf  # held until the recompute
+                        xin_of[s][key] = xslot
+                        r["xw"] = xslot
+                else:
+                    free = stash_free_from[s]
+                    for slot, f in enumerate(free):
+                        if f <= t:
+                            break
+                    else:
+                        free.append(0)
+                        slot = len(free) - 1
+                    free[slot] = np.inf  # occupied until the matching backward
+                    stash_of[s][key] = slot
+                    r["sw"] = slot
+            elif training and item.kind == OP_RECOMPUTE:
+                # free the input stash and claim the residual-stash slot the
+                # imminent backward consumes — the short stash lifetime
+                if stage_g != 0:
+                    xslot = xin_of[s].pop(key)
+                    xin_free_from[s][xslot] = t + 1
+                    r["xr"] = xslot
                 free = stash_free_from[s]
                 for slot, f in enumerate(free):
                     if f <= t:
@@ -778,11 +962,14 @@ def lower_schedule(
             raise ScheduleLoweringError(f"stage {s}: unfreed activation stash")
         if gstash_of[s]:
             raise ScheduleLoweringError(f"stage {s}: unfreed grad stash")
+        if xin_of[s]:
+            raise ScheduleLoweringError(f"stage {s}: unfreed input stash")
 
     K_f = max((m.depth for m in fwd_mail), default=0) or 1
     K_b = max((m.depth for m in bwd_mail), default=0) or 1
     K_s = max((len(f) for f in stash_free_from), default=0) or 1
     K_g = max((len(f) for f in gstash_free_from), default=0) if split else 0
+    K_x = max((len(f) for f in xin_free_from), default=0) if rec else 0
     T = len(rows)
 
     def table(key, trash):
@@ -825,4 +1012,8 @@ def lower_schedule(
         stash_peek=table("sp", K_s),
         gstash_write=table("gw", K_g),
         gstash_read=table("gr", K_g),
+        recompute=rec,
+        n_xin_slots=K_x,
+        xin_write=table("xw", K_x),
+        xin_read=table("xr", K_x),
     )

@@ -67,6 +67,7 @@ from shallowspeed_tpu.parallel.lowering import (
     OP_BWD_W,
     OP_FWD,
     OP_NOOP,
+    OP_RECOMPUTE,
 )
 from shallowspeed_tpu.parallel.mesh import mesh_tp
 
@@ -146,15 +147,15 @@ def stage_param_view(stacked, s, submesh, tp, V):
 
 
 def stage_flags_view(flags, s, submesh, V):
-    """Stage s's flag rows (active/relu/head_mask), replicated over the
-    sub-mesh like the lockstep per-device view."""
+    """Stage s's flag rows (active/relu/residual/head_mask), replicated
+    over the sub-mesh like the lockstep per-device view."""
     rows = slice(s * V, (s + 1) * V)
     return {
         k: _view(
             flags[k], (V,) + flags[k].shape[1:],
             NamedSharding(submesh, P()), rows=rows,
         )
-        for k in ("active", "relu", "head_mask")
+        for k in ("active", "relu", "residual", "head_mask")
     }
 
 
@@ -243,10 +244,16 @@ def expected_stage_comms(role, spec, dp, tp, sends=True):
     must not demand an op the compiler lawfully removed."""
     required, forbidden = [], list(_NEVER)
     axes = {}
-    if role in ("fwd", "bwd", "bwd_in"):
+    # the recompute roles run the SAME stage forward expression as "fwd"
+    # (fwd_ns: the no-stash forward at the fwd tick; recompute: the
+    # re-materializing forward at the backward tick), so their collective
+    # contract is the forward's — the tp psum count doubles per (chunk,
+    # microbatch) only because the forward runs twice
+    if role in ("fwd", "fwd_ns", "recompute", "bwd", "bwd_in"):
+        fwd_like = role in ("fwd", "fwd_ns", "recompute")
         if tp > 1:
             fwd_w, bwd_w = E.tp_allreduce_sites(spec, tp, training=True)
-            sites = len(fwd_w) if role == "fwd" else len(bwd_w)
+            sites = len(fwd_w) if fwd_like else len(bwd_w)
             if role in ("bwd", "bwd_in") and not sends:
                 # slot 0's dx psum feeds only the (unreturned) relay
                 sites -= 1
@@ -254,8 +261,8 @@ def expected_stage_comms(role, spec, dp, tp, sends=True):
                 required.append("all_reduce")
                 axes["tp"] = {
                     "kind": "all_reduce",
-                    "sites_fwd": sites if role == "fwd" else 0,
-                    "sites_bwd": 0 if role == "fwd" else sites,
+                    "sites_fwd": sites if fwd_like else 0,
+                    "sites_bwd": 0 if fwd_like else sites,
                     "hlo_min_all_reduce_ops": sites,
                 }
             # sites == 0: the one potential psum is dead code — whether
@@ -355,6 +362,14 @@ class _StagePrograms:
         self.opt = opt
         self.precision = precision
         self.submeshes = stage_submeshes(mesh)
+        # the activation family is STATIC (model.py): it picks which
+        # per-slot expressions the stage programs trace, exactly like the
+        # lockstep executor — and the mask stash dtype follows it (relu
+        # stashes sign bits; the gelu family stashes the f32 grad
+        # multiplier, docs/lowering.md)
+        self.act = getattr(spec, "act", "relu")
+        self.mask_dtype = jnp.bool_ if self.act == "relu" else jnp.float32
+        self.rec = bool(getattr(prog, "recompute", False))
         # singleton-axis fast path: with dp == tp == 1 each stage's
         # sub-mesh is ONE device, every collective in the stage programs
         # is a 1-member group (bitwise identity), and shard_map buys
@@ -404,7 +419,9 @@ class _StagePrograms:
             k: tuple(_drop_pp(sp) for sp in v)
             for k, v in E.stacked_param_specs(self.tp, self.L).items()
         }
-        self._flag_specs = {"active": P(), "relu": P(), "head_mask": P()}
+        self._flag_specs = {
+            "active": P(), "relu": P(), "residual": P(), "head_mask": P(),
+        }
         if opt is not None:
             from shallowspeed_tpu.optimizer import (
                 is_stateless,
@@ -543,7 +560,7 @@ class _StagePrograms:
             )
         )
 
-    def _build_fwd(self, s, v, load, head, send, training):
+    def _build_fwd(self, s, v, load, head, send, training, stash=True):
         """The stage forward. Training signatures (``mb`` is a traced
         index into the ONE per-batch device-resident x/y stack — value-
         identical to a static slice, and it keeps program count
@@ -554,9 +571,16 @@ class _StagePrograms:
             head:      (params, flags, x_in, y_full, mb, loss_acc)
             neither:   (params, flags, x_in)
 
+        ``stash=False`` (the fwd tick of a recompute program) drops the
+        stash outputs — the host keeps only the stage-INPUT handle and
+        the matching recompute program re-materializes the residuals;
+        the loss (head) and the relay payload are still produced here,
+        so the traced per-element expressions are character-identical.
+
         Inference keeps the direct per-slot signature
         ``(params, flags, x_in)``."""
         tp, dims, prec = self.tp, self.dims, self.precision
+        act = self.act
         W_rel, D_in, D_out, B = self.W_rel, self.D_in, self.D_out, self.B_global
 
         def per_device(*args):
@@ -575,6 +599,7 @@ class _StagePrograms:
             Ws, bs = self._chunk_params(stacked, v)
             active = flags["active"][v]
             relu = flags["relu"][v]
+            residual = flags["residual"][v]
             head_mask = flags["head_mask"][v]
             if training and load:
                 x = lax.dynamic_index_in_dim(x_full, mb, 0, keepdims=False)
@@ -585,26 +610,30 @@ class _StagePrograms:
             if tp > 1:
                 tp_idx = lax.axis_index("tp")
                 out, xs, masks = E._stage_fwd_tp(
-                    Ws, bs, active, relu, dims, x, prec, tp_idx, tp
+                    Ws, bs, active, relu, dims, x, prec, tp_idx, tp,
+                    act=act, residual=residual,
                 )
             else:
                 out, xs, masks = E._stage_fwd(
-                    Ws, bs, active, relu, dims, x, prec
+                    Ws, bs, active, relu, dims, x, prec,
+                    act=act, residual=residual,
                 )
             rets = []
             if send:
                 rets.append(E._fit(out, W_rel))
             if training:
-                xs_o, masks_o = self._stash_out(xs, masks)
-                rets.append(xs_o)
-                rets.append(masks_o)
+                if stash:
+                    xs_o, masks_o = self._stash_out(xs, masks)
+                    rets.append(xs_o)
+                    rets.append(masks_o)
                 if head:
                     y_mb = lax.dynamic_index_in_dim(
                         y_full, mb, 0, keepdims=False
                     )
                     p = ops.softmax(out, valid_mask=head_mask[None, :])
                     mb_loss = ops.mse_loss(p, y_mb, B)
-                    rets.append(out)  # the z stash (head-grad logits)
+                    if stash:
+                        rets.append(out)  # the z stash (head-grad logits)
                     rets.append(loss_acc + mb_loss.reshape(1))
             elif head:
                 rets.append(ops.softmax(out, valid_mask=head_mask[None, :]))
@@ -616,16 +645,69 @@ class _StagePrograms:
         if send:
             out_specs.append(P("dp"))
         if training:
-            out_specs.append(self._xs_specs)
-            out_specs.append(self._mask_specs)
+            if stash:
+                out_specs.append(self._xs_specs)
+                out_specs.append(self._mask_specs)
             if head:
                 in_specs.append(P(None, "dp"))  # y_full
             if load or head:
                 in_specs.append(P())  # mb index, replicated
             if head:
                 in_specs.append(P("dp"))  # loss accumulator
-                out_specs += [P("dp"), P("dp")]
+                out_specs += [P("dp"), P("dp")] if stash else [P("dp")]
         elif head:
+            out_specs.append(P("dp"))
+        return self._jit(s, per_device, tuple(in_specs), tuple(out_specs))
+
+    def _build_recompute(self, s, v, load, head):
+        """The OP_RECOMPUTE stage program: re-run the stage forward from
+        the kept INPUT (stage 0 reloads its microbatch from the device-
+        resident batch stack — the HBM-reload exemption) and return the
+        residual stashes the backward is about to consume. The forward
+        expression is the shared builder's own (``_build_fwd`` traces
+        the identical ``E._stage_fwd``/``_stage_fwd_tp`` call), so the
+        stashes are bitwise the ones the stashed twin stored at the fwd
+        tick. No relay (the output already traveled at the fwd tick) and
+        no loss tally (counted once, at the fwd tick)."""
+        tp, dims, prec = self.tp, self.dims, self.precision
+        act = self.act
+        D_in = self.D_in
+
+        def per_device(*args):
+            it = iter(args)
+            stacked, flags = next(it), next(it)
+            if load:
+                x_full, mb = next(it), next(it)
+                x = lax.dynamic_index_in_dim(x_full, mb, 0, keepdims=False)
+            else:
+                x = E._fit(next(it), D_in)
+            Ws, bs = self._chunk_params(stacked, v)
+            active = flags["active"][v]
+            relu = flags["relu"][v]
+            residual = flags["residual"][v]
+            if tp > 1:
+                out, xs, masks = E._stage_fwd_tp(
+                    Ws, bs, active, relu, dims, x, prec,
+                    lax.axis_index("tp"), tp, act=act, residual=residual,
+                )
+            else:
+                out, xs, masks = E._stage_fwd(
+                    Ws, bs, active, relu, dims, x, prec,
+                    act=act, residual=residual,
+                )
+            xs_o, masks_o = self._stash_out(xs, masks)
+            rets = [xs_o, masks_o]
+            if head:
+                rets.append(out)  # the z stash (head-grad logits)
+            return tuple(rets)
+
+        in_specs = [self._param_specs, self._flag_specs]
+        if load:
+            in_specs += [P(None, "dp"), P()]  # x stack, mb index
+        else:
+            in_specs.append(P("dp"))  # the kept stage-input handle
+        out_specs = [self._xs_specs, self._mask_specs]
+        if head:
             out_specs.append(P("dp"))
         return self._jit(s, per_device, tuple(in_specs), tuple(out_specs))
 
@@ -634,6 +716,7 @@ class _StagePrograms:
         B-input half (dgrad chain + g_eff stash instead of the wgrad
         accumulation)."""
         tp, dims, prec = self.tp, self.dims, self.precision
+        act = self.act
         W_rel, D_out, B = self.W_rel, self.D_out, self.B_global
         Wb = max(D_out, W_rel)
 
@@ -651,6 +734,7 @@ class _StagePrograms:
             Ws, _ = self._chunk_params(stacked, v)
             active = flags["active"][v]
             relu = flags["relu"][v]
+            residual = flags["residual"][v]
             head_mask = flags["head_mask"][v]
             masks = self._split_stash(masks, self._mask_widths)
             if not split_input:
@@ -669,10 +753,12 @@ class _StagePrograms:
                     dx, g_effs = E._stage_bwd_input_tp(
                         Ws, active, relu, dims, masks, g_in, prec,
                         lax.axis_index("tp"), tp,
+                        act=act, residual=residual,
                     )
                 else:
                     dx, g_effs = E._stage_bwd_input(
-                        Ws, active, relu, dims, masks, g_in, prec
+                        Ws, active, relu, dims, masks, g_in, prec,
+                        act=act, residual=residual,
                     )
                 if send:
                     rets.append(E._fit(dx, W_rel))
@@ -684,11 +770,12 @@ class _StagePrograms:
             if tp > 1:
                 dx, gW_d, gb_d = E._stage_bwd_tp(
                     Ws, active, relu, dims, xs, masks, g_in, prec,
-                    lax.axis_index("tp"), tp,
+                    lax.axis_index("tp"), tp, act=act, residual=residual,
                 )
             else:
                 dx, gW_d, gb_d = E._stage_bwd(
-                    Ws, active, relu, dims, xs, masks, g_in, prec
+                    Ws, active, relu, dims, xs, masks, g_in, prec,
+                    act=act, residual=residual,
                 )
             if send:
                 rets.append(E._fit(dx, W_rel))
@@ -837,6 +924,14 @@ class _StagePrograms:
         if role == "fwd":
             v, load, head, send = variant
             fn = self._build_fwd(s, v, load, head, send, training=True)
+        elif role == "fwd_ns":
+            v, load, head, send = variant
+            fn = self._build_fwd(
+                s, v, load, head, send, training=True, stash=False
+            )
+        elif role == "recompute":
+            v, load, head = variant
+            fn = self._build_recompute(s, v, load, head)
         elif role == "infer_fwd":
             v, load, head, send = variant
             fn = self._build_fwd(s, v, load, head, send, training=False)
@@ -1038,10 +1133,15 @@ class MpmdTrainRunner:
         issue — nothing here blocks on device execution. Returns the new
         per-stage (params, state) plus the un-synced loss handle."""
         progs = self.programs
+        rec = progs.rec
         x_full, y_full = self._put_batch(xb, yb)
         mail = {}
         stash = [dict() for _ in range(self.P)]
         gstash = [dict() for _ in range(self.P)]
+        # recompute programs: the stage-INPUT handles kept from the fwd
+        # tick (stage 0 exempt — its recompute reloads from the batch
+        # stack), freed by the OP_RECOMPUTE dispatch that consumes them
+        xin = [dict() for _ in range(self.P)]
         grads = list(self._zero_g)
         loss_acc = self._zero_loss
         subs = progs.submeshes
@@ -1077,17 +1177,27 @@ class MpmdTrainRunner:
                     fn = c.get("_fn")
                     if fn is None:
                         fn = c["_fn"] = progs.get(
-                            s, "fwd", (v, c["load"], c["head"], c["send_fwd"])
+                            s, "fwd_ns" if rec else "fwd",
+                            (v, c["load"], c["head"], c["send_fwd"]),
                         )
                     args = (params[s], flags[s])
-                    args += (x_full,) if c["load"] else (mail.pop(("fwd", s, key)),)
+                    if c["load"]:
+                        args += (x_full,)
+                    else:
+                        x_in = mail.pop(("fwd", s, key))
+                        if rec:
+                            xin[s][key] = x_in  # kept for the recompute
+                        args += (x_in,)
                     if c["head"]:
                         args += (y_full, idx[s][mb], loss_acc)
                     elif c["load"]:
                         args += (idx[s][mb],)
                     outs = fn(*args)
                     i = 1 if c["send_fwd"] else 0
-                    if c["head"]:
+                    if rec:
+                        if c["head"]:
+                            loss_acc = outs[i]
+                    elif c["head"]:
                         stash[s][key] = (outs[i], outs[i + 1], outs[i + 2])
                         loss_acc = outs[i + 3]
                     else:
@@ -1098,6 +1208,26 @@ class MpmdTrainRunner:
                     )
                     if c["send_fwd"]:
                         relay("fwd", s, outs[0], key)
+                elif c["op"] == OP_RECOMPUTE:
+                    fn = c.get("_fn")
+                    if fn is None:
+                        fn = c["_fn"] = progs.get(
+                            s, "recompute", (v, c["load"], c["head"])
+                        )
+                    args = (params[s], flags[s])
+                    if c["load"]:
+                        args += (x_full, idx[s][mb])
+                    else:
+                        args += (xin[s].pop(key),)
+                    outs = fn(*args)
+                    stash[s][key] = (
+                        outs[0], outs[1], outs[2] if c["head"] else None
+                    )
+                    self.dispatch_count += 1
+                    self._span(
+                        spans, "stage.dispatch", t0, stage=s, op="recompute",
+                        mb=mb,
+                    )
                 elif c["op"] == OP_BWD and self.split:
                     xs, masks, z = stash[s][key]  # peek (B-weight frees)
                     fn = c.get("_fn")
@@ -1156,6 +1286,7 @@ class MpmdTrainRunner:
                     )
 
         assert not mail, "undelivered relay payloads (tables violated)"
+        assert not any(xin), "unconsumed recompute input handles"
         # the per-stage optimizer tail: dp psum + update, one dispatch per
         # stage (the lockstep program's exact reduction and update math,
         # stage-local)
@@ -1262,11 +1393,15 @@ class MpmdTrainRunner:
         enumeration the warm/audit pass compiles, so a warm start covers
         exactly the dispatch surface."""
         seen = {}
+        rec = self.programs.rec
         for row in self.cells:
             for c in row:
                 s, v = c["s"], c["v"]
                 if c["op"] == OP_FWD:
-                    seen[(s, "fwd", (v, c["load"], c["head"], c["send_fwd"]))] = c
+                    role = "fwd_ns" if rec else "fwd"
+                    seen[(s, role, (v, c["load"], c["head"], c["send_fwd"]))] = c
+                elif c["op"] == OP_RECOMPUTE:
+                    seen[(s, "recompute", (v, c["load"], c["head"]))] = c
                 elif c["op"] == OP_BWD and self.split:
                     seen[(s, "bwd_in", (v, c["head"], c["send_bwd"]))] = c
                 elif c["op"] == OP_BWD:
@@ -1343,10 +1478,11 @@ class MpmdTrainRunner:
 
         def stash_structs():
             _, _, xs_w, mask_w = E.tp_local_dims(self.programs.dims, self.tp)
+            mdt = self.programs.mask_dtype
             if progs.packed:  # one concatenated buffer per stash
                 return (
                     f32((mb_rows, sum(xs_w))),
-                    struct((mb_rows, sum(mask_w)), jnp.bool_),
+                    struct((mb_rows, sum(mask_w)), mdt),
                 )
             # global widths: tp-local widths x tp where the spec shards
             xs = tuple(
@@ -1354,7 +1490,7 @@ class MpmdTrainRunner:
                 for l, w in enumerate(xs_w)
             )
             masks = tuple(
-                struct((mb_rows, w * (1 if l % 2 else self.tp)), jnp.bool_)
+                struct((mb_rows, w * (1 if l % 2 else self.tp)), mdt)
                 for l, w in enumerate(mask_w)
             )
             return xs, masks
@@ -1363,21 +1499,27 @@ class MpmdTrainRunner:
             self._mb_idx[s][0] if s in self._mb_idx
             else jax.ShapeDtypeStruct((), jnp.int32)
         )
-        if role in ("fwd", "infer_fwd"):
+        if role in ("fwd", "fwd_ns", "infer_fwd"):
+            training = role != "infer_fwd"
             v, load, head, send = variant
-            if role == "fwd" and load:
+            if training and load:
                 args = (pv, fv, f32((self.M, mb_rows, self.D_in)))
             elif load:
                 args = (pv, fv, f32((mb_rows, self.spec.sizes[0])))
             else:
                 args = (pv, fv, f32((mb_rows, self.programs.W_rel)))
-            if role == "fwd" and head:
+            if training and head:
                 args += (
                     f32((self.M, mb_rows, self.D_out)), mb_i, self._zero_loss,
                 )
-            elif role == "fwd" and load:
+            elif training and load:
                 args += (mb_i,)
             return args
+        if role == "recompute":
+            v, load, head = variant
+            if load:
+                return (pv, fv, f32((self.M, mb_rows, self.D_in)), mb_i)
+            return (pv, fv, f32((mb_rows, self.programs.W_rel)))
         if role in ("bwd", "bwd_in"):
             v, head, send = variant
             xs, masks = stash_structs()

@@ -84,6 +84,16 @@ class Forward(ComputeInstruction):
 
 
 @dataclasses.dataclass(frozen=True)
+class RecomputeForward(ComputeInstruction):
+    """Activation recompute (torchgpipe, arxiv 2004.09910): re-run the local
+    stage forward for one microbatch from the stashed STAGE INPUT — the
+    character-identical forward expressions — re-materializing the per-slot
+    residuals right before the backward consumes them. Emitted only under
+    ``Schedule(recompute=True)``, immediately ahead of each backward step;
+    no messages in or out (the input was stashed at the forward tick)."""
+
+
+@dataclasses.dataclass(frozen=True)
 class BackwardGradAcc(ComputeInstruction):
     """Backward one microbatch, accumulating into the gradient buffers."""
 
@@ -161,6 +171,7 @@ class Schedule(ABC):
         num_stages: int,
         stage_id: int,
         backward_split: bool = False,
+        recompute: bool = False,
     ):
         assert num_micro_batches > 0 and num_stages > 0
         assert 0 <= stage_id < num_stages
@@ -171,6 +182,10 @@ class Schedule(ABC):
         # BackwardWeightGradAcc per microbatch instead of the combined
         # Backward (the lowering packs the weight halves into bubble ticks)
         self.backward_split = backward_split
+        # activation recompute: the forward stashes only the stage INPUT;
+        # a RecomputeForward re-materializes the residuals right before
+        # each backward step (torchgpipe trade: FLOPs for stash peak)
+        self.recompute = recompute
 
     @abstractmethod
     def steps(self):
@@ -232,6 +247,11 @@ class Schedule(ABC):
 
     def _bwd_step(self, mb, allreduce):
         cmds = []
+        if self.recompute:
+            # re-materialize the residuals FIRST: the recompute binds no
+            # messages (its input was stashed at the forward tick), so the
+            # Recv/Load that follows still binds to the backward compute
+            cmds.append(RecomputeForward(mubatch_id=mb))
         if self.is_last_stage:
             cmds.append(LoadMuBatchTarget(mubatch_id=mb))
         else:
@@ -248,10 +268,15 @@ class NaiveParallelSchedule(Schedule):
         yield [ZeroGrad()]
         for mb in range(self.num_micro_batches):
             cmds = self._fwd_step(mb)
+            if not self.is_last_stage:
+                cmds.append(SendActivations())
+            if self.recompute:
+                # same contract as _bwd_step: re-materialize residuals
+                # ahead of the Recv/Load that binds to the backward
+                cmds.append(RecomputeForward(mubatch_id=mb))
             if self.is_last_stage:
                 cmds.append(LoadMuBatchTarget(mubatch_id=mb))
             else:
-                cmds.append(SendActivations())
                 cmds.append(RecvOutputGrad())
             cmds.extend(self._bwd_compute(mb, self.is_last_mubatch(mb)))
             yield cmds

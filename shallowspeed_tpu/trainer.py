@@ -207,6 +207,14 @@ def _validate_megakernel(spec, opt, fuse_mubatches, name="megakernel"):
 
     if not fuse_mubatches:
         raise ValueError(f"{name} requires fuse_mubatches=True")
+    if getattr(spec, "act", "relu") != "relu":
+        # the fused kernels hard-code the relu/identity slot expressions
+        # (pallas_ops fused units); the gelu family's f32 grad-multiplier
+        # masks and residual adds have no kernel path
+        raise ValueError(
+            f"{name} supports the relu activation family only "
+            f"(model act={spec.act!r})"
+        )
     desc = _kernel_opt_descriptor(opt)
     if desc is None:
         raise ValueError(

@@ -166,6 +166,59 @@ def test_stash_peak_mismatch_refused():
         )
 
 
+def test_recompute_peak_drop_proved_from_tick_tables():
+    """The smoke-gate proof: gpipe's recompute twin measurably drops the
+    live residual-stash peak (M slots -> 1) — measured by replaying the
+    tick tables, not by reading allocation metadata."""
+    from shallowspeed_tpu.analysis.stash import assert_recompute_peak_drop
+
+    stashed = lower_schedule(S.GPipeSchedule, 4, 4)
+    rec = lower_schedule(S.GPipeSchedule, 4, 4, recompute=True)
+    out = assert_recompute_peak_drop(stashed, rec)
+    assert out["stash_peak_stashed"] == 4
+    assert out["stash_peak_recompute"] == 1
+    assert out["xin_peak"] >= 1
+
+
+def test_recompute_peak_drop_honest_floor_of_one():
+    """naive holds one live stash slot at peak either way — nothing to
+    reclaim; the proof accepts the floor instead of demanding a
+    dishonest drop."""
+    from shallowspeed_tpu.analysis.stash import assert_recompute_peak_drop
+
+    stashed = lower_schedule(S.NaiveParallelSchedule, 4, 4)
+    rec = lower_schedule(S.NaiveParallelSchedule, 4, 4, recompute=True)
+    out = assert_recompute_peak_drop(stashed, rec)
+    assert out["stash_peak_stashed"] == 1
+    assert out["stash_peak_recompute"] == 1
+
+
+def test_recompute_peak_drop_refuses_mislabelled_twins():
+    """Handing the proof two stashed programs (or twins in the wrong
+    order) is refused before any replay — the comparison is only
+    meaningful between a stashed program and ITS recompute twin."""
+    from shallowspeed_tpu.analysis.stash import assert_recompute_peak_drop
+
+    stashed = lower_schedule(S.GPipeSchedule, 4, 4)
+    rec = lower_schedule(S.GPipeSchedule, 4, 4, recompute=True)
+    with pytest.raises(ProgramAnalysisError, match="not a recompute"):
+        assert_recompute_peak_drop(stashed, stashed)
+    with pytest.raises(ProgramAnalysisError, match="must be the"):
+        assert_recompute_peak_drop(rec, rec)
+
+
+def test_recompute_peak_drop_refuses_non_dropping_program():
+    """A 'recompute' program whose tables still hold the stashed twin's
+    lifetime (flag flipped, tables untouched) fails the strict-drop
+    leg with the two peaks named."""
+    from shallowspeed_tpu.analysis.stash import assert_recompute_peak_drop
+
+    stashed = lower_schedule(S.GPipeSchedule, 4, 4)
+    fake = dataclasses.replace(stashed, recompute=True)
+    with pytest.raises(ProgramAnalysisError, match="did not shorten"):
+        assert_recompute_peak_drop(stashed, fake)
+
+
 def test_cyclic_wait_refused_naming_the_chain():
     """Two single-cell stages each consuming the other's send: no
     lockstep tick order can realize it, and the async-dispatch proof

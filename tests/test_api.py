@@ -231,6 +231,30 @@ def test_backward_split_validation(data_dir):
                  backward_split=True)
 
 
+def test_recompute_validation(data_dir):
+    """The recompute refusal matrix: every unsupported combination is
+    refused at construction with an error naming the reason, not at the
+    first backward tick."""
+    with pytest.raises(ValueError, match="no cross-tick stash"):
+        _session(data_dir, recompute=True)  # dp=pp=1: nothing stashed
+    with pytest.raises(ValueError, match="interleaved virtual"):
+        _session(data_dir, pp=2, schedule="interleaved", virtual_stages=2,
+                 recompute=True)
+    with pytest.raises(ValueError, match="no recompute branch"):
+        _session(data_dir, pp=2, schedule="gpipe", kernel_backend="pallas",
+                 recompute=True)
+
+
+def test_model_zoo_validation(data_dir):
+    """Zoo resolution refusals: unknown names list the zoo; gelu-family
+    models refuse the relu-only pallas backend by name."""
+    with pytest.raises(ValueError, match="unknown model"):
+        _session(data_dir, model="mnist-cnn")
+    with pytest.raises(ValueError, match="gelu-family"):
+        _session(data_dir, model="transformer", pp=2, schedule="gpipe",
+                 kernel_backend="pallas")
+
+
 def test_backward_split_session_matches_unsplit(data_dir):
     """Split vs unsplit THROUGH the session surface (per-epoch loop and
     the fused run, ZeRO-1 included): identical model hashes — the split

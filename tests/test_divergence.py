@@ -150,6 +150,7 @@ def test_digest_stream_matches_host_reference(data_dir, kw):
         assert np.isfinite(last["gnorm_w"][gl]) and last["gnorm_w"][gl] >= 0
 
 
+@pytest.mark.slow  # 1-core wall budget; make diverge-smoke drives this end to end
 def test_digests_off_is_bitwise_identical_and_chunk_invariant(data_dir):
     """digests=True must observe, never perturb: the instrumented session
     trains to the uninstrumented twin's exact bits — and chunked

@@ -191,6 +191,7 @@ def fleet_checkpoint(tmp_path_factory):
 
 
 @pytest.mark.fleet
+@pytest.mark.slow  # 1-core wall budget; make fleet-smoke drives this end to end
 def test_fleet_serves_failover_and_scales(fleet_checkpoint):
     """The tentpole end to end, in one fleet lifetime: 3 checkpoint-loaded
     replicas serve a seeded open-loop stream (worker-verified bitwise

@@ -129,22 +129,26 @@ def _random_case_r2(seed):
 
 def _assert_lattice_case_matches_sequential(
     sizes, dp, pp, V, M, B, opt, zero1, sched, clip, fused, data_seed,
-    kb="xla", label_extra="", gbb=0, bsplit=False, tp=1,
+    kb="xla", label_extra="", gbb=0, bsplit=False, tp=1, act="relu",
+    recompute=False,
 ):
-    """The ONE sequential-vs-pipeline comparison harness behind the r2 and r3
-    lattice fuzz families: train two batches sequentially (the oracle) and
-    through the mesh pipeline with the given feature combination, then
-    compare every trained weight. ``tp > 1`` adds the Megatron model axis
-    (same tolerance: its psums reassociate a split contraction, exactly
-    like the dp sum)."""
-    spec_pp = Mo.make_model_spec(sizes, pp * V, B)
+    """The ONE sequential-vs-pipeline comparison harness behind the r2, r3
+    and r4 lattice fuzz families: train two batches sequentially (the
+    oracle) and through the mesh pipeline with the given feature
+    combination, then compare every trained weight. ``tp > 1`` adds the
+    Megatron model axis (same tolerance: its psums reassociate a split
+    contraction, exactly like the dp sum). ``act`` picks the activation
+    family (the model-zoo dimension); ``recompute`` drops the forward
+    stash and re-runs the stage forward at the backward boundary — both
+    must be invisible here."""
+    spec_pp = Mo.make_model_spec(sizes, pp * V, B, act=act)
     assert spec_pp.stages[-1].n_linears > 0  # generator guarantees parity regime
 
     rng = np.random.RandomState(data_seed)
     X = rng.randn(2, B, sizes[0]).astype(np.float32)
     Y = np.eye(sizes[-1], dtype=np.float32)[rng.randint(0, sizes[-1], (2, B))]
 
-    spec1 = Mo.make_model_spec(sizes, 1, B)
+    spec1 = Mo.make_model_spec(sizes, 1, B, act=act)
     params = jax.tree.map(jnp.asarray, Mo.init_model(spec1))
     step1 = trainer.make_train_step(spec1, opt, clip_norm=clip)
     st = opt.init(params)
@@ -159,7 +163,9 @@ def _assert_lattice_case_matches_sequential(
 
     mesh = make_mesh(dp, pp, tp=tp)
     order = E.interleave_order(pp * V, pp) if V > 1 else None
-    prog = lower_schedule(sched, M, pp, virtual=V, backward_split=bsplit)
+    prog = lower_schedule(
+        sched, M, pp, virtual=V, backward_split=bsplit, recompute=recompute
+    )
     stacked, flags = E.init_stacked(spec_pp, mesh, order=order)
     ost = E.zero1_init_state(opt, spec_pp, mesh) if zero1 else opt.init(stacked)
     if fused:
@@ -184,7 +190,8 @@ def _assert_lattice_case_matches_sequential(
     label = (
         f"sizes={sizes} dp={dp} pp={pp} tp={tp} V={V} M={M} B={B} "
         f"{type(opt).__name__} zero1={zero1} clip={clip} fused={fused} "
-        f"gbb={gbb} bsplit={bsplit} {sched.__name__}{label_extra}"
+        f"gbb={gbb} bsplit={bsplit} act={act} rec={recompute} "
+        f"{sched.__name__}{label_extra}"
     )
     # Adam's early update direction is ~g/|g| per element: near-zero second
     # moments amplify ulp-level cross-layout reassociation of g, so its
@@ -280,6 +287,66 @@ def test_random_r3_kernel_backend_combo_matches_sequential(seed):
         sizes, dp, pp, V, M, B, opt, zero1, sched, clip, fused,
         data_seed=4000 + seed, kb=kb, label_extra=f" kb={kb}", gbb=gbb,
         bsplit=bsplit, tp=tp,
+    )
+
+
+def _random_case_r4(seed):
+    """Round-19 feature fuzz: the MODEL and RECOMPUTE dimensions —
+    activation family (relu vs the transformer-style gelu+residual
+    slots) and pipeline activation recompute — from independent seed
+    bits, crossed with dp x pp x tp x zero1 x grad-bucketing x
+    backward-split x epoch-vs-step, so recompute meets every shipped
+    feature across the 12 seeds, not just its dedicated twins. Recompute
+    needs a flat pipeline schedule (pp > 1, V == 1); gelu is excluded
+    from the pallas backend only, which this family never draws."""
+    rng = np.random.RandomState(7000 + seed)
+    act = ["relu", "gelu"][seed % 2]
+    recompute = bool((seed // 2) % 2)
+    dp, pp = [(1, 4), (2, 2), (1, 2)][(seed // 4) % 3]
+    opt = OPTS[(seed + seed // 3) % 3]
+    zero1 = bool((seed // 3) % 2)
+    clip = [None, 0.05][(seed + seed // 2) % 2]
+    fused = bool((seed + seed // 4) % 2)
+    gbb = [0, int(rng.choice([256, 8192]))][(seed // 5) % 2]
+    bsplit = bool((seed + seed // 6) % 2)
+    tp = 2 if (seed + seed // 5) % 2 and dp * pp <= 4 else 1
+    per = int(rng.randint(2, 4))
+    if act == "gelu":
+        # gelu slot parity needs an even per-stage slice (model.py)
+        per += per % 2
+    n_sizes = pp * per
+    widths = sorted(rng.randint(8, 48, size=n_sizes - 1).tolist(), reverse=True)
+    sizes = tuple(widths) + (int(rng.randint(4, min(8, min(widths)) + 1)),)
+    M = int(rng.choice([2, 4]))
+    B = int(dp * M * rng.choice([4, 8]))
+    sched = SCHEDS[seed % 3]
+    return (
+        sizes, dp, pp, M, B, opt, zero1, sched, clip, fused, gbb, bsplit,
+        tp, act, recompute,
+    )
+
+
+@pytest.mark.parametrize(
+    "seed",
+    # seeds 2 and 3 (relu+recompute, gelu+recompute — the new lattice
+    # dimension) keep tier-1 coverage; the rest ride the slow tier
+    # (1-core wall budget)
+    [s if s in (2, 3) else pytest.param(s, marks=pytest.mark.slow)
+     for s in range(12)],
+)
+def test_random_r4_model_recompute_combo_matches_sequential(seed):
+    """Random (activation family, recompute) combinations crossed with
+    dp/pp/tp/zero1/bucketing/backward-split must still equal sequential
+    training — the model zoo and the recompute tick are invisible to the
+    math on every layout, not just the flagship relu-MLP."""
+    (
+        sizes, dp, pp, M, B, opt, zero1, sched, clip, fused, gbb, bsplit,
+        tp, act, recompute,
+    ) = _random_case_r4(seed)
+    _assert_lattice_case_matches_sequential(
+        sizes, dp, pp, 1, M, B, opt, zero1, sched, clip, fused,
+        data_seed=8000 + seed, gbb=gbb, bsplit=bsplit, tp=tp, act=act,
+        recompute=recompute,
     )
 
 
@@ -384,6 +451,81 @@ def test_backward_split_bitwise_identical_to_unsplit(layout):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=layout)
 
 
+RECOMPUTE_LAYOUTS = {
+    # layout -> (dp, pp, tp, zero1, schedule, bsplit, gbb, act)
+    "pp4-gpipe": (1, 4, 1, False, S.GPipeSchedule, False, 0, "relu"),
+    "pp4-pipedream-split": (
+        1, 4, 1, False, S.PipeDreamFlushSchedule, True, 0, "relu",
+    ),
+    "dp2pp2-bucketed": (2, 2, 1, False, S.GPipeSchedule, False, 1024, "gelu"),
+    "zero1": (2, 2, 1, True, S.PipeDreamFlushSchedule, False, 0, "relu"),
+    "tp2-gelu": (1, 2, 2, False, S.GPipeSchedule, False, 0, "gelu"),
+}
+
+
+@pytest.mark.parametrize(
+    "layout",
+    # the two pp4 layouts (plain + split, the recompute-smoke pair) keep
+    # tier-1 coverage; the dp/zero1/tp compositions ride the slow tier
+    # (1-core wall budget), still in the full suite
+    [lay if lay.startswith("pp4") else
+     pytest.param(lay, marks=pytest.mark.slow)
+     for lay in sorted(RECOMPUTE_LAYOUTS)],
+)
+def test_recompute_bitwise_identical_to_stashed(layout):
+    """The recompute acceptance criterion (arXiv 2004.09910): dropping the
+    forward activation stash and re-running the stage forward inside the
+    backward tick is BITWISE identical to stashed training — final
+    weights, loss AND the pre-clip global grad norm — across dp x pp x
+    tp x zero1 x bucketing x split-backward and both activation
+    families, with global-norm clipping active the whole time. The
+    recompute forward re-executes character-identical slot expressions,
+    so there is no tolerance to hide behind. The same pair of lowered
+    programs must also PROVE the memory win: ``assert_recompute_peak_drop``
+    replays both tick tables and refuses unless the recompute program's
+    stash peak is strictly below its stashed twin's."""
+    from shallowspeed_tpu.analysis.stash import assert_recompute_peak_drop
+
+    dp, pp, tp, zero1, sched, bsplit, gbb, act = RECOMPUTE_LAYOUTS[layout]
+    sizes = (40, 36, 32, 28, 24, 20, 14, 10)
+    M, B = 4, 32
+    spec = Mo.make_model_spec(sizes, pp, B, act=act)
+    mesh = make_mesh(dp, pp, tp=tp)
+    rng = np.random.RandomState(13)
+    X = rng.randn(2, B, sizes[0]).astype(np.float32)
+    Y = np.eye(sizes[-1], dtype=np.float32)[rng.randint(0, sizes[-1], (2, B))]
+    progs = {
+        rec: lower_schedule(sched, M, pp, backward_split=bsplit, recompute=rec)
+        for rec in (False, True)
+    }
+    drop = assert_recompute_peak_drop(progs[False], progs[True])
+    assert (
+        drop["stash_peak_recompute"] < drop["stash_peak_stashed"]
+        or drop["stash_peak_stashed"] == 1
+    ), (layout, drop)
+
+    def train(rec):
+        opt = SGD(0.01)
+        stacked, flags = E.init_stacked(spec, mesh)
+        ost = E.zero1_init_state(opt, spec, mesh) if zero1 else opt.init(stacked)
+        step = E.make_pipeline_step(
+            mesh, spec, progs[rec], B // dp // M, opt, zero1=zero1,
+            clip_norm=0.05, with_grad_norm=True, grad_bucket_bytes=gbb,
+        )
+        for i in range(2):
+            stacked, ost, loss, gnorm = step(
+                stacked, flags, ost, jnp.asarray(X[i]), jnp.asarray(Y[i])
+            )
+        return jax.device_get(stacked), float(loss), float(gnorm)
+
+    base_w, base_loss, base_gn = train(False)
+    w, loss, gn = train(True)
+    assert loss == base_loss, layout
+    assert gn == base_gn, layout
+    for a, b in zip(jax.tree.leaves(base_w), jax.tree.leaves(w)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=layout)
+
+
 KILL_RESUME_LAYOUTS = {
     # layout -> (killed-run session kwargs, resumed-run session kwargs) —
     # they differ only for the elastic case, which restores a dp=2 run's
@@ -405,6 +547,12 @@ KILL_RESUME_LAYOUTS = {
     "bsplit": (
         dict(pp=4, schedule="pipedream", backward_split=True, mubatches=4),
         dict(pp=4, schedule="pipedream", backward_split=True, mubatches=4),
+    ),
+    # activation recompute rides the same contract: the recompute tick is
+    # program structure, not state — snapshots hold logical params only
+    "recompute": (
+        dict(pp=4, schedule="gpipe", recompute=True, mubatches=4),
+        dict(pp=4, schedule="gpipe", recompute=True, mubatches=4),
     ),
     "elastic-dp2-to-dp4": (
         dict(dp=2, optimizer="momentum"),
@@ -444,9 +592,12 @@ def session_data_dir(tmp_path_factory):
     [
         # the elastic restores run two full sessions each and are the
         # slowest legs — exotic layouts ride the slow tier (1-core wall
-        # budget); the same-layout legs keep tier-1 coverage
+        # budget); the same-layout legs keep tier-1 coverage. The
+        # recompute leg rides slow too: checkpoints are recompute-
+        # agnostic by construction and make recompute-smoke drives the
+        # same parity end to end
         pytest.param(lay, marks=pytest.mark.slow)
-        if lay.startswith("elastic")
+        if lay.startswith("elastic") or lay == "recompute"
         else lay
         for lay in sorted(KILL_RESUME_LAYOUTS)
     ],

@@ -201,7 +201,16 @@ def test_corrupt_checkpoint_bytes_deterministic(tmp_path):
 
 
 @pytest.mark.parametrize(
-    "kw", [dict(), dict(dp=2, pp=2, schedule="gpipe")], ids=["seq", "dp2pp2"]
+    "kw",
+    [
+        dict(),
+        # the dp2pp2 leg rides the slow tier (1-core wall budget);
+        # make recovery-smoke drives chunked dispatch on a mesh
+        pytest.param(
+            dict(dp=2, pp=2, schedule="gpipe"), marks=pytest.mark.slow
+        ),
+    ],
+    ids=["seq", "dp2pp2"],
 )
 def test_train_steps_chunked_is_bitwise_identical_to_epochs(data_dir, kw):
     """The preemption-safe unit's correctness: dispatching an epoch in
@@ -233,6 +242,7 @@ def test_train_steps_chunked_is_bitwise_identical_to_epochs(data_dir, kw):
         chunked.train_steps(0)
 
 
+@pytest.mark.slow  # 1-core wall budget; make recovery-smoke drives this end to end
 def test_kill_and_resume_bitwise_equals_uninterrupted(data_dir, tmp_path):
     """The headline contract, session level: inject a die at step 5 of 8,
     resume from the surviving snapshots, and the final hash is bitwise
@@ -578,6 +588,7 @@ def test_corrupt_buffer_breaks_checksum_deterministically():
         faults.corrupt_buffer({})
 
 
+@pytest.mark.slow  # 1-core wall budget; make recovery-smoke --async leg drives this end to end
 def test_async_kill_and_resume_bitwise_equals_uninterrupted(
     data_dir, tmp_path
 ):

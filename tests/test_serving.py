@@ -156,6 +156,7 @@ def test_engine_bitwise_equals_direct_predict(data_dir):
         assert req.slo_ok(10_000) is True
 
 
+@pytest.mark.slow  # 1-core wall budget; make tp-smoke + serve-smoke drives this end to end
 def test_engine_serves_tensor_parallel_layout(data_dir):
     """Serving under TP (satellite of the tp lattice): the rung programs
     route through the Megatron-sharded layers — strict audit enforces the

@@ -332,6 +332,7 @@ def test_find_newer_good_watcher_helper(data_dir, tmp_path):
     assert "corrupt" in skipped[0][1] or "checksum" in skipped[0][1]
 
 
+@pytest.mark.slow  # 1-core wall budget; make chaos-smoke drives this end to end
 def test_hot_reload_bitwise_parity_and_zero_recompiles(data_dir, tmp_path):
     """The reload contract: the queue is untouched, every response after
     the swap is bitwise-equal to a direct predict() under the NEW weights,
@@ -585,6 +586,7 @@ def test_serve_cli_degraded_exit_code(data_dir, capsys):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # 1-core wall budget; make chaos-smoke drives this end to end
 def test_chaos_soak_invariants(data_dir, tmp_path):
     """The make chaos-smoke contract in miniature: die/slow/nan/error +
     one mid-traffic watcher reload; zero silently-lost requests, bitwise

@@ -119,6 +119,30 @@ def main():
         "bubble fraction the report/show_schedule quote (see "
         "docs/performance.md for when it pays)",
     )
+    ap.add_argument(
+        "--model",
+        choices=["mnist-mlp", "mlp-wide", "mlp-deep", "transformer"],
+        default=None,
+        help="model-zoo configuration (model.MODEL_ZOO): a named (sizes, "
+        "activation family) pair. 'mnist-mlp' is the reference 8-layer "
+        "ReLU MLP (the default sizes); 'mlp-wide'/'mlp-deep' are compute-"
+        "bound ReLU MLPs (512x6 / 2048x22) that unmask the scheduling "
+        "wins CPU dispatch overhead hides on the tiny reference; "
+        "'transformer' is the gelu-family block model (x @ W_up -> gelu "
+        "-> @ W_down + residual per slot pair, Megatron-parity sharding). "
+        "All zoo models keep the 784-wide MNIST input",
+    )
+    ap.add_argument(
+        "--recompute",
+        action="store_true",
+        help="pipeline schedules: activation recompute — forwards stash "
+        "only the stage INPUT, and the stage forward re-runs inside the "
+        "backward tick (OP_RECOMPUTE), shrinking the activation-stash "
+        "lifetime from fwd->bwd to recompute->bwd (peak stash slots drop "
+        "to 1 on gpipe/pipedream; ~4/3 FLOPs tax — see docs/lowering.md "
+        "and docs/performance.md for when it pays). Bitwise-identical "
+        "weights vs stashed training; mesh layouts only, not interleaved",
+    )
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--no-eval", action="store_true", help="skip per-epoch accuracy")
     ap.add_argument(
@@ -400,6 +424,18 @@ def main():
             "--runtime mpmd needs a mesh layout (dp/pp/tp > 1): the "
             "sequential path has no pipeline stages to decompose"
         )
+    if args.recompute and (args.dp, args.pp, args.tp) == (1, 1, 1):
+        ap.error(
+            "--recompute drops pipeline activation stashes; the "
+            "sequential path holds no cross-tick stash — use a mesh "
+            "layout (dp/pp/tp > 1)"
+        )
+    if args.recompute and args.virtual_stages > 1:
+        ap.error(
+            "--recompute is not supported with interleaved virtual "
+            "stages (the chunked stash rotation is its own lifetime "
+            "discipline)"
+        )
     # "plan is active" mirrors faults.FaultPlan.parse: any non-empty
     # comma-separated part is an injection (checked without importing the
     # package — argparse time stays jax-free)
@@ -425,6 +461,7 @@ def main():
             metrics=metrics,
             health=args.health,
             audit=args.audit,
+            model=args.model,
             dp=args.dp,
             pp=args.pp,
             tp=args.tp,
@@ -445,6 +482,7 @@ def main():
             zero1=args.zero1,
             grad_bucket_bytes=args.grad_bucket_bytes,
             backward_split=args.backward_split,
+            recompute=args.recompute,
             scan_unroll=args.scan_unroll,
             tick_unroll=args.tick_unroll,
             weight_decay=args.weight_decay,
