@@ -11,6 +11,7 @@ SHELL := /bin/bash
         diverge-smoke \
         aot-smoke serve-smoke chaos-smoke alerts-smoke fleet-smoke trace-smoke \
         mpmd-smoke bench-mpmd replay-smoke recompute-smoke \
+        zero-smoke bench-zero \
         bench-serving bench-ckpt-aot data train train-mesh bench \
         bench-scaling schedules clean
 
@@ -693,6 +694,49 @@ recompute-smoke:
 	done
 	python -c "from shallowspeed_tpu import schedules as S; from shallowspeed_tpu.parallel.lowering import lower_schedule; from shallowspeed_tpu.analysis.stash import assert_recompute_peak_drop; [print(n, assert_recompute_peak_drop(lower_schedule(c, 4, 4, backward_split=b), lower_schedule(c, 4, 4, backward_split=b, recompute=True))) for n, c, b in (('gpipe', S.GPipeSchedule, False), ('pipedream-split', S.PipeDreamFlushSchedule, True))]"
 	@echo "recompute-smoke OK: recompute hashes bitwise-equal to stashed twins on gpipe + split pipedream, census clean, measured stash peak strictly below the stashed twin's, Memory section rendered"
+
+# ZeRO-2/3 end-to-end: CPU epochs at --zero 2 and --zero 3 with --audit
+# (train.py aborts nonzero if the compiled census violates the per-stage
+# comms contract — per-tick reduce-scatter, ZeRO-3's JIT gather floor),
+# the fixed-layout hash pin (--zero 2 final hash == --zero 1 at
+# --mubatches 1: one scatter contribution per shard element, so the
+# per-tick psum_scatter value IS the psum chunk), and the report's
+# ZeRO-forecast row rendering per-stage headroom + the stage ladder
+zero-smoke:
+	rm -rf /tmp/zsmoke; mkdir -p /tmp/zsmoke
+	python -c "import numpy as np; from pathlib import Path; d=Path('/tmp/zsmoke/data'); d.mkdir(parents=True); rng=np.random.RandomState(0); [(np.save(d/('x_'+s+'.npy'), rng.rand(n,784).astype(np.float32)), np.save(d/('y_'+s+'.npy'), np.eye(10,dtype=np.float32)[rng.randint(0,10,n)])) for s,n in (('train',256),('val',96))]"
+	set -e; COMMON="--data-dir /tmp/zsmoke/data --epochs 1 --global-batch-size 32 --no-eval --dp 2 --pp 2 --schedule gpipe --optimizer momentum"; \
+	$(CPU_MESH) python train.py $$COMMON --mubatches 1 --zero 1 \
+	    > /tmp/zsmoke/z1.out; \
+	$(CPU_MESH) python train.py $$COMMON --mubatches 1 --zero 2 \
+	    > /tmp/zsmoke/z2pin.out; \
+	$(CPU_MESH) python train.py $$COMMON --mubatches 4 --zero 2 --audit \
+	    --metrics-out /tmp/zsmoke/z2.jsonl > /tmp/zsmoke/z2.out; \
+	$(CPU_MESH) python train.py $$COMMON --mubatches 4 --zero 3 --audit \
+	    --metrics-out /tmp/zsmoke/z3.jsonl > /tmp/zsmoke/z3.out; \
+	h1=$$(grep -o 'final model hash: [0-9a-f]*' /tmp/zsmoke/z1.out); \
+	h2=$$(grep -o 'final model hash: [0-9a-f]*' /tmp/zsmoke/z2pin.out); \
+	test -n "$$h1" && test "$$h1" = "$$h2" \
+	    || { echo "zero2 HASH MISMATCH [$$h2] vs zero1 [$$h1] at mubatches=1"; exit 1; }; \
+	echo "zero2 hash == zero1 hash at the fixed layout (mubatches=1)"; \
+	for f in /tmp/zsmoke/z2 /tmp/zsmoke/z3; do \
+	  python -c "import json,sys; p=sys.argv[1]; recs=[json.loads(l) for l in open(p) if l.strip()]; a=[r for r in recs if r.get('kind')=='xla_audit']; assert a, p+': no xla_audit record'; assert all(r.get('census_ok') for r in a), p+': census mismatch'; exp=[r for r in a if r.get('name')=='epoch_program'][-1]['expected']; zf=exp['zero_forecast']['stages']; assert zf['2']['total_bytes'] < zf['1']['total_bytes'], p+': stage-2 forecast not below stage-1'; dp=exp['axes']['dp']; assert dp['scatter_schedule']=='per_tick', p+': no per-tick scatter schedule'; print(p+': census clean, zero stage '+str(dp['zero'])+' per-tick scatter contract enforced')" $$f.jsonl; \
+	  python -m shallowspeed_tpu.observability.report $$f.jsonl --format md \
+	      > $$f.report.md; \
+	  grep -q "ZeRO forecast" $$f.report.md; \
+	  grep -q "headroom" $$f.report.md; \
+	  grep -q "stage ladder" $$f.report.md; \
+	done
+	grep -q "ZeRO stage 2" /tmp/zsmoke/z2.report.md
+	grep -q "JIT param gather" /tmp/zsmoke/z3.report.md
+	@echo "zero-smoke OK: zero2/zero3 census clean, mubatches=1 hash pin holds, ZeRO forecast + stage ladder + per-stage comms rendered"
+
+# the ZeRO memory scoreboard (same-window zero1/zero2/zero3 epochs on the
+# compute-bound flagship zoo model at dp2 and dp2 x pp2, measured
+# peak_hbm_bytes ladder + analytical forecast + the mubatches=1 hash
+# pin) — writes ZERO_r01.json at the repo root
+bench-zero:
+	$(CPU_MESH) python scripts/bench_zero.py
 
 # the MPMD-vs-lockstep scoreboard (same-window epoch pair, dispatch-probe
 # pair, serving burst p99) — writes MPMD_r01.json on the flagship data

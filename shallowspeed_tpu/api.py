@@ -103,6 +103,7 @@ class TrainingSession:
         momentum=0.9,
         virtual_stages=1,
         zero1=False,
+        zero=None,
         grad_bucket_bytes=0,
         backward_split=False,
         recompute=False,
@@ -261,11 +262,55 @@ class TrainingSession:
                 "tick_unroll unrolls the pipeline tick loop; the sequential "
                 "path has no ticks — use scan_unroll"
             )
-        self._zero1 = bool(zero1)
-        if self._zero1 and self._sequential:
+        # the dp-axis ZeRO stage (arXiv 2004.13336): ``zero`` in {0,1,2,3}
+        # supersedes the historical ``zero1`` boolean — ``zero=1`` IS the
+        # zero1 path, verbatim. Stage 2 shards gradients + optimizer state
+        # (block-cyclic per-slot layout, bitwise-equal weights to stage 1
+        # on clip-free runs); stage 3 additionally shards the params at
+        # rest with just-in-time per-tick gathers.
+        if zero is None:
+            zero = 1 if zero1 else 0
+        else:
+            zero = int(zero)
+            if zero not in (0, 1, 2, 3):
+                raise ValueError(f"zero must be one of 0/1/2/3, got {zero}")
+            if zero1 and zero != 1:
+                raise ValueError(
+                    f"conflicting dp-stage selectors: zero1=True but "
+                    f"zero={zero} — pass only --zero"
+                )
+        self._zero = zero
+        self._zero1 = zero == 1
+        # ZeRO-3 eval view: the {W, b} stacked layout rebuilt from the
+        # at-rest shards for inference programs, cached by identity
+        self._eval_stacked_cache = None
+        if self._zero and self._sequential:
+            if self._zero1:
+                raise ValueError(
+                    "zero1 shards the optimizer update over the dp mesh "
+                    "axis; the sequential path has no mesh — use dp/pp > 1"
+                )
             raise ValueError(
-                "zero1 shards the optimizer update over the dp mesh axis; "
+                f"zero={zero} shards the update over the dp mesh axis; "
                 "the sequential path has no mesh — use dp/pp > 1"
+            )
+        if self._zero >= 2 and digests:
+            raise ValueError(
+                "digests read the zero1 flat-chunk segment map; the "
+                "block-cyclic shard layout of zero>=2 has no flat chunk — "
+                "use --zero 1 or below with --digests"
+            )
+        if self._zero == 3 and kernel_backend == "pallas":
+            raise ValueError(
+                "zero=3 all-gathers parameter segments inside every tick "
+                "branch; the fused pallas flag kernels take whole resident "
+                "slots — use kernel_backend='xla' with --zero 3"
+            )
+        if self._zero == 3 and grad_bucket_bytes:
+            raise ValueError(
+                "zero=3 syncs gradients per tick (one reduce-scatter per "
+                "layer slot inside the scan); grad_bucket_bytes shapes the "
+                "tail sync only and has nothing to bucket at stage 3"
             )
         if grad_bucket_bytes is None:
             grad_bucket_bytes = 0
@@ -349,11 +394,12 @@ class TrainingSession:
                     "stage; the sequential path has no stages — use a mesh "
                     "layout (dp/pp/tp > 1)"
                 )
-            if self._zero1:
+            if self._zero:
                 raise ValueError(
-                    "runtime='mpmd' does not support zero1 yet: the ZeRO-1 "
-                    "reduce-scatter/all-gather update spans the whole flat "
-                    "param vector, not one stage — use runtime='lockstep'"
+                    f"runtime='mpmd' does not support zero (stage "
+                    f"{self._zero}) yet: the ZeRO reduce-scatter/all-gather "
+                    "update spans the whole sharded param layout, not one "
+                    "stage — use runtime='lockstep'"
                 )
             if grad_bucket_bytes:
                 raise ValueError(
@@ -699,6 +745,7 @@ class TrainingSession:
                 mubatches=mubatches, lr=lr, precision=precision,
                 optimizer=optimizer, momentum=momentum,
                 virtual_stages=virtual_stages, zero1=zero1,
+                zero=self._zero,
                 grad_bucket_bytes=grad_bucket_bytes,
                 backward_split=backward_split, recompute=recompute,
                 scan_unroll=scan_unroll,
@@ -817,13 +864,32 @@ class TrainingSession:
                     "pipeline.bubble_fraction", stats["bubble_fraction"]
                 )
             with self._metrics.span("device_put"):
-                self._stacked, self._flags = E.put_stacked(
-                    *E.stack_params(
-                        host_params, self.spec, order=self._order, tp=self.tp
-                    ),
-                    self.mesh,
+                stacked_np, flags_np = E.stack_params(
+                    host_params, self.spec, order=self._order, tp=self.tp
                 )
-            if self._zero1:
+                if self._zero == 3:
+                    # ZeRO-3 params at rest: one (pp*tp, dp*csz3)
+                    # block-cyclic array, each device holding only its own
+                    # 1/dp shard — the {W,b} stacked layout never lands on
+                    # device (predict/save rebuild it on demand)
+                    self._stacked = {
+                        "P": jax.device_put(
+                            E.zero_block_flatten_rows(
+                                stacked_np, self.spec, self.mesh
+                            ),
+                            E.zero1_part_sharding(self.mesh),
+                        )
+                    }
+                    self._flags = E.put_pp(flags_np, self.mesh)
+                else:
+                    self._stacked, self._flags = E.put_stacked(
+                        stacked_np, flags_np, self.mesh
+                    )
+            if self._zero >= 2:
+                self._opt_state = E.zero_block_state_from_logical(
+                    host_opt_state, opt, self.spec, self.mesh, order=self._order
+                )
+            elif self._zero1:
                 self._opt_state = E.zero1_state_from_logical(
                     host_opt_state, opt, self.spec, self.mesh, order=self._order
                 )
@@ -873,7 +939,7 @@ class TrainingSession:
             else:
                 self._epoch_fn = E.make_pipeline_epoch(
                     self.mesh, self.spec, prog, local_batch // mubatches, opt,
-                    precision=self.precision, zero1=self._zero1,
+                    precision=self.precision, zero=self._zero,
                     unroll=scan_unroll, tick_unroll=tick_unroll,
                     clip_norm=clip_norm, kernel_backend=kernel_backend,
                     with_grad_norm=self._epoch_aux,
@@ -885,7 +951,7 @@ class TrainingSession:
             self._mubatch_local = local_batch // mubatches
             self._run_kwargs = dict(
                 precision=self.precision, unroll=scan_unroll,
-                tick_unroll=tick_unroll, zero1=self._zero1,
+                tick_unroll=tick_unroll, zero=self._zero,
                 clip_norm=clip_norm, kernel_backend=kernel_backend,
                 grad_bucket_bytes=grad_bucket_bytes,
             )
@@ -927,7 +993,7 @@ class TrainingSession:
         self._sync_plan = None
         if grad_bucket_bytes and not self._sequential:
             self._sync_plan = gradsync.plan_buckets(
-                self.spec, dp, pp, grad_bucket_bytes, zero1=self._zero1,
+                self.spec, dp, pp, grad_bucket_bytes, zero=self._zero,
                 tp=self.tp,
             )
             if self._metrics.enabled:
@@ -936,19 +1002,24 @@ class TrainingSession:
                 # later throughput/audit record self-describing
                 self._metrics.event(
                     "grad_sync_plan", dp=dp, pp=pp, tp=self.tp,
-                    zero1=self._zero1, **self._sync_plan.describe(),
+                    zero=self._zero, **self._sync_plan.describe(),
                 )
         self._expected_comms = program_audit.expected_comms(
             self.spec,
             dp,
             pp,
             prog=None if self._sequential else self._prog,
-            zero1=self._zero1,
+            zero=self._zero,
             mubatch_size=None if self._sequential else self._mubatch_local,
             platform=platform,
             precision=self._precision_name,
             grad_bucket_plan=self._sync_plan,
             tp=self.tp,
+            # only params-mirroring parts occupy per-layer bytes (Adam's
+            # "t" is a scalar) — the forecast prices what actually shards
+            opt_state_parts=sum(
+                1 for v in opt.state_layout().values() if v == "params"
+            ),
         )
         if self._recovery is not None and self._metrics.enabled:
             # one schema-v4 recovery record per resume decision: what was
@@ -1268,7 +1339,7 @@ class TrainingSession:
             raise program_audit.AuditMismatchError(
                 f"{program}: compiled collective census disagrees with the "
                 f"layout contract (dp={self.dp}, pp={self.pp}, "
-                f"zero1={self._zero1}): " + "; ".join(rec["mismatches"])
+                f"zero={self._zero}): " + "; ".join(rec["mismatches"])
             )
         self._audit_done.add(dedup)
 
@@ -2135,7 +2206,9 @@ class TrainingSession:
                     xb.reshape(rung, S_rows, -1), self.dp
                 )
                 preds = serving_slots.unpack_slots(
-                    np.asarray(step(self._stacked, self._flags, jnp.asarray(packed))),
+                    np.asarray(
+                        step(self._eval_stacked(), self._flags, jnp.asarray(packed))
+                    ),
                     rung,
                     self.dp,
                 )
@@ -2179,6 +2252,26 @@ class TrainingSession:
         return lower_schedule(
             S.InferenceSchedule, mubatches, self.pp, training=False
         )
+
+    def _eval_stacked(self):
+        """The {W, b} stacked params the forward-only programs consume.
+        Identity on every layout except ZeRO-3, where params at rest are
+        per-rank block-cyclic shards: the eval view is rebuilt on host
+        (one gather) and cached by the live array's identity — a weight
+        update invalidates it, repeat dispatches between updates reuse it
+        (same pattern as the MPMD inference view cache)."""
+        if self._zero != 3:
+            return self._stacked
+        cached = self._eval_stacked_cache
+        if cached is not None and cached[0] is self._stacked:
+            return cached[1]
+        host = E.zero_block_unflatten_rows(
+            np.asarray(jax.device_get(self._stacked["P"])),
+            self.spec, self.mesh,
+        )
+        ev = E.put_stacked_tree(host, self.mesh)
+        self._eval_stacked_cache = (self._stacked, ev)
+        return ev
 
     def _inference_step(self, n_slots):
         """Cached inference program for a ladder rung of ``n_slots``
@@ -2231,14 +2324,14 @@ class TrainingSession:
                 # can serve a request
                 step, _ = self._aot_resolve(
                     f"inference_r{n_slots}", "inference_program", step,
-                    (self._stacked, self._flags, x_shape),
+                    (self._eval_stacked(), self._flags, x_shape),
                     expected=expected, dedup=("inference", n_slots),
                     dispatch=True,
                 )
             elif self._metrics.enabled or self._audit_strict:
                 with self._metrics.span("jit_compile"):
                     compiled = step.lower(
-                        self._stacked, self._flags, x_shape
+                        self._eval_stacked(), self._flags, x_shape
                     ).compile()
                 self._metrics.counter("jit_compiles")
                 self._record_audit(
@@ -2612,6 +2705,20 @@ class TrainingSession:
         with self._metrics.span("device_put"):
             if self._sequential:
                 self._params = jax.tree.map(jnp.asarray, host_params)
+            elif self._zero == 3:
+                # re-shard into the session's at-rest block-cyclic layout
+                stacked_np, _ = E.stack_params(
+                    host_params, self.spec, order=self._order, tp=self.tp
+                )
+                self._stacked = {
+                    "P": jax.device_put(
+                        E.zero_block_flatten_rows(
+                            stacked_np, self.spec, self.mesh
+                        ),
+                        E.zero1_part_sharding(self.mesh),
+                    )
+                }
+                self._eval_stacked_cache = None
             else:
                 # keep the session's existing flags array (identical
                 # content) — only the weight planes swap
@@ -2627,7 +2734,9 @@ class TrainingSession:
         return utils.model_hash(self.params())
 
     def assert_replicas_in_sync(self):
-        if not self._sequential:
+        if not self._sequential and self._zero != 3:
+            # ZeRO-3 keeps no dp-replicated params to cross-check: each
+            # rank owns a disjoint 1/dp shard at rest by construction
             utils.assert_dp_replicas_in_sync(self._stacked)
 
     def _snapshot_raw(self):
@@ -2652,6 +2761,13 @@ class TrainingSession:
         the async snapshot build — they cannot drift."""
         if self._sequential:
             return jax.device_get(raw_params)
+        if self._zero == 3:
+            # params at rest are one block-cyclic row plane — rebuild the
+            # stacked {W, b} layout on host before unstacking
+            raw_params = E.zero_block_unflatten_rows(
+                np.asarray(jax.device_get(raw_params["P"])),
+                self.spec, self.mesh,
+            )
         return E.unstack_params(raw_params, self.spec, order=self._order)
 
     def _logical_state_from_raw(self, raw_state):
@@ -2660,6 +2776,10 @@ class TrainingSession:
         as ``_logical_params_from_raw``). None stays None (stateless)."""
         if raw_state is None:
             return None
+        if self._zero >= 2:
+            return E.zero_block_state_to_logical(
+                raw_state, self._opt, self.spec, self.mesh, order=self._order
+            )
         if self._zero1:
             return E.zero1_state_to_logical(
                 raw_state, self._opt, self.spec, self.mesh, order=self._order

@@ -8,6 +8,15 @@ layer order, so a model trained DP=2 x PP=4 can be saved and resumed
 sequentially, or vice versa — the layout is a property of the run, not of
 the checkpoint.
 
+That principle is load-bearing for the ZeRO lattice (docs/performance.md):
+a run whose parameters, gradients and optimizer state live as per-rank
+block-cyclic shards (``--zero 2``/``3``) snapshots the SAME logical .npz
+as everyone else — the session rehydrates the full logical tree on save
+and re-deals it on load. Nothing layout-shaped touches disk, so elastic
+re-sharding is free: kill a zero2-dp2 run and resume it zero1-dp4, or a
+zero3-dp2 run sequentially, bitwise at restore
+(tests/test_recovery.py::test_kill_resume_elastic_resharding).
+
 Format: a single .npz (atomic rename on save) with arrays ``w{i}``/``b{i}``
 per global layer, optional optimizer-state arrays ``ow{i}``/``ob{i}`` in the
 same logical order (for stateful optimizers, e.g. momentum velocity), plus a

@@ -1184,6 +1184,50 @@ def _memory_lines(audit, md, stash=None):
                 al=format_bytes(mem.get("alias_size_in_bytes")),
             )
         )
+        # the per-stage ZeRO OOM forecast (program_audit.zero_peak_forecast):
+        # the params+grads+state ÷ dp residency claim, scored against the
+        # chip capacity next to the MEASURED compiled peak above
+        exp = audit.get("expected") or {}
+        zf = exp.get("zero_forecast")
+        if zf and not exp.get("inference"):
+            stage = str(exp.get("zero", 0))
+            stages = zf.get("stages") or {}
+            cur = stages.get(stage)
+            if cur:
+                line = (
+                    f"ZeRO forecast [stage {stage}]: "
+                    f"{format_bytes(cur['total_bytes'])}/device model state "
+                    f"(params {format_bytes(cur['params_bytes'])} + grads "
+                    f"{format_bytes(cur['grads_bytes'])} + opt state "
+                    f"{format_bytes(cur['state_bytes'])}"
+                )
+                if cur.get("transient_bytes"):
+                    line += (
+                        f" + {format_bytes(cur['transient_bytes'])} "
+                        "gathered-chunk transient"
+                    )
+                line += ")"
+                cap = audit.get("hbm_per_chip")
+                if cap:
+                    frac = cur["total_bytes"] / cap
+                    if frac > 1:
+                        line += (
+                            f" — OOM FORECAST: model state alone exceeds "
+                            f"{format_bytes(cap)}/chip"
+                        )
+                    else:
+                        line += (
+                            f" — {(1 - frac) * 100:.1f}% headroom of "
+                            f"{format_bytes(cap)}/chip"
+                        )
+                lines.append(line)
+                lines.append(
+                    "  stage ladder (model state/device): "
+                    + " -> ".join(
+                        f"z{k} {format_bytes(v['total_bytes'])}"
+                        for k, v in sorted(stages.items())
+                    )
+                )
     if stash:
         model = stash.get("model") or "mnist-mlp"
         speak = stash.get("stash_bytes_peak")
@@ -1267,6 +1311,41 @@ def _comms_lines(audit, md):
             line += " (" + " + ".join(parts) + ")"
         lines.append(line)
         dp_axis = (exp.get("axes") or {}).get("dp") or {}
+        stage = dp_axis.get("zero") or 0
+        if stage:
+            # the per-stage dp-traffic shape: the sharded stages replace
+            # the anchor all-reduce with gradient reduce-scatter (sharded
+            # result) + a deferred all-gather of the updated-param chunk;
+            # anchor zero-2 and zero-3 scatter PER TICK (one contribution
+            # per microbatch into the persistent shard), and stage 3 adds
+            # the JIT parameter-gather schedule on top
+            rs = dp_axis.get(
+                "reduce_scatter_bytes_per_step_per_device",
+                (dp_axis.get("bytes_per_step_per_device") or 0) / 2,
+            )
+            line = (
+                f"ZeRO stage {stage}: gradient reduce-scatter "
+                f"{format_bytes(rs)}/step/device"
+            )
+            sched = dp_axis.get("scatter_schedule")
+            if sched:
+                line += (
+                    f" ({sched} x {dp_axis.get('scatter_mubatches')} "
+                    "microbatches into the persistent 1/dp shard)"
+                )
+            else:
+                line += " (tail scatter; result is the 1/dp shard)"
+            gather = dp_axis.get("gather")
+            if gather:
+                line += (
+                    f" + JIT param gather {format_bytes(gather.get('bytes_per_step_per_device'))}"
+                    f"/step/device ({gather.get('schedule')}: "
+                    f"{gather.get('passes')} passes x "
+                    f"{gather.get('mubatches')} microbatches)"
+                )
+            else:
+                line += " + post-update param all-gather of the updated chunk"
+            lines.append(line)
         if dp_axis.get("mode") == "bucketed":
             # "budget", not "<=": a single leaf larger than the budget
             # gets its own oversized bucket (the planner never splits one)

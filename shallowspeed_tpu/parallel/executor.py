@@ -27,16 +27,31 @@ optimizer step — is ONE jitted XLA computation:
   lowering into former bubble ticks — finish the deferred wgrads from the
   activation + grad stashes, accumulating in the combined schedule's order
   so the fp sums (and the weight hash) are bit-identical;
-- the DP gradient sync after the tick loop has TWO modes
-  (``grad_bucket_bytes``): the legacy anchor — one ``jax.lax.psum`` of the
-  whole accumulated gradient pytree over ``dp`` — or byte-bucketed
-  collectives (parallel/gradsync.py): backward-ordered buckets of the
-  gradient tree, one all-reduce per bucket (``psum_scatter`` per bucket
-  under ZeRO-1), so XLA's latency-hiding scheduler can overlap bucket k's
-  communication with the consumers of already-synced buckets. This is the
-  reference's per-parameter Iallreduce engine (pipe.py:302-327) with the
-  bucketing its docstring wishes for; both modes are bitwise identical
-  (psum reduces elementwise per leaf);
+- the dp axis is a four-point memory lattice (``zero`` in {0, 1, 2, 3} —
+  arXiv 2004.13336's stages over this executor's stacked layout). Stage 0
+  (plain DP): one ``jax.lax.psum`` of the whole accumulated gradient
+  pytree over ``dp`` at the tail anchor, every replica repeats the full
+  update. Stage 1 (ZeRO-1): the tail reduce-scatters the FLAT gradient,
+  each replica updates its 1/dp chunk with its optimizer-state shard, and
+  one deferred all-gather rebuilds the params. Stage 2 (ZeRO-2): the tail
+  reduce-scatters PER LAYER SLOT straight from the accumulator slabs into
+  the block-cyclic shard layout below — the flat gradient concat never
+  materializes, the post-sync gradient lives only as this rank's shard,
+  and per-slot all-gathers rebuild the updated params. Stage 3 (ZeRO-3):
+  params REST in the block-cyclic shard and every tick branch all-gathers
+  just the active chunk's slots on demand (gathered copies die with the
+  branch), while the backward reduce-scatters each tick's slot gradients
+  immediately — peak live params is one stage chunk, not the model.
+  ``grad_bucket_bytes`` composes at stages 0-2: byte-bucketed collectives
+  (parallel/gradsync.py) split the anchor sync into backward-ordered
+  buckets, one collective each, so XLA's latency-hiding scheduler can
+  overlap bucket k's communication with the consumers of already-synced
+  buckets — the reference's per-parameter Iallreduce engine
+  (pipe.py:302-327) with the bucketing its docstring wishes for. Stages
+  0-2 are bitwise identical to each other modulo norm-scalar
+  reassociation (elementwise collectives; see the ZeRO sections below);
+  stage 3's per-tick sync reassociates the microbatch/replica sum order
+  and carries the standard cross-layout tolerance instead;
 - the optimizer step happens on-device on the padded params (padded regions
   receive exactly-zero gradients, so they stay zero — see tests);
 - on a mesh with a ``tp`` axis (parallel/mesh.py, ``--tp``), every slot's
@@ -61,6 +76,7 @@ stack time).
 """
 
 from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -621,6 +637,272 @@ def zero1_state_from_logical(logical, opt, spec: ModelSpec, mesh: Mesh, order=No
 
 
 # ---------------------------------------------------------------------------
+# ZeRO-2/3: the block-cyclic per-slot shard layout over dp
+# ---------------------------------------------------------------------------
+#
+# ZeRO-1 shards only the optimizer STATE: the program still concatenates the
+# full flat gradient (gvec) and the full flat params (pvec) before the one
+# reduce-scatter / chunk-slice, so three flat-sized temporaries coexist at
+# the tail. The higher stages kill those temporaries by making the shard
+# layout PER LAYER SLOT instead of per flat vector:
+#
+#   every slot (V virtual rows of sz elements; W slots then b slots, the
+#   same order as the flat layout) pads each row to dp*k columns
+#   (k = ceil(sz/dp)) and deals column-block d to dp rank d. Rank d's local
+#   shard is the concatenation over slots of its (V, k) blocks flattened
+#   v-major — csz3 = sum_slots V*k elements per rank.
+#
+# Why block-cyclic and not the zero1 flat chunking: a slot's gradient slab
+# (V, sz) reduce-scatters DIRECTLY into this layout (pad the row, deal the
+# column blocks — one collective per slot, no flat concat), and a single
+# row's gradient reduce-scatters into ONE (k,) segment of the shard — which
+# is what lets ZeRO-3 sync per tick from inside the scan. The column-block
+# deal is exactly the (dp, chunk) column view ``gradsync.
+# psum_scatter_bucketed`` already emits, so byte-bucket plans compose
+# (mode "zero2": ranges within a slot's [0, V*k) columns).
+#
+# ZeRO-2 = params still replicated (stacked {W, b} as ever) + gradients
+# reduce-scattered per slot at the tail anchor + optimizer state sharded in
+# this layout. Elementwise collectives: each element's dp-sum lands with
+# identical bits wherever it is scattered, so ZeRO-2 weights are BITWISE
+# equal to ZeRO-1's at a fixed layout for elementwise optimizer math (the
+# clip/grad-norm scalar partitions its partial sums differently — pin
+# bitwise equality on clip-free runs).
+#
+# ZeRO-3 = params AT REST in this layout ({"P": (pp*tp, dp*csz3)} under
+# ``zero1_part_spec``) — each tick branch all-gathers just the active
+# chunk's slot segments (under tp only the 1/tp local shard, since the
+# layout is built from tp-local slot shapes), uses them, and lets them die
+# with the branch; the backward reduce-scatters each tick's slot gradients
+# immediately into the persistent (csz3,) gradient shard. The per-tick sync
+# reassociates the microbatch/replica sum order (sum_m sum_d vs the slab
+# path's sum_d sum_m), hence ZeRO-3's tolerance-not-bitwise contract.
+#
+# Host helpers below transform between the flat device rows (the zero1
+# layout) and the block-cyclic rows, so checkpoints stay logical and
+# layout-independent.
+
+
+class ZeroSlot(NamedTuple):
+    """One layer slot's geometry in the block-cyclic dp-shard layout."""
+
+    kind: str  # "W" | "b"
+    layer: int  # slot index within its kind
+    rows: int  # V virtual chunk rows
+    shape: tuple  # per-row tp-LOCAL shape: (o, i) W shard or (o,) b shard
+    sz: int  # elements per row = prod(shape)
+    k: int  # per-dp-rank columns = ceil(sz / dp)
+    off: int  # start within a rank's csz3 block (cumulative V*k)
+    flat_off: int  # start within the flat layout (cumulative V*sz)
+
+
+def zero_block_slots(spec: ModelSpec, pp: int, dp: int, tp: int = 1):
+    """(slots, csz3): the per-slot block-cyclic geometry and the per-rank
+    shard length. Slot order == the flat layout's (every W slot then every
+    b slot), so ``flat_off`` walks ``stacked_flat_len`` exactly."""
+    dims = slot_shapes(spec, tp)
+    V = spec.n_stages // pp
+    slots = []
+    off = flat_off = 0
+    for l, (o, i) in enumerate(dims):
+        if tp == 1:
+            shape = (o, i)
+        elif l % 2 == 0:  # column-parallel slot: out-dim sharded
+            shape = (o // tp, i)
+        else:  # row-parallel slot: in-dim sharded
+            shape = (o, i // tp)
+        sz = shape[0] * shape[1]
+        k = -(-sz // dp)
+        slots.append(ZeroSlot("W", l, V, shape, sz, k, off, flat_off))
+        off += V * k
+        flat_off += V * sz
+    for l, (o, _) in enumerate(dims):
+        sz = o // tp
+        k = -(-sz // dp)
+        slots.append(ZeroSlot("b", l, V, (sz,), sz, k, off, flat_off))
+        off += V * k
+        flat_off += V * sz
+    return tuple(slots), off
+
+
+def zero_block_len(spec: ModelSpec, mesh: Mesh):
+    """(flat_len, csz3): the flat per-device param count and the
+    block-cyclic per-dp-rank shard length (>= ceil(flat/dp); per-slot
+    padding rounds each slot separately)."""
+    slots, csz3 = zero_block_slots(
+        spec, mesh.shape["pp"], mesh.shape["dp"], mesh_tp(mesh)
+    )
+    return slots[-1].flat_off + slots[-1].rows * slots[-1].sz, csz3
+
+
+def _zb_scatter_rows(g2d, dp, k):
+    """(V, sz) slot rows -> the (dp, V*k) per-rank column-block deal: pad
+    each row to dp*k, deal column block d to output row d (row v lands
+    v-major at columns [v*k, (v+1)*k) of its rank). Works on numpy or jnp
+    arrays (pure reshape/transpose)."""
+    V, sz = g2d.shape
+    mod = np if isinstance(g2d, np.ndarray) else jnp
+    pad = mod.pad(g2d, ((0, 0), (0, dp * k - sz)))
+    return pad.reshape(V, dp, k).transpose(1, 0, 2).reshape(dp, V * k)
+
+
+def _zb_unscatter_rows(mat, V, k, sz):
+    """(dp, V*k) -> (V, sz): inverse of ``_zb_scatter_rows`` (drops the
+    per-row padding)."""
+    dp = mat.shape[0]
+    return (
+        mat.reshape(dp, V, k).transpose(1, 0, 2).reshape(V, dp * k)[:, :sz]
+    )
+
+
+def _zb_deal_view(g2d, dp, k):
+    """(V, sz) slot rows -> the (V, dp, k) deal VIEW: the same per-rank
+    column deal as ``_zb_scatter_rows`` but as a pad + reshape only —
+    element (v, d, j) is padded row v's column d*k+j, so a dp-collective
+    on axis 1 touches exactly the elements the (dp, V*k) layout's axis-0
+    collective does, without ever materializing the transposed full-slot
+    slab (the ZeRO-2 tail's peak-HBM discipline: live temporaries stay
+    shard-sized, not model-sized)."""
+    V, sz = g2d.shape
+    return jnp.pad(g2d, ((0, 0), (0, dp * k - sz))).reshape(V, dp, k)
+
+
+def _zero_block_rows_from_flat(flat_rows, slots, dp, csz3):
+    """Host-side: flat device rows (n_rows, >=flat_len) -> block-cyclic
+    rows (n_rows, dp*csz3), where columns [d*csz3, (d+1)*csz3) are rank d's
+    shard (so ``zero1_part_spec`` column-chunking lands each rank its own
+    block)."""
+    n_rows = flat_rows.shape[0]
+    out = np.zeros((n_rows, dp * csz3), np.float32)
+    for s in slots:
+        seg = flat_rows[:, s.flat_off : s.flat_off + s.rows * s.sz]
+        for r in range(n_rows):
+            mat = _zb_scatter_rows(
+                np.asarray(seg[r], np.float32).reshape(s.rows, s.sz), dp, s.k
+            )
+            for d in range(dp):
+                a = d * csz3 + s.off
+                out[r, a : a + s.rows * s.k] = mat[d]
+    return out
+
+
+def _zero_flat_from_block_rows(block_rows, slots, dp, csz3, flat):
+    """Host-side inverse of ``_zero_block_rows_from_flat``."""
+    n_rows = block_rows.shape[0]
+    out = np.zeros((n_rows, flat), np.float32)
+    for s in slots:
+        for r in range(n_rows):
+            mat = np.stack(
+                [
+                    block_rows[
+                        r, d * csz3 + s.off : d * csz3 + s.off + s.rows * s.k
+                    ]
+                    for d in range(dp)
+                ]
+            )
+            full = _zb_unscatter_rows(mat, s.rows, s.k, s.sz)
+            out[r, s.flat_off : s.flat_off + s.rows * s.sz] = full.reshape(-1)
+    return out
+
+
+def zero_block_flatten_rows(stacked_np, spec, mesh):
+    """Host-side: stacked {W,b} (numpy) -> (pp*tp, dp*csz3) block-cyclic
+    device rows, ready for ``zero1_part_sharding`` placement (the ZeRO-3
+    at-rest param layout)."""
+    dp = mesh.shape["dp"]
+    slots, csz3 = zero_block_slots(
+        spec, mesh.shape["pp"], dp, mesh_tp(mesh)
+    )
+    return _zero_block_rows_from_flat(
+        _zero1_flatten_rows(stacked_np, spec, mesh), slots, dp, csz3
+    )
+
+
+def zero_block_unflatten_rows(arr, spec, mesh):
+    """Host-side inverse: (pp*tp, dp*csz3) -> stacked {W,b} full global
+    arrays."""
+    dp = mesh.shape["dp"]
+    slots, csz3 = zero_block_slots(
+        spec, mesh.shape["pp"], dp, mesh_tp(mesh)
+    )
+    flat = stacked_flat_len(spec, mesh.shape["pp"], mesh_tp(mesh))
+    return _zero1_unflatten_rows(
+        _zero_flat_from_block_rows(arr, slots, dp, csz3, flat), spec, mesh
+    )
+
+
+def zero_block_init_state(opt, spec: ModelSpec, mesh: Mesh):
+    """Device-put initial ZeRO-2/3 optimizer state: like
+    ``zero1_init_state`` but columns are the block-cyclic csz3 shard."""
+    from shallowspeed_tpu.optimizer import is_stateless
+
+    _, csz3 = zero_block_len(spec, mesh)
+    if is_stateless(opt):
+        return ()
+    parts, scalars = _zero1_check_state(opt, csz3)
+    dp = mesh.shape["dp"]
+    n_rows = mesh.shape["pp"] * mesh_tp(mesh)
+    part_sh = zero1_part_sharding(mesh)
+    rep_sh = NamedSharding(mesh, P())
+    state = {
+        key: jax.device_put(
+            np.zeros((n_rows, dp * csz3), np.float32), part_sh
+        )
+        for key in parts
+    }
+    state.update(
+        {
+            key: jax.device_put(np.asarray(leaf, np.float32), rep_sh)
+            for key, leaf in scalars.items()
+        }
+    )
+    return state
+
+
+def zero_block_state_to_logical(state, opt, spec: ModelSpec, mesh: Mesh, order=None):
+    """ZeRO-2/3 state dict -> logical {"parts", "scalars"} (for
+    layout-independent checkpoints); None for stateless state."""
+    if isinstance(state, tuple) and state == ():
+        return None
+    layout = opt.state_layout()
+    parts, scalars = {}, {}
+    for key, kind in layout.items():
+        if kind == "params":
+            arr = np.asarray(jax.device_get(state[key]))
+            stacked = zero_block_unflatten_rows(arr, spec, mesh)
+            parts[key] = unstack_params(stacked, spec, order=order)
+        else:
+            scalars[key] = float(jax.device_get(state[key]))
+    return {"parts": parts, "scalars": scalars}
+
+
+def zero_block_state_from_logical(logical, opt, spec: ModelSpec, mesh: Mesh, order=None):
+    """Inverse: logical {"parts", "scalars"} dict -> device-put ZeRO-2/3
+    state."""
+    if logical is None:
+        return zero_block_init_state(opt, spec, mesh)
+    layout = opt.state_layout()
+    part_sh = zero1_part_sharding(mesh)
+    rep_sh = NamedSharding(mesh, P())
+    dp = mesh.shape["dp"]
+    slots, csz3 = zero_block_slots(
+        spec, mesh.shape["pp"], dp, mesh_tp(mesh)
+    )
+    state = {}
+    for key, kind in layout.items():
+        if kind == "params":
+            rows = _zero1_state_rows(logical["parts"][key], spec, mesh, order)
+            state[key] = jax.device_put(
+                _zero_block_rows_from_flat(rows, slots, dp, csz3), part_sh
+            )
+        else:
+            state[key] = jax.device_put(
+                np.asarray(logical["scalars"][key], np.float32), rep_sh
+            )
+    return state
+
+
+# ---------------------------------------------------------------------------
 # The tick-program step builder
 # ---------------------------------------------------------------------------
 
@@ -971,6 +1253,7 @@ def make_pipeline_step(
     jit=True,
     tick_unroll=1,
     zero1=False,
+    zero=None,
     clip_norm=None,
     kernel_backend="xla",
     with_grad_norm=False,
@@ -994,6 +1277,18 @@ def make_pipeline_step(
     above; opt_state must come from ``zero1_init_state``). Exact for
     elementwise optimizers; bit-identical math to the plain path up to
     collective reassociation.
+
+    ``zero``: the full dp-axis stage selector {0, 1, 2, 3} superseding the
+    ``zero1`` boolean (``zero=1`` IS the zero1 path, verbatim). Stage 2
+    keeps params replicated but reduce-scatters the gradient PER LAYER
+    SLOT into the block-cyclic shard layout (see the ZeRO-2/3 section
+    above) — the flat gradient/param concats never materialize; opt_state
+    must come from ``zero_block_init_state``. Stage 3 additionally shards
+    the params at rest: ``stacked`` becomes ``{"P": (pp*tp, dp*csz3)}``
+    under ``zero1_part_spec``, every tick branch all-gathers just the
+    active chunk's slot segments, and the backward reduce-scatters each
+    tick's gradients immediately (per-tick sync => the tolerance-not-
+    bitwise contract; stages 0-2 stay bitwise-comparable).
 
     ``clip_norm``: optional global-norm gradient clipping before the update.
     The norm is GLOBAL over every parameter of the model: the local squared
@@ -1063,6 +1358,29 @@ def make_pipeline_step(
     """
     if kernel_backend not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel_backend {kernel_backend!r}")
+    if zero is None:
+        zero = 1 if zero1 else 0
+    else:
+        zero = int(zero)
+        if zero1 and zero != 1:
+            raise ValueError(
+                f"conflicting dp-stage selectors: zero1=True but zero={zero}"
+            )
+    if zero not in (0, 1, 2, 3):
+        raise ValueError(f"zero must be one of 0/1/2/3, got {zero}")
+    zero1 = zero == 1  # the legacy flag IS stage 1 — that path is verbatim
+    if zero == 3 and kernel_backend == "pallas":
+        raise ValueError(
+            "zero=3 all-gathers parameter segments inside every tick "
+            "branch; the fused pallas flag kernels take whole resident "
+            "slots — use kernel_backend='xla' with --zero 3"
+        )
+    if zero == 3 and grad_bucket_bytes:
+        raise ValueError(
+            "zero=3 syncs gradients per tick (one reduce-scatter per layer "
+            "slot inside the scan); the grad_bucket_bytes knob shapes the "
+            "tail sync only and has nothing to bucket at stage 3"
+        )
     tp_n = mesh_tp(mesh)
     if tp_n > 1 and kernel_backend == "pallas":
         raise ValueError(
@@ -1129,20 +1447,47 @@ def make_pipeline_step(
         from shallowspeed_tpu.parallel import gradsync
 
         sync_plan = gradsync.plan_buckets(
-            spec, dp_n, P_, grad_bucket_bytes, zero1=zero1, tp=tp_n
+            spec, dp_n, P_, grad_bucket_bytes, zero=zero, tp=tp_n
         )
     else:
         sync_plan = None
-    if zero1:
+    if zero >= 2 and with_digests:
+        raise ValueError(
+            "with_digests reads the zero1 flat-chunk segment map; the "
+            "block-cyclic shard layout of zero>=2 has no flat chunk — "
+            "run digests at --zero 1 or below"
+        )
+    if zero >= 1:
         if not training:
-            raise ValueError("zero1 applies to training programs only")
+            if zero1:
+                raise ValueError("zero1 applies to training programs only")
+            raise ValueError(f"zero={zero} applies to training programs only")
         from shallowspeed_tpu.optimizer import is_stateless
 
-        z1_flat, z1_csz = zero1_flat_len(spec, mesh)
         z1_stateful = not is_stateless(opt)
+        if zero1:
+            z1_flat, z1_csz = zero1_flat_len(spec, mesh)
+            if z1_stateful:
+                _zero1_check_state(opt, z1_csz)
+        else:
+            zb_slots, zb_csz = zero_block_slots(
+                spec, mesh.shape["pp"], mesh.shape["dp"], tp_n
+            )
+            if z1_stateful:
+                _zero1_check_state(opt, zb_csz)
         if z1_stateful:
-            _zero1_check_state(opt, z1_csz)
             z1_layout = opt.state_layout()
+
+    # ZeRO-2/3 persistent gradient shard: the anchor zero-2 program and
+    # every zero-3 program accumulate the dp-summed gradient as this
+    # rank's (csz3,) block-cyclic shard, reduce-scattered per tick
+    # (canonical ZeRO-2 ordering: the shard sums microbatch-outer). A
+    # bucketed zero-2 plan keeps the full-slab accumulators and the
+    # byte-bucketed tail reduce-scatter instead — the overlap trade,
+    # which also stays bitwise equal to zero-1 at any microbatch count
+    # (the sharded accumulator's reassociated (dp x microbatch) sum is
+    # bitwise only at mubatches=1; see docs/performance.md).
+    shard_grads = zero == 3 or (zero == 2 and sync_plan is None)
 
     if with_digests:
         # the digest-grid builders (see the docstring): per-slot columns of
@@ -1251,8 +1596,14 @@ def make_pipeline_step(
     def per_device(stacked, flags, opt_state, x, y):
         # local views: stage axis is sharded to V rows per device on pp
         # (device-major interleaved order, so row v IS virtual chunk v)
-        WsV = stacked["W"]  # per slot (V, out_l, in_l)
-        bsV = stacked["b"]
+        if zero == 3:
+            # ZeRO-3: params at rest are this rank's block-cyclic shard;
+            # tick branches gather the active chunk's segments on demand
+            pshard = stacked["P"][0]  # (csz3,)
+            WsV = bsV = None
+        else:
+            WsV = stacked["W"]  # per slot (V, out_l, in_l)
+            bsV = stacked["b"]
         activeV = flags["active"]  # (V, L)
         reluV = flags["relu"]
         residualV = flags["residual"]  # (V, L); all-False for relu specs
@@ -1292,10 +1643,21 @@ def make_pipeline_step(
                     for w in mask_widths
                 ),
                 z=jnp.zeros((Ks + 1, mb_sz, D_out), jnp.float32),
-                gW=tuple(jnp.zeros((V, o, i), jnp.float32) for o, i in w_dims),
-                gb=tuple(jnp.zeros((V, w), jnp.float32) for w in b_widths),
                 loss=jnp.zeros((), jnp.float32),
             )
+            if shard_grads:
+                # ZeRO-2 (anchor) and ZeRO-3 accumulate the dp-summed
+                # gradient directly as this rank's persistent (csz3,)
+                # shard — reduce-scattered per tick, never as full
+                # (V, o, i) slabs: the stage's gradient-residency claim
+                carry.update(gz=jnp.zeros((zb_csz,), jnp.float32))
+            else:
+                carry.update(
+                    gW=tuple(
+                        jnp.zeros((V, o, i), jnp.float32) for o, i in w_dims
+                    ),
+                    gb=tuple(jnp.zeros((V, w), jnp.float32) for w in b_widths),
+                )
             if split:
                 # grad stash: per-slot effective output-grads, held from
                 # each B-input tick to its deferred B-weight tick (slots
@@ -1331,17 +1693,60 @@ def make_pipeline_step(
             load_in = row["li"][stage] == 1  # compute is the global stage 0 fwd
             is_head = row["ih"][stage] == 1  # compute is the global last stage
 
-            def chunk_params():
-                Ws = [pick(w, v) for w in WsV]
-                bs = [pick(b, v) for b in bsV]
+            def chunk_flags():
+                """The active chunk's flag rows — no weights, so branches
+                that never touch weights (split B-weight) emit no ZeRO-3
+                gathers."""
                 return (
-                    Ws,
-                    bs,
                     pick(activeV, v),
                     pick(reluV, v),
                     pick(residualV, v),
                     pick(head_maskV, v),
                 )
+
+            def chunk_weights():
+                """The active chunk's weights: resident-row picks at
+                stages 0-2; just-in-time per-slot all-gathers of this
+                chunk's shard segments at ZeRO-3 (gathered copies die with
+                the branch — peak live params is one chunk, not the
+                model)."""
+                if zero != 3:
+                    return [pick(w, v) for w in WsV], [pick(b, v) for b in bsV]
+                gathered = []
+                for s in zb_slots:
+                    if V == 1:
+                        seg = lax.slice_in_dim(pshard, s.off, s.off + s.k)
+                    else:
+                        seg = lax.dynamic_slice(
+                            pshard, (s.off + v * s.k,), (s.k,)
+                        )
+                    full = lax.all_gather(seg, "dp", axis=0, tiled=True)
+                    gathered.append(full[: s.sz].reshape(s.shape))
+                return gathered[:L], gathered[L:]
+
+            def chunk_params():
+                Ws, bs = chunk_weights()
+                return (Ws, bs) + chunk_flags()
+
+            def z3_scatter_grads(c, gW_d, gb_d):
+                """ZeRO-2/3 per-tick gradient sync: reduce-scatter each
+                slot's chunk-row gradient over dp and accumulate the (k,)
+                shard at this chunk's segment of the persistent gz."""
+                gz = c["gz"]
+                for s, g in zip(zb_slots, list(gW_d) + list(gb_d)):
+                    vec = jnp.pad(g.reshape(-1), (0, dp_n * s.k - s.sz))
+                    sh = lax.psum_scatter(
+                        vec, "dp", scatter_dimension=0, tiled=True
+                    )
+                    if V == 1:
+                        gz = gz.at[s.off : s.off + s.k].add(sh)
+                    else:
+                        a = s.off + v * s.k
+                        seg = lax.dynamic_slice(gz, (a,), (s.k,))
+                        gz = lax.dynamic_update_slice(gz, seg + sh, (a,))
+                c = dict(c)
+                c["gz"] = gz
+                return c
 
             def noop(c):
                 return c, zero_fwd, zero_bwd
@@ -1452,7 +1857,9 @@ def make_pipeline_step(
                         precision, kernel_backend, act=act, residual=residual,
                     )
                 c = dict(c)
-                if V == 1:
+                if shard_grads:
+                    c = z3_scatter_grads(c, gW_d, gb_d)
+                elif V == 1:
                     c["gW"] = tuple(a.at[0].add(d) for a, d in zip(c["gW"], gW_d))
                     c["gb"] = tuple(a.at[0].add(d) for a, d in zip(c["gb"], gb_d))
                 else:
@@ -1498,8 +1905,9 @@ def make_pipeline_step(
                 # split B-weight: wgrads from the two stashes, accumulated
                 # in lowering-enforced B-input order (bit-identical fp sums
                 # vs the combined schedule); frees both stash slots by
-                # overwrite-on-reuse — no messages in or out
-                _, _, active, _, _, _ = chunk_params()
+                # overwrite-on-reuse — no messages in or out. Flags only:
+                # wgrad never touches weights, so ZeRO-3 gathers nothing
+                active, _, _, _ = chunk_flags()
                 sr = row["sr"][stage]
                 gr = row["gr"][stage]
                 xs_r = tuple(buf[sr] for buf in c["xs"])
@@ -1513,7 +1921,9 @@ def make_pipeline_step(
                         active, dims, xs_r, geff_r, precision
                     )
                 c = dict(c)
-                if V == 1:
+                if shard_grads:
+                    c = z3_scatter_grads(c, gW_d, gb_d)
+                elif V == 1:
                     c["gW"] = tuple(a.at[0].add(d) for a, d in zip(c["gW"], gW_d))
                     c["gb"] = tuple(a.at[0].add(d) for a, d in zip(c["gb"], gb_d))
                 else:
@@ -1556,6 +1966,137 @@ def make_pipeline_step(
         # loss was only accumulated on head-stage ticks (zero elsewhere)
         loss = lax.psum(carry["loss"], "dp")
         loss = lax.pmax(loss, "pp")  # replicate scalar across devices
+
+        if zero >= 2:
+            # ZeRO-2/3 tail: the dp-summed gradient lives as this rank's
+            # block-cyclic (csz3,) shard. The anchor zero-2 program and
+            # every zero-3 program accumulated it per tick (shard_grads);
+            # a bucketed zero-2 plan reduce-scatters its full-slab
+            # accumulators HERE, one byte-bucket at a time (elementwise
+            # over the same (dp, chunk) column deal, so the bucketed
+            # shard is zero-1's update input, bitwise).
+            if shard_grads:
+                gsh = carry["gz"]
+            else:
+                mats = [
+                    _zb_scatter_rows(g.reshape(s.rows, s.sz), dp_n, s.k)
+                    for s, g in zip(
+                        zb_slots, list(carry["gW"]) + list(carry["gb"])
+                    )
+                ]
+                # byte-bucketed: one collective per (slot, column
+                # range) bucket in backward emission order; the
+                # reassembled shard is the anchor's column deal, bitwise
+                pieces = [[] for _ in zb_slots]
+                for si, a, b in sync_plan.buckets:
+                    pieces[si].append(
+                        (
+                            a,
+                            lax.psum_scatter(
+                                mats[si][:, a:b],
+                                "dp",
+                                scatter_dimension=0,
+                                tiled=False,
+                            ),
+                        )
+                    )
+                gsh = jnp.concatenate(
+                    [
+                        p
+                        for ps in pieces
+                        for _, p in sorted(ps, key=lambda t: t[0])
+                    ]
+                )
+            if with_grad_norm:
+                # shards partition the dp-summed gradient across every
+                # sharded axis; per-slot padding is exactly zero
+                gnorm = jnp.sqrt(lax.psum(jnp.sum(gsh * gsh), z1_axes))
+            if clip_norm is not None:
+                from shallowspeed_tpu.optimizer import clip_tree
+
+                gsh = clip_tree(
+                    gsh, clip_norm, lambda sq: lax.psum(sq, z1_axes)
+                )
+            if zero == 3:
+                pch = pshard
+            else:
+                # this rank's param chunk: the same per-slot column deal,
+                # sliced at the dp index on the deal VIEW — shard-sized
+                # temporaries, no transposed slab
+                d0 = lax.axis_index("dp")
+                pch = jnp.concatenate(
+                    [
+                        lax.dynamic_slice(
+                            _zb_deal_view(
+                                p.reshape(s.rows, s.sz), dp_n, s.k
+                            ),
+                            (0, d0, 0),
+                            (s.rows, 1, s.k),
+                        ).reshape(-1)
+                        for s, p in zip(
+                            zb_slots, list(stacked["W"]) + list(stacked["b"])
+                        )
+                    ]
+                )
+            if z1_stateful:
+                from shallowspeed_tpu.optimizer import join_state, split_state
+
+                chunk_state = join_state(
+                    opt,
+                    {k: opt_state[k][0] for k, kd in z1_layout.items() if kd == "params"},
+                    {k: opt_state[k] for k, kd in z1_layout.items() if kd == "scalar"},
+                )
+                new_ch, new_state = opt.apply(pch, gsh, chunk_state)
+                nparts, nscalars = split_state(opt, new_state)
+                opt_state = {k: v[None] for k, v in nparts.items()}
+                opt_state.update(nscalars)
+            else:
+                new_ch, _ = opt.apply(pch, gsh, ())
+            if zero == 3:
+                # params stay at rest in the shard layout; the next step's
+                # tick branches gather from the updated chunk
+                new_stacked = {"P": new_ch[None]}
+            else:
+                # per-slot all-gather of the updated chunks rebuilds the
+                # resident params: gathering on axis 1 of the (rows, 1, k)
+                # segment lands ranks straight into the deal view's
+                # (rows, dp, k) layout, so the inverse is a reshape +
+                # padding slice — no transposed slab
+                outW, outb = [], []
+                for s in zb_slots:
+                    seg = new_ch[s.off : s.off + s.rows * s.k].reshape(
+                        s.rows, 1, s.k
+                    )
+                    mat = lax.all_gather(seg, "dp", axis=1, tiled=True)
+                    full = mat.reshape(s.rows, dp_n * s.k)[:, : s.sz]
+                    (outW if s.kind == "W" else outb).append(
+                        full.reshape((s.rows,) + s.shape)
+                    )
+                new_stacked = {"W": tuple(outW), "b": tuple(outb)}
+            outs = (new_stacked, opt_state, loss)
+            if with_grad_norm:
+                outs += (gnorm,)
+            if with_step_stats:
+                if zero == 3:
+                    # chunk shards partition the params exactly (padding
+                    # is exactly zero), so the shard norm IS the logical
+                    # norm after the cross-axis psum
+                    outs += (
+                        jnp.sqrt(
+                            lax.psum(jnp.sum(new_ch * new_ch), z1_axes)
+                        ),
+                    )
+                else:
+                    from shallowspeed_tpu.optimizer import (
+                        global_norm as gnorm_of,
+                    )
+
+                    outs += (
+                        gnorm_of(
+                            new_stacked, lambda sq: lax.psum(sq, pp_axes)
+                        ),
+                    )
+            return outs
 
         if zero1:
             # ZeRO-1: reduce_scatter the flattened gradient over dp, update
@@ -1715,11 +2256,17 @@ def make_pipeline_step(
     pp = P("pp")
     dp_spec = P("dp")
     flags_specs = {"active": pp, "relu": pp, "residual": pp, "head_mask": pp}
-    stacked_specs = stacked_param_specs(tp_n, L)
+    if zero == 3:
+        # ZeRO-3 params at rest: one (pp*tp, dp*csz3) block-cyclic array,
+        # rows per (pp, tp) device, column-chunk per dp rank — the same
+        # spec the sharded optimizer state rides
+        stacked_specs = {"P": zero1_part_spec(tp_n)}
+    else:
+        stacked_specs = stacked_param_specs(tp_n, L)
 
     if training:
-        if zero1:
-            # ZeRO-1 state: one (pp[*tp], dp*chunk) array per 'params'
+        if zero >= 1:
+            # ZeRO-1/2/3 state: one (pp[*tp], dp*chunk) array per 'params'
             # part (row per (pp, tp) device, column-chunk per dp replica)
             # + replicated scalars; () for stateless optimizers
             state_specs = (
@@ -1828,6 +2375,7 @@ def make_pipeline_epoch(
     unroll=1,
     tick_unroll=1,
     zero1=False,
+    zero=None,
     clip_norm=None,
     kernel_backend="xla",
     with_grad_norm=False,
@@ -1851,12 +2399,15 @@ def make_pipeline_epoch(
     scalars on every layout); ``with_digests`` adds the per-step stacked
     digest grids under the aux's ``"digests"`` key (each leaf
     ``(num_batches, S, L)`` — see make_pipeline_step's digest contract);
+    ``zero`` selects the full dp-axis ZeRO stage {0..3} (supersedes the
+    ``zero1`` boolean; see make_pipeline_step — at stage 3 ``stacked`` is
+    the ``{"P"}`` shard layout throughout the epoch);
     ``grad_bucket_bytes`` selects the gradient-
     sync mode (0 = anchor collective, >0 = byte-bucketed — see
     make_pipeline_step)."""
     step = make_pipeline_step(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
-        tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
+        tick_unroll=tick_unroll, zero1=zero1, zero=zero, clip_norm=clip_norm,
         kernel_backend=kernel_backend, with_grad_norm=with_grad_norm,
         with_step_stats=with_step_stats, with_digests=with_digests,
         grad_bucket_bytes=grad_bucket_bytes,
@@ -1930,6 +2481,7 @@ def make_pipeline_run(
     unroll=1,
     tick_unroll=1,
     zero1=False,
+    zero=None,
     clip_norm=None,
     eval_prog=None,
     eval_mubatch_size=None,
@@ -1960,9 +2512,15 @@ def make_pipeline_run(
     ``n_epochs`` is static (one compile per value); ``grad_bucket_bytes``
     selects the gradient-sync mode (see make_pipeline_step).
     """
+    if zero is not None and int(zero) == 3:
+        raise ValueError(
+            "the fused multi-epoch run cannot shard params at rest: its "
+            "eval step consumes the full stacked layout every epoch — "
+            "use --zero 3 without --fused-run (per-epoch dispatch)"
+        )
     step = make_pipeline_step(
         mesh, spec, prog, mubatch_size, opt, precision, jit=False,
-        tick_unroll=tick_unroll, zero1=zero1, clip_norm=clip_norm,
+        tick_unroll=tick_unroll, zero1=zero1, zero=zero, clip_norm=clip_norm,
         kernel_backend=kernel_backend, with_grad_norm=with_grad_norm,
         grad_bucket_bytes=grad_bucket_bytes,
     )

@@ -155,6 +155,53 @@ def test_mesh_cli_interleaved_zero1_momentum(tiny_data):
     assert re.search(r"final model hash: [0-9a-f]{40}", out)
 
 
+@pytest.mark.slow
+def test_mesh_cli_zero23_hash_pin(tiny_data):
+    """The ZeRO lattice's CLI surface: --zero 2 and --zero 1 at
+    --mubatches 1 print the SAME final model hash (the fixed-layout
+    bitwise pin — one scatter contribution per shard element), and
+    --zero 3 trains, evals and syncs on the same layout. (Slow tier:
+    `make zero-smoke` runs the identical CLI pin end-to-end, and the
+    session/executor pins cover it in tier-1.)"""
+    common = [
+        "--dp", "2", "--pp", "2", "--optimizer", "momentum",
+        "--epochs", "1", "--global-batch-size", "32", "--mubatches", "1",
+    ]
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    hashes = {}
+    for stage in ("1", "2"):
+        out = _run(common + ["--zero", stage, "--no-eval"], tiny_data,
+                   extra_env=env)
+        hashes[stage] = re.search(r"final model hash: ([0-9a-f]{40})", out).group(1)
+    assert hashes["1"] == hashes["2"]
+    out = _run(common + ["--zero", "3"], tiny_data, extra_env=env)
+    assert "DP replicas in sync" in out
+    assert re.search(r"final model hash: [0-9a-f]{40}", out)
+
+
+def test_cli_zero_refusals_exit_2(tiny_data):
+    """The six fail-fast lattice refusals, all at argparse time (exit 2,
+    pre-backend): stage conflicts and the combinations the executor has
+    no program for."""
+    cases = [
+        (["--zero1", "--zero", "2"], "conflicting dp-stage selectors"),
+        (["--zero", "3", "--dp", "2", "--fused-run"],
+         "incompatible with --fused-run"),
+        (["--zero", "3", "--dp", "2", "--kernel-backend", "pallas"],
+         "incompatible with --kernel-backend pallas"),
+        (["--zero", "3", "--dp", "2", "--grad-bucket-bytes", "1024"],
+         "syncs gradients per tick"),
+        (["--zero", "2", "--dp", "2", "--pp", "2", "--runtime", "mpmd"],
+         "does not support --zero"),
+        (["--zero", "2", "--dp", "2", "--digests"],
+         "--digests is incompatible"),
+    ]
+    for args, msg in cases:
+        r = _run_raw(args, tiny_data)
+        assert r.returncode == 2, (args, r.stderr[-500:])
+        assert msg in r.stderr, (args, r.stderr[-500:])
+
+
 def test_mesh_cli_kernel_backend_pallas_matches_xla(tiny_data):
     """The executor's Pallas backend is a product feature, not a test-only
     artifact: the CLI flag must train bit-identically to the default XLA

@@ -130,7 +130,7 @@ def _random_case_r2(seed):
 def _assert_lattice_case_matches_sequential(
     sizes, dp, pp, V, M, B, opt, zero1, sched, clip, fused, data_seed,
     kb="xla", label_extra="", gbb=0, bsplit=False, tp=1, act="relu",
-    recompute=False,
+    recompute=False, zero=None,
 ):
     """The ONE sequential-vs-pipeline comparison harness behind the r2, r3
     and r4 lattice fuzz families: train two batches sequentially (the
@@ -140,7 +140,10 @@ def _assert_lattice_case_matches_sequential(
     contraction, exactly like the dp sum). ``act`` picks the activation
     family (the model-zoo dimension); ``recompute`` drops the forward
     stash and re-runs the stage forward at the backward boundary — both
-    must be invisible here."""
+    must be invisible here. ``zero`` (superseding the ``zero1`` bool when
+    set) picks the dp-axis ZeRO stage: 2-3 carry the cross-layout
+    tolerance like tp (the per-tick scatter reassociates the microbatch
+    sum), which is exactly what this oracle already prices."""
     spec_pp = Mo.make_model_spec(sizes, pp * V, B, act=act)
     assert spec_pp.stages[-1].n_linears > 0  # generator guarantees parity regime
 
@@ -167,29 +170,42 @@ def _assert_lattice_case_matches_sequential(
         sched, M, pp, virtual=V, backward_split=bsplit, recompute=recompute
     )
     stacked, flags = E.init_stacked(spec_pp, mesh, order=order)
-    ost = E.zero1_init_state(opt, spec_pp, mesh) if zero1 else opt.init(stacked)
+    zstage = (1 if zero1 else 0) if zero is None else int(zero)
+    if zstage >= 2:
+        ost = E.zero_block_init_state(opt, spec_pp, mesh)
+    elif zstage == 1:
+        ost = E.zero1_init_state(opt, spec_pp, mesh)
+    else:
+        ost = opt.init(stacked)
+    if zstage == 3:
+        rows = E.zero_block_flatten_rows(
+            jax.device_get(stacked), spec_pp, mesh)
+        stacked = {"P": jax.device_put(rows, E.zero1_part_sharding(mesh))}
     if fused:
         # same two batches as one epoch inside the fused whole-run program
         run = E.make_pipeline_run(
-            mesh, spec_pp, prog, B // dp // M, opt, zero1=zero1, clip_norm=clip,
-            kernel_backend=kb, grad_bucket_bytes=gbb,
+            mesh, spec_pp, prog, B // dp // M, opt, zero=zstage,
+            clip_norm=clip, kernel_backend=kb, grad_bucket_bytes=gbb,
         )
         stacked, ost, _ = run(stacked, flags, ost, jnp.asarray(X), jnp.asarray(Y), 1)
     else:
         step = E.make_pipeline_step(
-            mesh, spec_pp, prog, B // dp // M, opt, zero1=zero1, clip_norm=clip,
-            kernel_backend=kb, grad_bucket_bytes=gbb,
+            mesh, spec_pp, prog, B // dp // M, opt, zero=zstage,
+            clip_norm=clip, kernel_backend=kb, grad_bucket_bytes=gbb,
         )
         for i in range(2):
             stacked, ost, _ = step(
                 stacked, flags, ost, jnp.asarray(X[i]), jnp.asarray(Y[i])
             )
+    if zstage == 3:
+        stacked = E.zero_block_unflatten_rows(
+            np.asarray(jax.device_get(stacked["P"])), spec_pp, mesh)
     got = [l for s in E.unstack_params(stacked, spec_pp, order=order) for l in s]
     assert len(want) == len(got)
 
     label = (
         f"sizes={sizes} dp={dp} pp={pp} tp={tp} V={V} M={M} B={B} "
-        f"{type(opt).__name__} zero1={zero1} clip={clip} fused={fused} "
+        f"{type(opt).__name__} zero={zstage} clip={clip} fused={fused} "
         f"gbb={gbb} bsplit={bsplit} act={act} rec={recompute} "
         f"{sched.__name__}{label_extra}"
     )
@@ -347,6 +363,62 @@ def test_random_r4_model_recompute_combo_matches_sequential(seed):
         sizes, dp, pp, 1, M, B, opt, zero1, sched, clip, fused,
         data_seed=8000 + seed, gbb=gbb, bsplit=bsplit, tp=tp, act=act,
         recompute=recompute,
+    )
+
+
+def _random_case_r5(seed):
+    """Round-20 feature fuzz: the ZeRO STAGE dimension — ``zero`` in
+    {0,1,2,3} cycling every 4 seeds so each stage meets three different
+    feature draws — crossed with tp x grad-bucketing x backward-split x
+    interleaved virtual stages x epoch-vs-step. Stage constraints mirror
+    the executor's refusals: stage 3 syncs per tick (no bucket plan) and
+    keeps params sharded at rest (the fused whole-run program's eval
+    view is an API-level refusal, so the fused bit only rides stages
+    0-2)."""
+    rng = np.random.RandomState(9000 + seed)
+    zero = seed % 4
+    dp, pp = [(2, 2), (4, 2), (2, 1)][(seed // 4) % 3]
+    V = 2 if (seed // 2) % 2 and pp > 1 else 1
+    opt = OPTS[(seed + seed // 3) % 3]
+    clip = [None, 0.05][(seed + seed // 2) % 2]
+    gbb = (
+        [0, int(rng.choice([256, 8192]))][(seed // 5) % 2]
+        if zero != 3 else 0
+    )
+    bsplit = bool((seed + seed // 6) % 2) and V == 1 and pp > 1
+    tp = 2 if (seed + seed // 5) % 2 and dp * pp <= 4 else 1
+    fused = bool((seed + seed // 4) % 2) and zero != 3
+    n_stages = pp * V
+    n_sizes = n_stages * int(rng.randint(2, 4))
+    widths = sorted(rng.randint(8, 48, size=n_sizes - 1).tolist(), reverse=True)
+    sizes = tuple(widths) + (int(rng.randint(4, min(8, min(widths)) + 1)),)
+    M = int(pp * rng.choice([1, 2]))  # interleaved needs M % pp == 0
+    B = int(dp * M * rng.choice([4, 8]))
+    sched = S.InterleavedSchedule if V > 1 else (
+        S.PipeDreamFlushSchedule if bsplit else SCHEDS[seed % 3])
+    return sizes, dp, pp, V, M, B, opt, zero, sched, clip, fused, gbb, bsplit, tp
+
+
+@pytest.mark.parametrize(
+    "seed",
+    # seed 3 (zero=3 — the most exotic point of the new lattice
+    # dimension) keeps tier-1 coverage; the rest ride the slow tier
+    # (1-core wall budget; stage 2 has dedicated tier-1 legs in
+    # test_zero23.py)
+    [s if s == 3 else pytest.param(s, marks=pytest.mark.slow)
+     for s in range(12)],
+)
+def test_random_r5_zero_stage_combo_matches_sequential(seed):
+    """Random ZeRO-stage draws crossed with tp/bucketing/backward-split/
+    interleaved must still equal sequential training — the dp-axis
+    residency lattice is invisible to the math on every layout."""
+    (
+        sizes, dp, pp, V, M, B, opt, zero, sched, clip, fused, gbb, bsplit,
+        tp,
+    ) = _random_case_r5(seed)
+    _assert_lattice_case_matches_sequential(
+        sizes, dp, pp, V, M, B, opt, False, sched, clip, fused,
+        data_seed=9500 + seed, gbb=gbb, bsplit=bsplit, tp=tp, zero=zero,
     )
 
 

@@ -301,6 +301,69 @@ def test_kill_and_resume_bitwise_equals_uninterrupted(data_dir, tmp_path):
     assert eps[0]["steps_counted"] == 3
 
 
+@pytest.mark.parametrize(
+    "killed_kw,resumed_kw",
+    [
+        # zero2-dp2 -> zero1-dp4: the grad/state shards re-deal over a
+        # WIDER dp axis at a LOWER stage
+        pytest.param(
+            dict(dp=2, pp=2, schedule="gpipe", zero=2),
+            dict(dp=4, pp=2, schedule="gpipe", zero=1),
+            id="zero2dp2-to-zero1dp4", marks=pytest.mark.slow,
+        ),
+        # zero3-dp2 -> sequential: params sharded at rest rehydrate into
+        # the no-mesh layout (slow tier: the 1-core tier-1 wall budget
+        # is tight; test_zero23's z3-save -> plain-load leg keeps the
+        # logical-snapshot contract in tier-1)
+        pytest.param(
+            dict(dp=2, pp=2, schedule="gpipe", zero=3),
+            dict(),
+            id="zero3dp2-to-seq", marks=pytest.mark.slow,
+        ),
+    ],
+)
+def test_kill_resume_elastic_resharding(data_dir, tmp_path, killed_kw,
+                                        resumed_kw):
+    """ZeRO snapshots are LOGICAL (the zero1 checkpoint substrate keeps
+    nothing layout-shaped on disk), so a run killed under one (stage, dp)
+    point resumes under ANOTHER — elastic re-sharding. Bitwise at
+    restore: the re-sharded resume and a same-layout resume of the same
+    snapshot agree on params (hash) and on every optimizer-state leaf,
+    and the re-sharded session trains on from the cursor."""
+    ck = tmp_path / "ck"
+    run = _session(
+        data_dir, optimizer="momentum", checkpoint_dir=ck,
+        faults="die@step=5", **killed_kw,
+    )
+    with pytest.raises(faults.InjectedFault):
+        while run.epoch < 2:
+            run.train_steps(2)
+            run.save_step_checkpoint()
+    assert [gs for gs, _ in list_step_checkpoints(ck)][-1] == 5
+
+    res = _session(
+        data_dir, optimizer="momentum", checkpoint_dir=ck, resume="auto",
+        **resumed_kw,
+    )
+    same = _session(
+        data_dir, optimizer="momentum", checkpoint_dir=ck, resume="auto",
+        **killed_kw,
+    )
+    assert res.resumed_from == same.resumed_from
+    assert res.model_hash() == same.model_hash()
+    a, b = res.opt_state_logical(), same.opt_state_logical()
+    assert sorted(a["parts"]) == sorted(b["parts"])
+    import jax
+
+    la, lb = jax.tree.leaves(a["parts"]), jax.tree.leaves(b["parts"])
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    while res.epoch < 2:
+        res.train_steps(2)
+    assert res.epoch == 2 and np.isfinite(res.accuracy())
+
+
 def test_resume_auto_skips_corrupt_newest(data_dir, tmp_path):
     """Acceptance criterion end-to-end: corrupt the NEWEST snapshot with
     the fault harness; resume auto detects it via the checksum, falls back

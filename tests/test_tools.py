@@ -55,7 +55,9 @@ def test_weighted_utilization_matches_documented_figures():
         weighted_utilization,
     )
 
-    assert PIPELINE_OP_COSTS == {"fwd": 1.0, "bwd": 2.0, "bwd_in": 1.0, "bwd_w": 1.0}
+    assert PIPELINE_OP_COSTS == {
+        "fwd": 1.0, "bwd": 2.0, "bwd_in": 1.0, "bwd_w": 1.0, "recompute": 1.0,
+    }
     pd8 = lower_schedule(S.PipeDreamFlushSchedule, 8, 4)
     pd8s = lower_schedule(S.PipeDreamFlushSchedule, 8, 4, backward_split=True)
     assert round((1 - weighted_utilization(pd8)) * 100) == 40

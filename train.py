@@ -90,7 +90,22 @@ def main():
         action="store_true",
         help="ZeRO-1: shard the optimizer state + update over the dp axis "
         "(reduce_scatter grads, per-replica chunk update, all_gather params; "
-        "mesh layouts only — beyond the reference)",
+        "mesh layouts only — beyond the reference). Alias for --zero 1",
+    )
+    ap.add_argument(
+        "--zero",
+        type=int,
+        choices=[0, 1, 2, 3],
+        default=None,
+        help="ZeRO stage on the dp axis (mesh layouts; supersedes --zero1): "
+        "0 = replicate everything (the anchor all-reduce sync); 1 = shard "
+        "the optimizer state + update; 2 = gradients also live as "
+        "persistent reduce-scattered per-rank shards (composes with "
+        "--grad-bucket-bytes; bitwise-equal weights to --zero 1 at the "
+        "same layout); 3 = parameters sharded at rest too, all-gathered "
+        "just-in-time per layer inside the tick scan (per-tick gradient "
+        "reduce-scatter; cross-stage tolerance numerics, same-layout "
+        "determinism). See docs/performance.md for when each stage pays",
     )
     ap.add_argument(
         "--grad-bucket-bytes",
@@ -439,6 +454,45 @@ def main():
     # "plan is active" mirrors faults.FaultPlan.parse: any non-empty
     # comma-separated part is an injection (checked without importing the
     # package — argparse time stays jax-free)
+    if args.zero1 and args.zero is not None and args.zero != 1:
+        ap.error(
+            f"conflicting dp-stage selectors: --zero1 and --zero {args.zero} "
+            "— pass only --zero"
+        )
+    zero_stage = args.zero if args.zero is not None else (1 if args.zero1 else 0)
+    if zero_stage == 3 and args.fused_run:
+        ap.error(
+            "--zero 3 is incompatible with --fused-run: the fused "
+            "multi-epoch run's eval step consumes the full stacked layout "
+            "every epoch, but stage 3 keeps parameters sharded at rest — "
+            "drop --fused-run (the per-epoch loop dispatches ZeRO-3)"
+        )
+    if zero_stage == 3 and args.kernel_backend == "pallas":
+        ap.error(
+            "--zero 3 is incompatible with --kernel-backend pallas: the "
+            "fused slot kernels consume resident {W, b} operands, but "
+            "stage 3 materializes parameters per tick via all-gather — "
+            "drop one of the two flags"
+        )
+    if zero_stage == 3 and args.grad_bucket_bytes:
+        ap.error(
+            "--zero 3 syncs gradients per tick (reduce-scatter into the "
+            "persistent shard carry) — there is no tail collective for "
+            "--grad-bucket-bytes to bucket; drop one of the two flags"
+        )
+    if zero_stage and args.runtime == "mpmd":
+        ap.error(
+            f"--runtime mpmd does not support --zero {zero_stage} yet: the "
+            "ZeRO reduce-scatter/all-gather tail assumes the lockstep SPMD "
+            "program's dp axis — drop one of the two flags"
+        )
+    if zero_stage >= 2 and args.digests:
+        ap.error(
+            f"--digests is incompatible with --zero {zero_stage}: the "
+            "digest taps read the zero1 flat-chunk segment map, which "
+            "stages 2-3 replace with the block-cyclic shard layout — drop "
+            "one of the two flags"
+        )
     faults_env = os.environ.get("SHALLOWSPEED_FAULTS", "")
     if args.fused_run and any(p.strip() for p in faults_env.split(",")):
         ap.error(
@@ -479,7 +533,7 @@ def main():
             optimizer=args.optimizer,
             momentum=args.momentum,
             virtual_stages=args.virtual_stages,
-            zero1=args.zero1,
+            zero=zero_stage,
             grad_bucket_bytes=args.grad_bucket_bytes,
             backward_split=args.backward_split,
             recompute=args.recompute,
